@@ -1,0 +1,55 @@
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Current flagship config (BASELINE.md target #1): pairwise L2 + brute-force
+kNN, sift-128-euclidean shape (10k queries × 10k database, dim=128, k=10).
+Metric is QPS in throughput mode (all queries batched), matching
+raft-ann-bench's QPS definition (docs/source/raft_ann_benchmarks.md:154).
+``vs_baseline`` is 1.0 — BASELINE.json publishes no reference numbers
+(``published: {}``), so there is nothing to normalize against yet.
+
+As the index suite lands, this graduates to IVF-PQ / CAGRA QPS@recall=0.95.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main():
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.stats import neighborhood_recall
+
+    n_db, n_q, dim, k = 10_000, 10_000, 128, 10
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((n_db, dim)).astype(np.float32)
+    q = rng.standard_normal((n_q, dim)).astype(np.float32)
+
+    index = brute_force.build(db, metric="sqeuclidean")
+    # warmup (compile)
+    d, i = brute_force.search(index, q[:n_q], k)
+    jax.block_until_ready((d, i))
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        d, i = brute_force.search(index, q, k)
+        jax.block_until_ready((d, i))
+    dt = (time.perf_counter() - t0) / iters
+    qps = n_q / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "brute_force_knn_qps_sift10k_k10",
+                "value": round(qps, 1),
+                "unit": "QPS",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""raft_tpu — TPU-native vector-search & ML-primitives framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of RAPIDS RAFT
+(reference: /root/reference, RAFT 24.02): pairwise distances, batched top-k
+selection, fused L2 1-NN, (balanced) k-means, RNG and stats primitives, and
+the ANN index suite — brute-force, IVF-Flat, IVF-PQ, CAGRA — plus a comms
+facade over ICI/DCN mesh collectives for multi-host sharded index build.
+
+Layout mirrors the reference's layer map (SURVEY.md §1) but the design is
+TPU-first: jax.Array instead of mdspan/mdarray, XLA fusion + Pallas kernels
+instead of hand-rolled CUDA, jax.sharding.Mesh collectives instead of NCCL.
+"""
+
+from raft_tpu.core.resources import Resources
+from raft_tpu import core, ops, cluster, neighbors, parallel, stats, utils
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Resources",
+    "core",
+    "ops",
+    "cluster",
+    "neighbors",
+    "parallel",
+    "stats",
+    "utils",
+    "__version__",
+]

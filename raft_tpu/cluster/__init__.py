@@ -1,0 +1,4 @@
+"""Cluster layer (SURVEY.md §2.6): k-means (Lloyd), balanced hierarchical
+k-means (IVF coarse-quantizer trainer), single-linkage."""
+
+__all__ = []

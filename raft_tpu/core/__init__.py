@@ -1,0 +1,19 @@
+"""Core layer: resources/context, serialization, bitset, logging/tracing.
+
+TPU-native analog of ``cpp/include/raft/core`` (SURVEY.md §2.1). The mdspan/
+mdarray machinery of the reference collapses into plain ``jax.Array`` here —
+shape/dtype conventions are documented per-API instead of encoded in types.
+"""
+
+from raft_tpu.core.resources import Resources, default_resources, ensure_resources
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core import logger, serialize
+
+__all__ = [
+    "Resources",
+    "default_resources",
+    "ensure_resources",
+    "Bitset",
+    "logger",
+    "serialize",
+]

@@ -1,0 +1,106 @@
+"""Device bitset — the basis of ANN search filtering.
+
+Reference: ``raft::core::bitset`` / ``bitset_view`` (core/bitset.cuh:91-147):
+a packed device bitset with set/test/flip/count used by
+``bitset_filter`` sample filters (neighbors/sample_filter_types.hpp:27-82) to
+exclude dataset rows from search results.
+
+TPU-native design: bits packed into a ``uint32`` jax.Array; all ops are pure
+functions returning new arrays (XLA fuses the word-twiddling); ``test`` on a
+batch of indices is a gather + mask — exactly what the search pipelines need
+to build additive distance masks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_WORD_BITS = 32
+
+
+def _n_words(n_bits: int) -> int:
+    return (n_bits + _WORD_BITS - 1) // _WORD_BITS
+
+
+class Bitset:
+    """Immutable-functional packed bitset over ``n_bits`` positions."""
+
+    def __init__(self, words: jax.Array, n_bits: int):
+        self.words = words
+        self.n_bits = int(n_bits)
+
+    # ------------------------------------------------------------ constructors
+    @staticmethod
+    def create(n_bits: int, default: bool = True) -> "Bitset":
+        """New bitset; RAFT's bitset default-constructs to all-set (all samples
+        pass the filter)."""
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        words = jnp.full((_n_words(n_bits),), fill, dtype=jnp.uint32)
+        return Bitset(words, n_bits)._mask_tail()
+
+    @staticmethod
+    def from_mask(mask) -> "Bitset":
+        """Build from a boolean vector of length n_bits."""
+        mask = jnp.asarray(mask, dtype=bool)
+        n_bits = mask.shape[0]
+        pad = _n_words(n_bits) * _WORD_BITS - n_bits
+        mask = jnp.pad(mask, (0, pad))
+        bits = mask.reshape(-1, _WORD_BITS).astype(jnp.uint32)
+        shifts = jnp.arange(_WORD_BITS, dtype=jnp.uint32)
+        words = jnp.sum(bits << shifts, axis=1, dtype=jnp.uint32)
+        return Bitset(words, n_bits)
+
+    def _mask_tail(self) -> "Bitset":
+        tail = self.n_bits % _WORD_BITS
+        if tail == 0:
+            return self
+        last_mask = jnp.uint32((1 << tail) - 1)
+        words = self.words.at[-1].set(self.words[-1] & last_mask)
+        return Bitset(words, self.n_bits)
+
+    # ------------------------------------------------------------------- ops
+    def set(self, indices, value: bool = True) -> "Bitset":
+        """Set (or clear) the bits at ``indices``; duplicate indices are fine.
+
+        Scatter-OR has no native lowering, so route through a boolean scatter
+        (one bit-position per element) and re-pack — XLA fuses the repack.
+        """
+        indices = jnp.asarray(indices)
+        touched = jnp.zeros((self.n_bits,), dtype=bool).at[indices].set(True)
+        mask_words = Bitset.from_mask(touched).words
+        if value:
+            return Bitset(self.words | mask_words, self.n_bits)._mask_tail()
+        return Bitset(self.words & ~mask_words, self.n_bits)._mask_tail()
+
+    def test(self, indices) -> jax.Array:
+        """Gather bit values for a batch of indices → bool array."""
+        indices = jnp.asarray(indices)
+        words = self.words[indices // _WORD_BITS]
+        return ((words >> (indices % _WORD_BITS).astype(jnp.uint32)) & 1).astype(bool)
+
+    def flip(self) -> "Bitset":
+        return Bitset(~self.words, self.n_bits)._mask_tail()
+
+    def count(self) -> jax.Array:
+        """Population count (reference: bitset::count)."""
+        return jnp.sum(_popcount32(self.words))
+
+    def to_mask(self) -> jax.Array:
+        """Expand to a boolean vector of length n_bits."""
+        shifts = jnp.arange(_WORD_BITS, dtype=jnp.uint32)
+        bits = (self.words[:, None] >> shifts[None, :]) & 1
+        return bits.reshape(-1)[: self.n_bits].astype(bool)
+
+    def __len__(self) -> int:
+        return self.n_bits
+
+
+def _popcount32(x: jax.Array) -> jax.Array:
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return (x * jnp.uint32(0x01010101)) >> 24

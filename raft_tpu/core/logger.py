@@ -1,0 +1,84 @@
+"""Logging + named-scope tracing.
+
+Reference: spdlog-backed singleton logger with a callback sink so Python can
+capture C++ logs (core/logger-inl.hpp:74-131, detail/callback_sink.hpp) and
+``RAFT_LOG_{TRACE..CRITICAL}`` macros (core/logger-macros.hpp); NVTX RAII
+ranges at every nontrivial entry point (core/nvtx.hpp:25-91).
+
+TPU-native design: stdlib ``logging`` with an optional user callback sink
+(mirroring the reference's Python-capture path), and tracing via
+``jax.named_scope`` / ``jax.profiler.TraceAnnotation`` so ranges show up in
+XLA profiles (xprof) exactly where NVTX ranges show up in Nsight.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Callable, Optional
+
+import jax
+
+_logger = logging.getLogger("raft_tpu")
+if not _logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s"))
+    _logger.addHandler(_h)
+    _logger.setLevel(logging.WARNING)
+
+_callback: Optional[Callable[[int, str], None]] = None
+
+
+def get_logger() -> logging.Logger:
+    return _logger
+
+
+def set_level(level: int) -> None:
+    """Set log level (reference: logger::set_level, core/logger-inl.hpp:103)."""
+    _logger.setLevel(level)
+
+
+def set_callback(cb: Optional[Callable[[int, str], None]]) -> None:
+    """Install a capture callback receiving (level, message) — the analog of
+    the reference's callback_sink used by pylibraft to surface C++ logs."""
+    global _callback
+    _callback = cb
+
+
+def _emit(level: int, msg: str, *args) -> None:
+    if args:
+        msg = msg % args
+    if _callback is not None:
+        _callback(level, msg)
+    _logger.log(level, msg)
+
+
+def trace(msg, *args):
+    _emit(logging.DEBUG - 5, msg, *args)
+
+
+def debug(msg, *args):
+    _emit(logging.DEBUG, msg, *args)
+
+
+def info(msg, *args):
+    _emit(logging.INFO, msg, *args)
+
+
+def warn(msg, *args):
+    _emit(logging.WARNING, msg, *args)
+
+
+def error(msg, *args):
+    _emit(logging.ERROR, msg, *args)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """RAII trace range (reference: common::nvtx::range, core/nvtx.hpp:25-91).
+
+    Inside jit traces this adds a named_scope (shows in HLO + xprof op names);
+    outside it adds a profiler TraceAnnotation (shows on the host timeline).
+    """
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield

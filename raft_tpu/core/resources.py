@@ -1,0 +1,158 @@
+"""Resources — the per-call context object (TPU analog of ``raft::resources``).
+
+The reference threads a ``raft::resources const&`` through every public API as
+the first argument (reference: cpp/include/raft/core/resources.hpp:47-137); it
+carries the CUDA stream, cuBLAS/cuSOLVER handles, the communicator, and the
+workspace memory resource. On TPU, XLA owns streams and library handles, so the
+equivalent context is much lighter: a device (or mesh of devices), a PRNG key
+stream, an HBM workspace budget used to pick tile/batch sizes, and the comms
+handle for multi-host runs.
+
+Like the reference's type-erased resource container (``resources::get_resource``
+keyed by ``resource_type`` slots — core/resource/resource_types.hpp:29-47), the
+``Resources`` object supports lazily-built custom slots via ``get_resource`` so
+downstream layers can stash caches (e.g. compiled kernels, sub-communicators)
+without new fields.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+class Resources:
+    """Lightweight resource/context container threaded through public APIs.
+
+    Parameters
+    ----------
+    device:
+        A single ``jax.Device`` to place work on. ``None`` = JAX default.
+    mesh:
+        A ``jax.sharding.Mesh`` for SPMD execution; when set, algorithms that
+        support sharded execution pjit/shard_map over this mesh. Mutually
+        compatible with ``device`` (single-device work ignores the mesh).
+    seed:
+        Base seed for this context's PRNG key stream (analog of
+        ``random::RngState`` living in the handle).
+    workspace_limit_bytes:
+        Soft HBM budget used to size tiles/batches (analog of the reference's
+        ``limiting_memory_resource`` workspace —
+        core/resource/device_memory_resource.hpp:38-88). Defaults to a
+        conservative estimate from the device's memory stats.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        seed: int = 0,
+        workspace_limit_bytes: Optional[int] = None,
+    ):
+        self._device = device
+        self.mesh = mesh
+        self._key = jax.random.key(seed)
+        self._key_lock = threading.Lock()
+        self._workspace_limit = workspace_limit_bytes
+        self._slots: dict[str, Any] = {}
+        self._slot_lock = threading.Lock()
+        self._comms = None  # set by raft_tpu.parallel.comms.inject_comms
+
+    # ------------------------------------------------------------------ device
+    @property
+    def device(self) -> jax.Device:
+        if self._device is not None:
+            return self._device
+        return jax.devices()[0]
+
+    @property
+    def workspace_limit_bytes(self) -> int:
+        if self._workspace_limit is not None:
+            return self._workspace_limit
+        stats = getattr(self.device, "memory_stats", lambda: None)()
+        if stats and "bytes_limit" in stats:
+            # Leave headroom: workspace is for scratch, not the whole HBM.
+            return int(stats["bytes_limit"] * 0.25)
+        return 2 << 30  # 2 GiB fallback (CPU backend / unknown device)
+
+    # -------------------------------------------------------------------- rng
+    def next_key(self) -> jax.Array:
+        """Split and return a fresh PRNG key (thread-safe)."""
+        with self._key_lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_keys(self, n: int) -> jax.Array:
+        with self._key_lock:
+            keys = jax.random.split(self._key, n + 1)
+            self._key = keys[0]
+        return keys[1:]
+
+    # ------------------------------------------------------------------ slots
+    def get_resource(self, name: str, factory: Callable[[], Any]) -> Any:
+        """Lazily-created custom resource slot (analog of resource_type CUSTOM)."""
+        with self._slot_lock:
+            if name not in self._slots:
+                self._slots[name] = factory()
+            return self._slots[name]
+
+    def has_resource(self, name: str) -> bool:
+        with self._slot_lock:
+            return name in self._slots
+
+    # ------------------------------------------------------------------ comms
+    @property
+    def comms(self):
+        """The injected communicator (raft_tpu.parallel.comms.Comms) or None.
+
+        Analog of ``resource::get_comms(handle)`` (reference:
+        core/resource/comms.hpp); raises if none injected, matching the
+        reference's behavior of failing when the COMMUNICATOR slot is unset.
+        """
+        if self._comms is None:
+            raise RuntimeError(
+                "No communicator injected into this Resources; call "
+                "raft_tpu.parallel.comms.inject_comms(res, ...) first."
+            )
+        return self._comms
+
+    @property
+    def has_comms(self) -> bool:
+        return self._comms is not None
+
+    # ------------------------------------------------------------------- sync
+    def sync(self, *arrays) -> None:
+        """Block until given arrays (or all dispatched work) are ready.
+
+        Analog of ``resource::sync_stream``; under JAX, async dispatch means
+        results materialize lazily — tests and benchmarks call this to fence.
+        """
+        if arrays:
+            for a in jax.tree_util.tree_leaves(arrays):
+                if isinstance(a, jax.Array):
+                    a.block_until_ready()
+        else:
+            # Fence the whole device queue.
+            jax.effects_barrier()
+
+
+_default_resources: Optional[Resources] = None
+_default_lock = threading.Lock()
+
+
+def default_resources() -> Resources:
+    """Process-wide default Resources (analog of device_resources_manager —
+    reference: core/device_resources_manager.hpp:36-95)."""
+    global _default_resources
+    with _default_lock:
+        if _default_resources is None:
+            _default_resources = Resources()
+        return _default_resources
+
+
+def ensure_resources(res: Optional[Resources]) -> Resources:
+    """Internal helper: APIs accept ``res=None`` and fall back to the default."""
+    return res if res is not None else default_resources()

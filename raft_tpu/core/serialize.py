@@ -1,0 +1,127 @@
+"""Array + index (de)serialization, numpy-``.npy`` compatible.
+
+The reference serializes every mdspan in numpy .npy format so index files are
+language-interchangeable (reference: core/serialize.hpp:36-145,
+core/detail/mdspan_numpy_serializer.hpp:42-161), and each ANN index writes a
+version constant followed by scalars + arrays (e.g.
+neighbors/detail/ivf_pq_serialize.cuh). We keep the same container model:
+
+- ``serialize_array`` / ``deserialize_array``: one jax/numpy array in .npy
+  format on a binary stream (delegates to numpy, which *is* the format).
+- ``serialize_scalar`` / ``deserialize_scalar``: fixed-dtype little-endian
+  scalars (reference serializes scalars via a 0-d mdspan; we write raw dtype
+  bytes with an explicit dtype tag for robustness).
+- ``IndexWriter`` / ``IndexReader``: magic + named-version header, then an
+  ordered sequence of scalars and arrays — the pattern every index's
+  serialize/deserialize uses.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Union
+
+import jax
+import numpy as np
+
+_MAGIC = b"RAFT_TPU_IDX"
+_SERIALIZATION_VERSION = 1
+
+ArrayLike = Union[np.ndarray, "jax.Array"]
+
+
+def _to_numpy(a: ArrayLike) -> np.ndarray:
+    if isinstance(a, np.ndarray):
+        return a
+    return np.asarray(jax.device_get(a))
+
+
+def serialize_array(stream: BinaryIO, a: ArrayLike) -> None:
+    """Write one array in .npy format (same wire format as the reference's
+    serialize_mdspan — core/serialize.hpp:36)."""
+    np.save(stream, _to_numpy(a), allow_pickle=False)
+
+
+def deserialize_array(stream: BinaryIO) -> np.ndarray:
+    return np.load(stream, allow_pickle=False)
+
+
+def serialize_scalar(stream: BinaryIO, value, dtype: str) -> None:
+    """Write a tagged little-endian scalar (dtype in numpy str form)."""
+    dt = np.dtype(dtype).newbyteorder("<")
+    tag = dt.str.encode()
+    stream.write(struct.pack("<B", len(tag)))
+    stream.write(tag)
+    stream.write(np.asarray(value, dtype=dt).tobytes())
+
+
+def deserialize_scalar(stream: BinaryIO):
+    (tag_len,) = struct.unpack("<B", stream.read(1))
+    dt = np.dtype(stream.read(tag_len).decode())
+    val = np.frombuffer(stream.read(dt.itemsize), dtype=dt)[0]
+    return val.item()
+
+
+class IndexWriter:
+    """Header + ordered payload writer used by every index's serialize()."""
+
+    def __init__(self, stream: BinaryIO, kind: str, version: int):
+        self.stream = stream
+        stream.write(_MAGIC)
+        stream.write(struct.pack("<I", _SERIALIZATION_VERSION))
+        kind_b = kind.encode()
+        stream.write(struct.pack("<I", len(kind_b)))
+        stream.write(kind_b)
+        stream.write(struct.pack("<I", version))
+
+    def scalar(self, value, dtype: str) -> "IndexWriter":
+        serialize_scalar(self.stream, value, dtype)
+        return self
+
+    def string(self, s: str) -> "IndexWriter":
+        b = s.encode()
+        self.stream.write(struct.pack("<I", len(b)))
+        self.stream.write(b)
+        return self
+
+    def array(self, a: ArrayLike) -> "IndexWriter":
+        serialize_array(self.stream, a)
+        return self
+
+
+class IndexReader:
+    def __init__(self, stream: BinaryIO, kind: str, max_version: int):
+        self.stream = stream
+        magic = stream.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"bad magic {magic!r}: not a raft_tpu index file")
+        (fmt_ver,) = struct.unpack("<I", stream.read(4))
+        if fmt_ver > _SERIALIZATION_VERSION:
+            raise ValueError(f"serialization format v{fmt_ver} is newer than supported")
+        (kind_len,) = struct.unpack("<I", stream.read(4))
+        found = stream.read(kind_len).decode()
+        if found != kind:
+            raise ValueError(f"index kind mismatch: file has {found!r}, expected {kind!r}")
+        (self.version,) = struct.unpack("<I", stream.read(4))
+        if self.version > max_version:
+            raise ValueError(
+                f"{kind} index version {self.version} is newer than supported {max_version}"
+            )
+
+    def scalar(self):
+        return deserialize_scalar(self.stream)
+
+    def string(self) -> str:
+        (n,) = struct.unpack("<I", self.stream.read(4))
+        return self.stream.read(n).decode()
+
+    def array(self) -> np.ndarray:
+        return deserialize_array(self.stream)
+
+
+def open_for(file_or_stream, mode: str):
+    """Return (stream, should_close) for a path or an already-open stream."""
+    if isinstance(file_or_stream, (str, bytes)) or hasattr(file_or_stream, "__fspath__"):
+        return open(file_or_stream, mode), True
+    return file_or_stream, False

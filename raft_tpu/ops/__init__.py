@@ -1,0 +1,26 @@
+"""Dense primitives layer (SURVEY.md §2.3/§2.5): distances, top-k selection,
+fused L2 1-NN, RNG — the TPU analogs of raft::{distance, matrix, linalg,
+random} kernel prims."""
+
+from raft_tpu.ops.distance import (
+    DistanceType,
+    pairwise_distance,
+    resolve_metric,
+    is_min_close,
+    row_norms_sq,
+)
+from raft_tpu.ops.select_k import SelectAlgo, select_k
+from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
+from raft_tpu.ops import rng
+
+__all__ = [
+    "DistanceType",
+    "pairwise_distance",
+    "resolve_metric",
+    "is_min_close",
+    "row_norms_sq",
+    "SelectAlgo",
+    "select_k",
+    "fused_l2_nn_argmin",
+    "rng",
+]

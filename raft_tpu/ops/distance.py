@@ -1,0 +1,369 @@
+"""Pairwise distances — TPU-native engine for all dense RAFT metrics.
+
+Reference: ``raft::distance::pairwise_distance`` (distance/distance-inl.cuh)
+with the ``DistanceType`` enum of 20 metrics (distance/distance_types.hpp:23-68)
+and per-metric ops in distance/detail/distance_ops/*.cuh. The reference builds
+one tiled register-blocked GEMM-like CUDA kernel parameterized by a distance op
+(detail/pairwise_distance_base.cuh:69-170).
+
+TPU-native design — two engines instead of one kernel template:
+
+- **Expanded (matmul) engine**: metrics whose cross term is an inner product
+  (L2Expanded, Cosine, InnerProduct, Correlation, Hellinger, RusselRao,
+  KLDivergence) ride the MXU via ``dot_general`` with fp32 accumulation, plus a
+  cheap fused epilogue (XLA fuses norm broadcast + clamp/sqrt into the matmul's
+  output). This is where ANN search spends its FLOPs — identical strategy to
+  the reference's cuBLAS/CUTLASS path but chosen per-metric algebraically.
+- **Elementwise (tiled broadcast) engine**: metrics needing a nonlinear
+  function of (x_ik, y_jk) per element (L1, L2Unexpanded, Linf, Canberra, Lp,
+  BrayCurtis, JensenShannon, Hamming). Computed as x-row tiles broadcast
+  against all of y with the reduction fused by XLA; tile rows sized from the
+  Resources workspace budget so the [tile, n, k] intermediate stays in HBM
+  bounds (analog of the reference's shared-memory tiling policy).
+
+Haversine is a dim-2 special case, as in the reference
+(spatial/knn/detail/haversine_distance.cuh).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.utils.shape import cdiv
+
+
+class DistanceType(enum.IntEnum):
+    """Metric enum; values match the reference's (distance_types.hpp:23-68)."""
+
+    L2Expanded = 0
+    L2SqrtExpanded = 1
+    CosineExpanded = 2
+    L1 = 3
+    L2Unexpanded = 4
+    L2SqrtUnexpanded = 5
+    InnerProduct = 6
+    Linf = 7
+    Canberra = 8
+    LpUnexpanded = 9
+    CorrelationExpanded = 10
+    JaccardExpanded = 11  # sparse-only in the reference; dense raises
+    HellingerExpanded = 12
+    Haversine = 13
+    BrayCurtis = 14
+    JensenShannon = 15
+    HammingUnexpanded = 16
+    KLDivergence = 17
+    RusselRaoExpanded = 18
+    DiceExpanded = 19  # sparse-only in the reference; dense raises
+    Precomputed = 100
+
+
+_METRIC_ALIASES = {
+    "euclidean": DistanceType.L2SqrtExpanded,
+    "sqeuclidean": DistanceType.L2Expanded,
+    "l2": DistanceType.L2SqrtExpanded,
+    "l2_expanded": DistanceType.L2Expanded,
+    "l2_unexpanded": DistanceType.L2Unexpanded,
+    "l2sqrt_expanded": DistanceType.L2SqrtExpanded,
+    "l2sqrt_unexpanded": DistanceType.L2SqrtUnexpanded,
+    "cosine": DistanceType.CosineExpanded,
+    "l1": DistanceType.L1,
+    "cityblock": DistanceType.L1,
+    "manhattan": DistanceType.L1,
+    "taxicab": DistanceType.L1,
+    "inner_product": DistanceType.InnerProduct,
+    "chebyshev": DistanceType.Linf,
+    "linf": DistanceType.Linf,
+    "canberra": DistanceType.Canberra,
+    "minkowski": DistanceType.LpUnexpanded,
+    "lp": DistanceType.LpUnexpanded,
+    "correlation": DistanceType.CorrelationExpanded,
+    "hellinger": DistanceType.HellingerExpanded,
+    "haversine": DistanceType.Haversine,
+    "braycurtis": DistanceType.BrayCurtis,
+    "jensenshannon": DistanceType.JensenShannon,
+    "hamming": DistanceType.HammingUnexpanded,
+    "kl_divergence": DistanceType.KLDivergence,
+    "kldivergence": DistanceType.KLDivergence,
+    "russellrao": DistanceType.RusselRaoExpanded,
+    "russelrao": DistanceType.RusselRaoExpanded,
+    "jaccard": DistanceType.JaccardExpanded,
+    "dice": DistanceType.DiceExpanded,
+    "sqeuclidean_unexpanded": DistanceType.L2Unexpanded,
+}
+
+
+def resolve_metric(metric) -> DistanceType:
+    """Accept a DistanceType, its name, or a pylibraft-style string alias."""
+    if isinstance(metric, DistanceType):
+        return metric
+    if isinstance(metric, int):
+        return DistanceType(metric)
+    key = str(metric).lower()
+    if key in _METRIC_ALIASES:
+        return _METRIC_ALIASES[key]
+    try:
+        return DistanceType[metric]
+    except KeyError:
+        raise ValueError(f"unknown metric {metric!r}") from None
+
+
+def is_min_close(metric) -> bool:
+    """True when smaller distance = more similar (reference:
+    distance_types.hpp is_min_close — InnerProduct is the max-close case)."""
+    return resolve_metric(metric) != DistanceType.InnerProduct
+
+
+# =============================================================== matmul engine
+
+
+def _dot(x: jax.Array, y: jax.Array) -> jax.Array:
+    """x @ y.T with fp32 accumulation (MXU-friendly for bf16 inputs).
+
+    fp32 inputs request Precision.HIGHEST: the TPU default lowers fp32 matmul
+    to bf16 passes (~1e-3 error) which breaks exact-kNN rank order; bf16/int8
+    inputs keep the fast path — callers choose speed by choosing the dtype.
+    """
+    prec = jax.lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    return jax.lax.dot_general(
+        x,
+        y,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec,
+    )
+
+
+def row_norms_sq(x: jax.Array) -> jax.Array:
+    """Squared L2 row norms in fp32 (reference: linalg::rowNorm used by the
+    expanded-distance prologue, detail/knn_brute_force.cuh:97-136)."""
+    xf = x.astype(jnp.float32)
+    return jnp.sum(xf * xf, axis=-1)
+
+
+def l2_expanded(
+    x, y, sqrt: bool, x_norms: Optional[jax.Array] = None, y_norms: Optional[jax.Array] = None
+):
+    """dist_ij = ||x_i||² + ||y_j||² − 2·x_i·y_j, clamped ≥ 0 (l2_exp.cuh)."""
+    xn = row_norms_sq(x) if x_norms is None else x_norms
+    yn = row_norms_sq(y) if y_norms is None else y_norms
+    d = xn[:, None] + yn[None, :] - 2.0 * _dot(x, y)
+    d = jnp.maximum(d, 0.0)
+    return jnp.sqrt(d) if sqrt else d
+
+
+def cosine_expanded(x, y, x_norms=None, y_norms=None):
+    """1 − x·y / (||x|| ||y||) (cosine.cuh)."""
+    xn = row_norms_sq(x) if x_norms is None else x_norms
+    yn = row_norms_sq(y) if y_norms is None else y_norms
+    denom = jnp.sqrt(xn[:, None] * yn[None, :])
+    return 1.0 - _dot(x, y) / jnp.maximum(denom, jnp.finfo(jnp.float32).tiny)
+
+
+def inner_product(x, y):
+    return _dot(x, y)
+
+
+def correlation_expanded(x, y):
+    """1 − (k·Σxy − ΣxΣy)/√((k·Σx² − (Σx)²)(k·Σy² − (Σy)²)) (correlation.cuh)."""
+    k = x.shape[-1]
+    xf, yf = x.astype(jnp.float32), y.astype(jnp.float32)
+    sx, sy = jnp.sum(xf, -1), jnp.sum(yf, -1)
+    sx2, sy2 = jnp.sum(xf * xf, -1), jnp.sum(yf * yf, -1)
+    numer = k * _dot(x, y) - sx[:, None] * sy[None, :]
+    q = k * sx2 - sx * sx
+    r = k * sy2 - sy * sy
+    denom = jnp.sqrt(jnp.maximum(q[:, None] * r[None, :], 0.0))
+    return 1.0 - numer / jnp.maximum(denom, jnp.finfo(jnp.float32).tiny)
+
+
+def hellinger_expanded(x, y):
+    """√(1 − Σ√(x·y)) via matmul of √x, √y (hellinger.cuh)."""
+    inner = _dot(jnp.sqrt(jnp.maximum(x.astype(jnp.float32), 0.0)),
+                 jnp.sqrt(jnp.maximum(y.astype(jnp.float32), 0.0)))
+    # Rounding can push the inner product epsilon above 1.
+    return jnp.sqrt(jnp.maximum(1.0 - inner, 0.0))
+
+
+def russelrao_expanded(x, y):
+    """(k − Σ x·y)/k for binary vectors (russel_rao.cuh epilog)."""
+    k = x.shape[-1]
+    return (k - _dot(x, y)) / k
+
+
+def kl_divergence(x, y):
+    """0.5·Σ x·log(x/y) (kl_divergence.cuh), 0-guarded like the device op."""
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    x_logx = jnp.sum(jnp.where(xf > 0, xf * jnp.log(jnp.maximum(xf, 1e-38)), 0.0), -1)
+    log_y = jnp.where(yf > 0, jnp.log(jnp.maximum(yf, 1e-38)), 0.0)
+    cross = _dot(x, log_y)
+    return 0.5 * (x_logx[:, None] - cross)
+
+
+# =========================================================== elementwise engine
+
+
+def _elem_l1(xt, yt):
+    return jnp.sum(jnp.abs(xt - yt), -1)
+
+
+def _elem_l2_unexp(xt, yt):
+    d = xt - yt
+    return jnp.sum(d * d, -1)
+
+
+def _elem_linf(xt, yt):
+    return jnp.max(jnp.abs(xt - yt), -1)
+
+
+def _elem_canberra(xt, yt):
+    num = jnp.abs(xt - yt)
+    den = jnp.abs(xt) + jnp.abs(yt)
+    return jnp.sum(jnp.where(den > 0, num / jnp.maximum(den, 1e-38), 0.0), -1)
+
+
+def _elem_braycurtis(xt, yt):
+    num = jnp.sum(jnp.abs(xt - yt), -1)
+    den = jnp.sum(jnp.abs(xt + yt), -1)
+    return jnp.where(den > 0, num / jnp.maximum(den, 1e-38), 0.0)
+
+
+def _elem_jensen_shannon(xt, yt):
+    m = 0.5 * (xt + yt)
+    log_m = jnp.where(m > 0, jnp.log(jnp.maximum(m, 1e-38)), 0.0)
+    px = jnp.where(xt > 0, xt * (jnp.log(jnp.maximum(xt, 1e-38)) - log_m), 0.0)
+    py = jnp.where(yt > 0, yt * (jnp.log(jnp.maximum(yt, 1e-38)) - log_m), 0.0)
+    return jnp.sqrt(jnp.maximum(0.5 * jnp.sum(px + py, -1), 0.0))
+
+
+def _elem_hamming(xt, yt):
+    k = xt.shape[-1]
+    return jnp.sum((xt != yt).astype(jnp.float32), -1) / k
+
+
+def _make_elem_lp(p: float):
+    def _elem_lp(xt, yt):
+        s = jnp.sum(jnp.abs(xt - yt) ** p, -1)
+        return s ** (1.0 / p)
+
+    return _elem_lp
+
+
+def _choose_tile_rows(m: int, n: int, k: int, budget_bytes: int) -> int:
+    """Rows of x per tile so the [tile, n, k] fp32 broadcast fits the budget."""
+    per_row = max(n * k * 4, 1)
+    tile = max(1, budget_bytes // (4 * per_row))  # 4x headroom for fusion temps
+    tile = min(tile, m, 4096)
+    # Round down to a multiple of 8 (fp32 sublane) when we can afford it.
+    if tile >= 8:
+        tile -= tile % 8
+    return max(tile, 1)
+
+
+def _pairwise_tiled(x: jax.Array, y: jax.Array, elem_fn, tile_rows: int) -> jax.Array:
+    """Apply elem_fn(x_tile[:, None, :], y[None, :, :]) over x-row tiles."""
+    m = x.shape[0]
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    if m <= tile_rows:
+        return elem_fn(xf[:, None, :], yf[None, :, :])
+    n_tiles = cdiv(m, tile_rows)
+    pad = n_tiles * tile_rows - m
+    xp = jnp.pad(xf, ((0, pad), (0, 0)))
+    tiles = xp.reshape(n_tiles, tile_rows, xf.shape[1])
+
+    def body(xt):
+        return elem_fn(xt[:, None, :], yf[None, :, :])
+
+    out = jax.lax.map(body, tiles)
+    return out.reshape(n_tiles * tile_rows, y.shape[0])[:m]
+
+
+def haversine(x, y):
+    """Great-circle distance on (lat, lon) radian pairs
+    (spatial/knn/detail/haversine_distance.cuh)."""
+    if x.shape[-1] != 2 or y.shape[-1] != 2:
+        raise ValueError("haversine requires dim-2 (lat, lon) inputs")
+    lat1, lon1 = x[:, 0:1], x[:, 1:2]
+    lat2, lon2 = y[None, :, 0], y[None, :, 1]
+    sin_dlat = jnp.sin(0.5 * (lat2 - lat1))
+    sin_dlon = jnp.sin(0.5 * (lon2 - lon1))
+    a = sin_dlat**2 + jnp.cos(lat1) * jnp.cos(lat2) * sin_dlon**2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+
+
+_ELEMENTWISE = {
+    DistanceType.L1: _elem_l1,
+    DistanceType.L2Unexpanded: _elem_l2_unexp,
+    DistanceType.L2SqrtUnexpanded: lambda xt, yt: jnp.sqrt(_elem_l2_unexp(xt, yt)),
+    DistanceType.Linf: _elem_linf,
+    DistanceType.Canberra: _elem_canberra,
+    DistanceType.BrayCurtis: _elem_braycurtis,
+    DistanceType.JensenShannon: _elem_jensen_shannon,
+    DistanceType.HammingUnexpanded: _elem_hamming,
+}
+
+
+def _pairwise_impl(x, y, metric: DistanceType, metric_arg: float, budget: int):
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        return l2_expanded(x, y, sqrt=(metric == DistanceType.L2SqrtExpanded))
+    if metric == DistanceType.CosineExpanded:
+        return cosine_expanded(x, y)
+    if metric == DistanceType.InnerProduct:
+        return inner_product(x, y)
+    if metric == DistanceType.CorrelationExpanded:
+        return correlation_expanded(x, y)
+    if metric == DistanceType.HellingerExpanded:
+        return hellinger_expanded(x, y)
+    if metric == DistanceType.RusselRaoExpanded:
+        return russelrao_expanded(x, y)
+    if metric == DistanceType.KLDivergence:
+        return kl_divergence(x, y)
+    if metric == DistanceType.Haversine:
+        return haversine(x, y)
+    if metric == DistanceType.LpUnexpanded:
+        fn = _make_elem_lp(float(metric_arg))
+    elif metric in _ELEMENTWISE:
+        fn = _ELEMENTWISE[metric]
+    else:
+        raise NotImplementedError(
+            f"metric {metric.name} is not supported for dense inputs "
+            "(Jaccard/Dice are sparse-only in the reference as well)"
+        )
+    tile = _choose_tile_rows(x.shape[0], y.shape[0], x.shape[1], budget)
+    return _pairwise_tiled(x, y, fn, tile)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "budget"))
+def _pairwise_jit(x, y, metric, metric_arg, budget):
+    return _pairwise_impl(x, y, metric, metric_arg, budget)
+
+
+def pairwise_distance(
+    x,
+    y,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """All-pairs distance matrix [m, n] between rows of x [m, k] and y [n, k].
+
+    API analog of ``raft::distance::pairwise_distance`` (distance-inl.cuh) /
+    ``pylibraft.distance.pairwise_distance``. ``metric_arg`` is the Minkowski
+    p for ``LpUnexpanded``.
+    """
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim != 2 or y.ndim != 2 or x.shape[1] != y.shape[1]:
+        raise ValueError(f"bad shapes {x.shape} vs {y.shape}: need [m,k],[n,k]")
+    m = resolve_metric(metric)
+    return _pairwise_jit(x, y, m, float(metric_arg), res.workspace_limit_bytes)

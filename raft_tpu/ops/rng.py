@@ -1,0 +1,218 @@
+"""RNG utilities and test-data generators.
+
+Reference: ``raft::random`` — ``RngState`` (random/rng_state.hpp), device
+generators (random/detail/rng_device.cuh), distributions, ``permute``,
+``sample_without_replacement``, ``make_blobs`` (random/make_blobs.cuh),
+``make_regression``, ``rmat_rectangular_generator`` (random/rmat_*.cuh).
+
+TPU-native design: jax.random's counter-based threefry keys replace
+Philox/PCG — same splittable-stream semantics ``RngState{seed, subsequence}``
+provides. Generators are pure functions of a key; ``RngState`` here is a thin
+seed+subsequence wrapper for pylibraft API parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class RngState:
+    """seed + subsequence (reference: random/rng_state.hpp)."""
+
+    seed: int = 0
+    subsequence: int = 0
+
+    def key(self) -> jax.Array:
+        base = jax.random.key(self.seed)
+        if self.subsequence:
+            base = jax.random.fold_in(base, self.subsequence)
+        return base
+
+    def advance(self, n: int = 1) -> "RngState":
+        return RngState(self.seed, self.subsequence + n)
+
+
+def _as_key(key_or_state) -> jax.Array:
+    if isinstance(key_or_state, RngState):
+        return key_or_state.key()
+    if isinstance(key_or_state, int):
+        return jax.random.key(key_or_state)
+    return key_or_state
+
+
+def uniform(key, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_as_key(key), shape, dtype, low, high)
+
+
+def normal(key, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_as_key(key), shape, dtype)
+
+
+def laplace(key, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return jax.random.laplace(_as_key(key), shape, dtype) * scale + mu
+
+
+def gumbel(key, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return jax.random.gumbel(_as_key(key), shape, dtype) * beta + mu
+
+
+def lognormal(key, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(key, shape, mu, sigma, dtype))
+
+def exponential(key, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_as_key(key), shape, dtype) / lam
+
+
+def rayleigh(key, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_as_key(key), shape, dtype, jnp.finfo(dtype).tiny, 1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def bernoulli(key, shape, p=0.5):
+    return jax.random.bernoulli(_as_key(key), p, shape)
+
+
+def permute(key, n: int) -> jax.Array:
+    """Random permutation of [0, n) (reference: random/permute.cuh)."""
+    return jax.random.permutation(_as_key(key), n)
+
+
+def sample_without_replacement(key, n_population: int, n_samples: int) -> jax.Array:
+    """Uniform sample of ``n_samples`` distinct indices from [0, n_population)
+    (reference: random/sample_without_replacement.cuh)."""
+    if n_samples > n_population:
+        raise ValueError("n_samples > n_population")
+    return jax.random.choice(
+        _as_key(key), n_population, shape=(n_samples,), replace=False
+    )
+
+
+def subsample_rows(key, x: jax.Array, n_samples: int) -> jax.Array:
+    """Gather a uniform row subsample (the trainset-subsampling step of IVF
+    builds — reference: neighbors/detail/ivf_pq_build.cuh:1759)."""
+    if n_samples >= x.shape[0]:
+        return x
+    idx = sample_without_replacement(key, x.shape[0], n_samples)
+    return x[jnp.sort(idx)]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_rows", "n_cols", "n_clusters", "dtype", "shuffle")
+)
+def _make_blobs_jit(key, n_rows, n_cols, n_clusters, cluster_std, center_box_min,
+                    center_box_max, dtype, shuffle):
+    k_centers, k_noise, k_labels, k_shuffle = jax.random.split(key, 4)
+    centers = jax.random.uniform(
+        k_centers, (n_clusters, n_cols), jnp.float32, center_box_min, center_box_max
+    )
+    labels = jax.random.randint(k_labels, (n_rows,), 0, n_clusters)
+    noise = jax.random.normal(k_noise, (n_rows, n_cols), jnp.float32) * cluster_std
+    x = centers[labels] + noise
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_rows)
+        x, labels = x[perm], labels[perm]
+    return x.astype(dtype), labels.astype(jnp.int32), centers.astype(dtype)
+
+
+def make_blobs(
+    key,
+    n_rows: int,
+    n_cols: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    center_box=(-10.0, 10.0),
+    dtype=jnp.float32,
+    shuffle: bool = True,
+    return_centers: bool = False,
+):
+    """Isotropic Gaussian blobs (reference: random/make_blobs.cuh) — the
+    standard test-data generator for clustering/ANN tests."""
+    x, labels, centers = _make_blobs_jit(
+        _as_key(key), int(n_rows), int(n_cols), int(n_clusters), float(cluster_std),
+        float(center_box[0]), float(center_box[1]), jnp.dtype(dtype), bool(shuffle),
+    )
+    if return_centers:
+        return x, labels, centers
+    return x, labels
+
+
+def make_regression(
+    key,
+    n_rows: int,
+    n_cols: int,
+    n_informative: Optional[int] = None,
+    noise: float = 0.0,
+    bias: float = 0.0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Linear-model regression data (reference: random/make_regression.cuh).
+    Returns (x, y, coef)."""
+    n_informative = n_cols if n_informative is None else n_informative
+    kx, kc, kn = jax.random.split(_as_key(key), 3)
+    x = jax.random.normal(kx, (n_rows, n_cols), jnp.float32)
+    coef = jnp.zeros((n_cols,), jnp.float32)
+    coef = coef.at[:n_informative].set(
+        100.0 * jax.random.uniform(kc, (n_informative,), jnp.float32)
+    )
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, (n_rows,), jnp.float32)
+    return x.astype(dtype), y.astype(dtype), coef.astype(dtype)
+
+
+def rmat(
+    key,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    theta=None,
+) -> jax.Array:
+    """R-MAT rectangular graph generator (reference:
+    random/rmat_rectangular_generator.cuh; bound as pylibraft.random.rmat).
+
+    Returns an [n_edges, 2] int32 array of (src, dst) edges. ``theta`` is the
+    (a, b, c, d) quadrant-probability tuple, per-level or scalar; default the
+    common (0.57, 0.19, 0.19, 0.05).
+    """
+    if theta is None:
+        theta = (0.57, 0.19, 0.19, 0.05)
+    theta = jnp.asarray(theta, jnp.float32).reshape(-1, 4)
+    max_scale = max(r_scale, c_scale)
+    if theta.shape[0] == 1:
+        theta = jnp.tile(theta, (max_scale, 1))
+    # Per level, choose one of 4 quadrants for every edge.
+    probs = theta / jnp.sum(theta, axis=1, keepdims=True)
+    keys = jax.random.split(_as_key(key), max_scale)
+
+    def level(carry, inp):
+        src, dst = carry
+        lvl_key, p, bit_r, bit_c = inp
+        q = jax.random.categorical(lvl_key, jnp.log(p)[None, :], shape=(n_edges,))
+        src = src | jnp.where(bit_r >= 0, ((q >> 1) & 1) << jnp.maximum(bit_r, 0), 0)
+        dst = dst | jnp.where(bit_c >= 0, (q & 1) << jnp.maximum(bit_c, 0), 0)
+        return (src, dst), None
+
+    src = jnp.zeros((n_edges,), jnp.int32)
+    dst = jnp.zeros((n_edges,), jnp.int32)
+    # bit index for each level; levels beyond a side's scale don't set bits.
+    bits_r = jnp.arange(max_scale - 1, -1, -1, dtype=jnp.int32)
+    bits_r = jnp.where(bits_r < r_scale, bits_r, -1)
+    bits_c = jnp.arange(max_scale - 1, -1, -1, dtype=jnp.int32)
+    bits_c = jnp.where(bits_c < c_scale, bits_c, -1)
+    (src, dst), _ = jax.lax.scan(level, (src, dst), (keys, probs, bits_r, bits_c))
+    return jnp.stack([src, dst], axis=1)
+
+
+def multi_variable_gaussian(key, mean: jax.Array, cov: jax.Array, n_samples: int):
+    """Samples from N(mean, cov) via Cholesky (reference:
+    random/multi_variable_gaussian.cuh)."""
+    dim = mean.shape[0]
+    chol = jnp.linalg.cholesky(cov + 1e-6 * jnp.eye(dim, dtype=cov.dtype))
+    z = jax.random.normal(_as_key(key), (n_samples, dim), mean.dtype)
+    return mean[None, :] + z @ chol.T

@@ -1,0 +1,107 @@
+"""Batched top-k selection — THE key primitive for all ANN search.
+
+Reference: ``raft::matrix::select_k`` (matrix/select_k.cuh) with two kernel
+families — radix "AIR top-k" (detail/select_radix.cuh:54-67) and warpsort
+per-warp priority queues (detail/select_warpsort.cuh:40-75) — picked by
+``choose_select_k_algorithm`` (detail/select_k-inl.cuh:48).
+
+TPU-native design: ``jax.lax.top_k`` (an XLA-native O(len·log len / lane)
+sort-based selection that TPUs lower well) is the baseline algorithm; a
+two-phase tiled variant (per-tile top-k then merge) bounds the working set for
+very wide rows, mirroring how warpsort splits into per-warp queues + a final
+merge. Min-selection is negation (distances are finite); NaN/Inf payloads are
+pushed to the end like the reference's null-padding convention.
+
+``SelectAlgo`` mirrors matrix/select_k_types.hpp:36-78 in spirit: AUTO picks
+between the direct and two-phase paths by row width.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.shape import cdiv
+
+
+class SelectAlgo(enum.Enum):
+    AUTO = "auto"
+    DIRECT = "direct"  # single lax.top_k over the full row
+    TWO_PHASE = "two_phase"  # per-tile top-k, then merge (wide rows)
+
+
+# Rows wider than this use the two-phase path under AUTO; beyond ~64k lanes a
+# single lax.top_k's full-row sort wastes HBM bandwidth vs tiled selection.
+_TWO_PHASE_THRESHOLD = 65536
+_TILE = 16384
+
+
+def _direct(values: jax.Array, k: int, select_min: bool):
+    v = -values if select_min else values
+    top_v, top_i = jax.lax.top_k(v, k)
+    return (-top_v if select_min else top_v), top_i
+
+
+def _two_phase(values: jax.Array, k: int, select_min: bool):
+    batch, n = values.shape
+    tile = max(_TILE, k)
+    n_tiles = cdiv(n, tile)
+    pad = n_tiles * tile - n
+    v = -values if select_min else values
+    if pad:
+        v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+    vt = v.reshape(batch, n_tiles, tile)
+    # Phase 1: top-k within each tile (vmapped over tiles).
+    tv, ti = jax.lax.top_k(vt, min(k, tile))
+    ti = ti + (jnp.arange(n_tiles, dtype=ti.dtype) * tile)[None, :, None]
+    # Phase 2: merge the n_tiles*k survivors.
+    tv = tv.reshape(batch, -1)
+    ti = ti.reshape(batch, -1)
+    mv, mi = jax.lax.top_k(tv, k)
+    out_i = jnp.take_along_axis(ti, mi, axis=1)
+    return (-mv if select_min else mv), out_i
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "algo"))
+def _select_k_jit(values, k, select_min, algo):
+    if algo == SelectAlgo.AUTO:
+        algo = (
+            SelectAlgo.TWO_PHASE
+            if values.shape[-1] >= _TWO_PHASE_THRESHOLD and k * 4 <= values.shape[-1]
+            else SelectAlgo.DIRECT
+        )
+    if algo == SelectAlgo.DIRECT:
+        return _direct(values, k, select_min)
+    return _two_phase(values, k, select_min)
+
+
+def select_k(
+    values,
+    k: int,
+    select_min: bool = True,
+    indices: Optional[jax.Array] = None,
+    algo: SelectAlgo = SelectAlgo.AUTO,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select k smallest (or largest) per row of ``values`` [batch, len].
+
+    Returns (selected_values [batch, k], selected_indices [batch, k]).
+    When ``indices`` is given, returned indices are gathered from it —
+    the source-index relabeling the reference supports via its in_idx arg.
+    """
+    values = jnp.asarray(values)
+    if values.ndim == 1:
+        v, i = select_k(values[None], k, select_min, None, algo)
+        v, i = v[0], i[0]
+        if indices is not None:
+            i = jnp.asarray(indices)[i]
+        return v, i
+    if k > values.shape[-1]:
+        raise ValueError(f"k={k} > row length {values.shape[-1]}")
+    out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo)
+    if indices is not None:
+        out_i = jnp.take_along_axis(jnp.asarray(indices), out_i, axis=1)
+    return out_v, out_i

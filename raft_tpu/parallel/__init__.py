@@ -1,0 +1,4 @@
+"""Distributed layer (SURVEY.md §2.8): comms facade over XLA mesh
+collectives (ICI/DCN), multi-host bootstrap, sharded index build/search."""
+
+__all__ = []

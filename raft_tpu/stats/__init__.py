@@ -1,0 +1,47 @@
+"""Stats layer (SURVEY.md §2.3 'stats'): summary statistics, clustering
+quality metrics, model metrics, and neighborhood_recall — the ANN-recall
+metric that gates every index test/benchmark."""
+
+from raft_tpu.stats.recall import neighborhood_recall
+from raft_tpu.stats.basic import (
+    mean,
+    stddev,
+    var,
+    cov,
+    histogram,
+    minmax,
+    accuracy_score,
+    r2_score,
+    mean_squared_error,
+)
+from raft_tpu.stats.cluster_metrics import (
+    silhouette_score,
+    adjusted_rand_index,
+    rand_index,
+    mutual_info_score,
+    entropy,
+    homogeneity_score,
+    completeness_score,
+    v_measure,
+)
+
+__all__ = [
+    "neighborhood_recall",
+    "mean",
+    "stddev",
+    "var",
+    "cov",
+    "histogram",
+    "minmax",
+    "accuracy_score",
+    "r2_score",
+    "mean_squared_error",
+    "silhouette_score",
+    "adjusted_rand_index",
+    "rand_index",
+    "mutual_info_score",
+    "entropy",
+    "homogeneity_score",
+    "completeness_score",
+    "v_measure",
+]

@@ -1,0 +1,68 @@
+"""Summary statistics + model metrics (reference: raft::stats — mean.cuh,
+stddev.cuh, cov.cuh, histogram.cuh, minmax.cuh, accuracy.cuh, r2_score.cuh,
+regression_metrics.cuh)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mean(x, axis=0):
+    return jnp.mean(jnp.asarray(x, jnp.float32), axis=axis)
+
+
+def var(x, axis=0, sample: bool = False):
+    return jnp.var(jnp.asarray(x, jnp.float32), axis=axis, ddof=1 if sample else 0)
+
+
+def stddev(x, axis=0, sample: bool = False):
+    return jnp.std(jnp.asarray(x, jnp.float32), axis=axis, ddof=1 if sample else 0)
+
+
+def cov(x, sample: bool = True):
+    """Column covariance matrix of x [n, d] (reference: stats/cov.cuh)."""
+    xf = jnp.asarray(x, jnp.float32)
+    xc = xf - jnp.mean(xf, axis=0, keepdims=True)
+    denom = xf.shape[0] - 1 if sample else xf.shape[0]
+    return (xc.T @ xc) / denom
+
+
+def histogram(x, n_bins: int, lo=None, hi=None) -> Tuple[jax.Array, jax.Array]:
+    """Fixed-width histogram (reference: stats/histogram.cuh). Returns
+    (counts, edges)."""
+    xf = jnp.asarray(x, jnp.float32).reshape(-1)
+    lo = jnp.min(xf) if lo is None else lo
+    hi = jnp.max(xf) if hi is None else hi
+    edges = jnp.linspace(lo, hi, n_bins + 1)
+    width = jnp.maximum((hi - lo) / n_bins, 1e-38)
+    idx = jnp.clip(((xf - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    counts = jnp.zeros((n_bins,), jnp.int32).at[idx].add(1)
+    return counts, edges
+
+
+def minmax(x, axis=0):
+    xf = jnp.asarray(x)
+    return jnp.min(xf, axis=axis), jnp.max(xf, axis=axis)
+
+
+def accuracy_score(predictions, labels):
+    p = jnp.asarray(predictions)
+    l = jnp.asarray(labels)
+    return jnp.mean((p == l).astype(jnp.float32))
+
+
+def r2_score(y_true, y_pred):
+    yt = jnp.asarray(y_true, jnp.float32)
+    yp = jnp.asarray(y_pred, jnp.float32)
+    ss_res = jnp.sum((yt - yp) ** 2)
+    ss_tot = jnp.sum((yt - jnp.mean(yt)) ** 2)
+    return 1.0 - ss_res / jnp.maximum(ss_tot, 1e-38)
+
+
+def mean_squared_error(y_true, y_pred):
+    yt = jnp.asarray(y_true, jnp.float32)
+    yp = jnp.asarray(y_pred, jnp.float32)
+    return jnp.mean((yt - yp) ** 2)

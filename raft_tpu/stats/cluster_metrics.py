@@ -1,0 +1,106 @@
+"""Clustering quality metrics (reference: raft::stats — silhouette_score.cuh,
+adjusted_rand_index.cuh, rand_index.cuh, mutual_info_score.cuh, entropy.cuh,
+homogeneity_score.cuh, completeness_score.cuh, v_measure.cuh).
+
+All are contingency-table computations — pure XLA scatter/reduce territory.
+Label arrays are int32 in [0, n_classes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.distance import pairwise_distance
+
+
+def _contingency(a, b, n_a: int, n_b: int) -> jax.Array:
+    a = jnp.asarray(a, jnp.int32)
+    b = jnp.asarray(b, jnp.int32)
+    flat = a * n_b + b
+    counts = jnp.zeros((n_a * n_b,), jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
+    return counts.at[flat].add(1.0).reshape(n_a, n_b)
+
+
+def rand_index(a, b, n_classes_a: int, n_classes_b: int):
+    c = _contingency(a, b, n_classes_a, n_classes_b)
+    n = jnp.sum(c)
+    sum_all = jnp.sum(c * (c - 1)) / 2
+    sum_rows = jnp.sum(jnp.sum(c, 1) * (jnp.sum(c, 1) - 1)) / 2
+    sum_cols = jnp.sum(jnp.sum(c, 0) * (jnp.sum(c, 0) - 1)) / 2
+    total = n * (n - 1) / 2
+    return (total + 2 * sum_all - sum_rows - sum_cols) / jnp.maximum(total, 1.0)
+
+
+def adjusted_rand_index(a, b, n_classes_a: int, n_classes_b: int):
+    c = _contingency(a, b, n_classes_a, n_classes_b)
+    n = jnp.sum(c)
+    sum_comb = jnp.sum(c * (c - 1)) / 2
+    comb_a = jnp.sum(jnp.sum(c, 1) * (jnp.sum(c, 1) - 1)) / 2
+    comb_b = jnp.sum(jnp.sum(c, 0) * (jnp.sum(c, 0) - 1)) / 2
+    total = n * (n - 1) / 2
+    expected = comb_a * comb_b / jnp.maximum(total, 1.0)
+    max_idx = 0.5 * (comb_a + comb_b)
+    return (sum_comb - expected) / jnp.maximum(max_idx - expected, 1e-38)
+
+
+def entropy(labels, n_classes: int):
+    l = jnp.asarray(labels, jnp.int32)
+    counts = jnp.zeros((n_classes,), jnp.float32).at[l].add(1.0)
+    p = counts / jnp.maximum(jnp.sum(counts), 1.0)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, 1e-38)), 0.0))
+
+
+def mutual_info_score(a, b, n_classes_a: int, n_classes_b: int):
+    c = _contingency(a, b, n_classes_a, n_classes_b)
+    n = jnp.maximum(jnp.sum(c), 1.0)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    ratio = pij / jnp.maximum(pi * pj, 1e-38)
+    return jnp.sum(jnp.where(pij > 0, pij * jnp.log(jnp.maximum(ratio, 1e-38)), 0.0))
+
+
+def homogeneity_score(truth, pred, n_classes_t: int, n_classes_p: int):
+    mi = mutual_info_score(truth, pred, n_classes_t, n_classes_p)
+    h = entropy(truth, n_classes_t)
+    return jnp.where(h > 0, mi / jnp.maximum(h, 1e-38), 1.0)
+
+
+def completeness_score(truth, pred, n_classes_t: int, n_classes_p: int):
+    return homogeneity_score(pred, truth, n_classes_p, n_classes_t)
+
+
+def v_measure(truth, pred, n_classes_t: int, n_classes_p: int, beta: float = 1.0):
+    h = homogeneity_score(truth, pred, n_classes_t, n_classes_p)
+    c = completeness_score(truth, pred, n_classes_t, n_classes_p)
+    return (1 + beta) * h * c / jnp.maximum(beta * h + c, 1e-38)
+
+
+def silhouette_score(x, labels, n_classes: int, metric="l2_expanded"):
+    """Mean silhouette coefficient (reference: stats/silhouette_score.cuh).
+
+    O(n²) pairwise distances — intended for test-sized inputs, like the
+    reference's batched variant is for larger ones.
+    """
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels, jnp.int32)
+    n = x.shape[0]
+    d = pairwise_distance(x, x, metric=metric)
+    onehot = jax.nn.one_hot(labels, n_classes, dtype=jnp.float32)  # [n, c]
+    cluster_sizes = jnp.sum(onehot, axis=0)  # [c]
+    # Sum of distances from each point to each cluster: [n, c]
+    sums = d @ onehot
+    own = labels
+    own_size = cluster_sizes[own]
+    # a: mean intra-cluster distance excluding self (distance to self is 0).
+    a = jnp.where(own_size > 1,
+                  jnp.take_along_axis(sums, own[:, None], 1)[:, 0] / jnp.maximum(own_size - 1, 1),
+                  0.0)
+    # b: min over other clusters of mean distance.
+    means = sums / jnp.maximum(cluster_sizes[None, :], 1.0)
+    means = jnp.where(jnp.arange(n_classes)[None, :] == own[:, None], jnp.inf, means)
+    means = jnp.where(cluster_sizes[None, :] == 0, jnp.inf, means)
+    b = jnp.min(means, axis=1)
+    s = jnp.where(own_size > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-38), 0.0)
+    return jnp.mean(s)

@@ -1,0 +1,40 @@
+"""ANN recall metric.
+
+Reference: ``raft::stats::neighborhood_recall`` (stats/neighborhood_recall.cuh
+:86-120) — fraction of predicted neighbor indices present in the ground-truth
+lists, optionally accepting distance ties within an epsilon.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def neighborhood_recall(
+    indices,
+    ref_indices,
+    distances: Optional[jax.Array] = None,
+    ref_distances: Optional[jax.Array] = None,
+    eps: float = 0.001,
+) -> jax.Array:
+    """Mean recall of ``indices`` [n, k] vs ``ref_indices`` [n, k].
+
+    A prediction counts if its index appears in the reference row, or (when
+    both distance arrays are given) if its distance matches some reference
+    distance within ``eps`` — the tie-acceptance rule of the reference metric.
+    """
+    indices = jnp.asarray(indices)
+    ref_indices = jnp.asarray(ref_indices)
+    match = jnp.any(indices[:, :, None] == ref_indices[:, None, :], axis=-1)
+    if distances is not None and ref_distances is not None:
+        distances = jnp.asarray(distances)
+        ref_distances = jnp.asarray(ref_distances)
+        tie = jnp.any(
+            jnp.abs(distances[:, :, None] - ref_distances[:, None, :]) <= eps,
+            axis=-1,
+        )
+        match = match | tie
+    return jnp.mean(match.astype(jnp.float32))

@@ -1,0 +1,7 @@
+"""Utility layer (SURVEY.md §2.2): padding/tiling arithmetic and small
+helpers. Most of the reference's device utilities (warp primitives, vectorized
+loads, atomics) disappear into XLA; what remains is shape/layout math."""
+
+from raft_tpu.utils.shape import round_up_to, pad_rows, cdiv
+
+__all__ = ["round_up_to", "pad_rows", "cdiv"]

@@ -1,0 +1,26 @@
+"""Shape/tile arithmetic (TPU analog of util/pow2_utils.cuh): lane-aligned
+padding helpers used by the IVF list layouts and Pallas kernels."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+LANES = 128  # TPU lane count: last-dim tiling unit
+SUBLANES_F32 = 8
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up_to(n: int, multiple: int) -> int:
+    return cdiv(n, multiple) * multiple
+
+
+def pad_rows(x, target_rows: int, fill=0):
+    """Pad a [n, ...] array to [target_rows, ...]."""
+    n = x.shape[0]
+    if n == target_rows:
+        return x
+    pad_widths = [(0, target_rows - n)] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, pad_widths, constant_values=fill)

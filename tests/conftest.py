@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding /
+comms tests run anywhere (the driver separately dry-runs the multi-chip path
+via __graft_entry__.dryrun_multichip). Must set flags before jax imports."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture()
+def res():
+    from raft_tpu import Resources
+
+    return Resources(seed=42)

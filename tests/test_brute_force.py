@@ -1,0 +1,81 @@
+"""Brute-force kNN tests: exact agreement with a numpy oracle, tiling paths,
+serialization round-trip (reference pattern: cpp/test/neighbors/
+knn_brute_force.cu + ann fixtures' serialize round-trips)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu import Resources
+from raft_tpu.neighbors import brute_force
+from raft_tpu.stats import neighborhood_recall
+
+
+def _numpy_knn(queries, dataset, k, metric="sqeuclidean"):
+    import scipy.spatial.distance as sd
+
+    d = sd.cdist(queries, dataset, metric)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, 1), idx
+
+
+@pytest.mark.parametrize("metric,scipy_metric", [
+    ("sqeuclidean", "sqeuclidean"),
+    ("euclidean", "euclidean"),
+    ("cosine", "cosine"),
+])
+def test_exact_recall(metric, scipy_metric, rng):
+    db = rng.standard_normal((500, 32)).astype(np.float32)
+    q = rng.standard_normal((40, 32)).astype(np.float32)
+    dist, idx = brute_force.knn(q, db, k=10, metric=metric)
+    want_dist, want_idx = _numpy_knn(q, db, 10, scipy_metric)
+    # tie-tolerant recall: fp32 near-ties can flip ranks at the k boundary
+    recall = float(
+        neighborhood_recall(
+            np.asarray(idx), want_idx, np.asarray(dist), want_dist, eps=1e-4
+        )
+    )
+    assert recall >= 0.999
+
+
+def test_inner_product_maximizes(rng):
+    db = rng.standard_normal((200, 16)).astype(np.float32)
+    q = rng.standard_normal((10, 16)).astype(np.float32)
+    dist, idx = brute_force.knn(q, db, k=5, metric="inner_product")
+    ip = q @ db.T
+    want = np.argsort(-ip, axis=1)[:, :5]
+    assert float(neighborhood_recall(np.asarray(idx), want)) >= 0.999
+    # returned "distances" are the (descending) inner products
+    assert np.all(np.diff(np.asarray(dist), axis=1) <= 1e-5)
+
+
+def test_tiled_matches_untiled(rng):
+    db = rng.standard_normal((1000, 24)).astype(np.float32)
+    q = rng.standard_normal((30, 24)).astype(np.float32)
+    small = Resources(workspace_limit_bytes=1_000_000)
+    d1, i1 = brute_force.knn(q, db, k=7, res=small)
+    d2, i2 = brute_force.knn(q, db, k=7)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-4, atol=1e-5)
+    assert float(neighborhood_recall(np.asarray(i1), np.asarray(i2))) >= 0.999
+
+
+def test_k_clamped_to_size(rng):
+    db = rng.standard_normal((5, 8)).astype(np.float32)
+    q = rng.standard_normal((3, 8)).astype(np.float32)
+    d, i = brute_force.search(brute_force.build(db), q, k=10)
+    assert d.shape == (3, 5)
+
+
+def test_serialize_roundtrip(rng):
+    db = rng.standard_normal((100, 16)).astype(np.float32)
+    q = rng.standard_normal((10, 16)).astype(np.float32)
+    idx = brute_force.build(db, metric="euclidean")
+    buf = io.BytesIO()
+    brute_force.serialize(idx, buf)
+    buf.seek(0)
+    idx2 = brute_force.deserialize(buf)
+    d1, i1 = brute_force.search(idx, q, 5)
+    d2, i2 = brute_force.search(idx2, q, 5)
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

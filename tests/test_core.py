@@ -1,0 +1,188 @@
+"""Core-layer tests: Resources, bitset, serialization, fused L2 NN, RNG,
+stats."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu import Resources
+from raft_tpu.core import Bitset, serialize as ser
+from raft_tpu.ops import fused_l2_nn_argmin
+from raft_tpu.ops import rng as rrng
+from raft_tpu import stats
+
+
+class TestResources:
+    def test_keys_unique(self):
+        res = Resources(seed=1)
+        import jax
+        k1, k2 = res.next_key(), res.next_key()
+        assert not np.array_equal(
+            np.asarray(jax.random.key_data(k1)), np.asarray(jax.random.key_data(k2))
+        )
+
+    def test_custom_slot(self):
+        res = Resources()
+        calls = []
+        res.get_resource("x", lambda: calls.append(1) or 42)
+        v = res.get_resource("x", lambda: calls.append(1) or 43)
+        assert v == 42 and len(calls) == 1
+
+    def test_comms_unset_raises(self):
+        with pytest.raises(RuntimeError):
+            Resources().comms
+
+
+class TestBitset:
+    def test_create_default_all_set(self):
+        b = Bitset.create(70)
+        assert int(b.count()) == 70
+
+    def test_set_test_flip(self):
+        b = Bitset.create(100, default=False)
+        b = b.set(np.array([0, 31, 32, 99, 99]))
+        assert int(b.count()) == 4
+        got = np.asarray(b.test(np.array([0, 1, 31, 32, 99])))
+        np.testing.assert_array_equal(got, [True, False, True, True, True])
+        f = b.flip()
+        assert int(f.count()) == 96
+
+    def test_clear(self):
+        b = Bitset.create(64).set(np.array([3, 5]), value=False)
+        assert int(b.count()) == 62
+
+    def test_mask_roundtrip(self, rng):
+        mask = rng.random(130) > 0.5
+        b = Bitset.from_mask(mask)
+        np.testing.assert_array_equal(np.asarray(b.to_mask()), mask)
+        assert int(b.count()) == mask.sum()
+
+
+class TestSerialize:
+    def test_scalar_array_roundtrip(self, rng):
+        buf = io.BytesIO()
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        ser.serialize_scalar(buf, 42, "<i8")
+        ser.serialize_array(buf, a)
+        ser.serialize_scalar(buf, 2.5, "<f4")
+        buf.seek(0)
+        assert ser.deserialize_scalar(buf) == 42
+        np.testing.assert_array_equal(ser.deserialize_array(buf), a)
+        assert ser.deserialize_scalar(buf) == 2.5
+
+    def test_npy_compatible(self, rng):
+        """Arrays are raw .npy records — numpy can read them directly,
+        matching the reference's interchange guarantee (core/serialize.hpp)."""
+        buf = io.BytesIO()
+        a = (rng.standard_normal((5, 2)) * 10).astype(np.int32)
+        ser.serialize_array(buf, a)
+        buf.seek(0)
+        np.testing.assert_array_equal(np.load(buf), a)
+
+    def test_kind_mismatch(self):
+        buf = io.BytesIO()
+        ser.IndexWriter(buf, "ivf_flat", 1)
+        buf.seek(0)
+        with pytest.raises(ValueError, match="kind mismatch"):
+            ser.IndexReader(buf, "ivf_pq", 1)
+
+
+class TestFusedL2NN:
+    def test_matches_naive(self, rng):
+        x = rng.standard_normal((300, 17)).astype(np.float32)
+        y = rng.standard_normal((37, 17)).astype(np.float32)
+        val, idx = fused_l2_nn_argmin(x, y)
+        d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(1))
+        np.testing.assert_allclose(np.asarray(val), d.min(1), rtol=1e-3, atol=1e-3)
+
+    def test_tiled(self, rng):
+        x = rng.standard_normal((1000, 8)).astype(np.float32)
+        y = rng.standard_normal((16, 8)).astype(np.float32)
+        small = Resources(workspace_limit_bytes=100_000)
+        val, idx = fused_l2_nn_argmin(x, y, sqrt=True, res=small)
+        d = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_array_equal(np.asarray(idx), d.argmin(1))
+        np.testing.assert_allclose(np.asarray(val), d.min(1), rtol=1e-3, atol=1e-3)
+
+
+class TestRng:
+    def test_make_blobs_separable(self):
+        x, labels, centers = rrng.make_blobs(
+            0, 1000, 8, n_clusters=4, cluster_std=0.1, return_centers=True
+        )
+        x, labels, centers = map(np.asarray, (x, labels, centers))
+        # every point is closest to its own center
+        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        assert (d.argmin(1) == labels).mean() > 0.999
+
+    def test_sample_without_replacement(self):
+        s = np.asarray(rrng.sample_without_replacement(0, 100, 50))
+        assert len(np.unique(s)) == 50 and s.max() < 100
+
+    def test_permute(self):
+        p = np.asarray(rrng.permute(0, 64))
+        assert sorted(p) == list(range(64))
+
+    def test_rng_state(self):
+        import jax
+        k1 = rrng.RngState(1, 0).key()
+        k2 = rrng.RngState(1, 1).key()
+        assert not np.array_equal(
+            np.asarray(jax.random.key_data(k1)), np.asarray(jax.random.key_data(k2))
+        )
+
+    def test_rmat_shape(self):
+        edges = np.asarray(rrng.rmat(0, r_scale=4, c_scale=3, n_edges=100))
+        assert edges.shape == (100, 2)
+        assert edges[:, 0].max() < 16 and edges[:, 1].max() < 8
+        assert edges.min() >= 0
+
+    def test_make_regression(self):
+        x, y, coef = rrng.make_regression(0, 200, 5, noise=0.0)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x) @ np.asarray(coef), rtol=1e-3, atol=1e-2
+        )
+
+
+class TestStats:
+    def test_neighborhood_recall(self):
+        got = np.array([[1, 2, 3], [4, 5, 6]])
+        ref = np.array([[1, 2, 9], [4, 5, 6]])
+        assert float(stats.neighborhood_recall(got, ref)) == pytest.approx(5 / 6)
+
+    def test_vs_sklearn_cluster_metrics(self, rng):
+        from sklearn import metrics as skm
+
+        a = rng.integers(0, 4, 200)
+        b = rng.integers(0, 3, 200)
+        assert float(stats.adjusted_rand_index(a, b, 4, 3)) == pytest.approx(
+            skm.adjusted_rand_score(a, b), abs=1e-4
+        )
+        assert float(stats.mutual_info_score(a, b, 4, 3)) == pytest.approx(
+            skm.mutual_info_score(a, b), abs=1e-4
+        )
+        assert float(stats.v_measure(a, b, 4, 3)) == pytest.approx(
+            skm.v_measure_score(a, b), abs=1e-4
+        )
+
+    def test_silhouette_vs_sklearn(self, rng):
+        from sklearn import metrics as skm
+
+        x = rng.standard_normal((100, 4)).astype(np.float32)
+        labels = rng.integers(0, 3, 100)
+        got = float(stats.silhouette_score(x, labels, 3, metric="l2sqrt_expanded"))
+        want = skm.silhouette_score(x, labels, metric="euclidean")
+        assert got == pytest.approx(want, abs=1e-3)
+
+    def test_histogram(self, rng):
+        x = rng.standard_normal(1000).astype(np.float32)
+        counts, edges = stats.histogram(x, 10)
+        want, _ = np.histogram(x, bins=np.asarray(edges))
+        assert int(np.asarray(counts).sum()) == 1000
+        np.testing.assert_allclose(np.asarray(counts), want, atol=1)
+
+    def test_r2(self, rng):
+        y = rng.standard_normal(50)
+        assert float(stats.r2_score(y, y)) == pytest.approx(1.0)

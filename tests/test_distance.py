@@ -1,0 +1,139 @@
+"""Pairwise-distance tests vs scipy (the reference's Python tests compare
+against scipy/sklearn the same way — python/pylibraft/pylibraft/test/
+test_distance.py)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as scipy_dist
+
+from raft_tpu.ops import DistanceType, pairwise_distance, row_norms_sq
+from raft_tpu.ops.distance import resolve_metric, is_min_close
+
+SCIPY_NAMES = {
+    DistanceType.L2SqrtExpanded: "euclidean",
+    DistanceType.L2Expanded: "sqeuclidean",
+    DistanceType.L2SqrtUnexpanded: "euclidean",
+    DistanceType.L2Unexpanded: "sqeuclidean",
+    DistanceType.L1: "cityblock",
+    DistanceType.Linf: "chebyshev",
+    DistanceType.Canberra: "canberra",
+    DistanceType.CosineExpanded: "cosine",
+    DistanceType.CorrelationExpanded: "correlation",
+    DistanceType.BrayCurtis: "braycurtis",
+    DistanceType.JensenShannon: "jensenshannon",
+}
+
+
+@pytest.mark.parametrize("metric", sorted(SCIPY_NAMES, key=lambda m: m.value))
+@pytest.mark.parametrize("shape", [(50, 40, 16), (33, 17, 130)])
+def test_vs_scipy(metric, shape, rng):
+    m, n, k = shape
+    x = rng.standard_normal((m, k)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    if metric == DistanceType.JensenShannon:
+        x = np.abs(x) + 1e-3
+        y = np.abs(y) + 1e-3
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric=metric))
+    want = scipy_dist.cdist(x, y, SCIPY_NAMES[metric])
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_inner_product(rng):
+    x = rng.standard_normal((20, 8)).astype(np.float32)
+    y = rng.standard_normal((30, 8)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="inner_product"))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-5, atol=1e-5)
+
+
+def test_minkowski(rng):
+    x = rng.standard_normal((20, 8)).astype(np.float32)
+    y = rng.standard_normal((30, 8)).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="minkowski", metric_arg=3.0))
+    want = scipy_dist.cdist(x, y, "minkowski", p=3.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_hellinger(rng):
+    x = np.abs(rng.standard_normal((20, 8))).astype(np.float32)
+    y = np.abs(rng.standard_normal((30, 8))).astype(np.float32)
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="hellinger"))
+    inner = np.sqrt(x) @ np.sqrt(y).T
+    want = np.sqrt(np.maximum(1 - inner, 0))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_kl_divergence(rng):
+    x = np.abs(rng.standard_normal((20, 8))).astype(np.float32) + 1e-3
+    y = np.abs(rng.standard_normal((30, 8))).astype(np.float32) + 1e-3
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(x, y, metric="kl_divergence"))
+    want = 0.5 * np.sum(
+        x[:, None, :] * (np.log(x[:, None, :]) - np.log(y[None, :, :])), axis=-1
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_hamming(rng):
+    x = (rng.random((20, 16)) > 0.5).astype(np.float32)
+    y = (rng.random((30, 16)) > 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="hamming"))
+    want = scipy_dist.cdist(x, y, "hamming")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_russelrao(rng):
+    x = (rng.random((20, 16)) > 0.5).astype(np.float32)
+    y = (rng.random((30, 16)) > 0.5).astype(np.float32)
+    got = np.asarray(pairwise_distance(x, y, metric="russelrao"))
+    want = scipy_dist.cdist(x, y, "russellrao")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_haversine():
+    # London, Paris, NYC (lat, lon in radians)
+    pts = np.radians(
+        np.array([[51.5074, -0.1278], [48.8566, 2.3522], [40.7128, -74.0060]])
+    ).astype(np.float32)
+    d = np.asarray(pairwise_distance(pts, pts, metric="haversine"))
+    earth_km = 6371.0
+    # London-Paris ≈ 344 km
+    assert abs(d[0, 1] * earth_km - 344) < 10
+    assert abs(d[0, 2] * earth_km - 5570) < 60
+    np.testing.assert_allclose(d, d.T, atol=1e-6)
+
+
+def test_tiled_path_matches_direct(rng, res):
+    """Force tiling by shrinking the workspace budget."""
+    from raft_tpu import Resources
+
+    x = rng.standard_normal((257, 33)).astype(np.float32)
+    y = rng.standard_normal((119, 33)).astype(np.float32)
+    small = Resources(workspace_limit_bytes=200_000)
+    got = np.asarray(pairwise_distance(x, y, metric="l1", res=small))
+    want = scipy_dist.cdist(x, y, "cityblock")
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_resolve_and_minclose():
+    assert resolve_metric("euclidean") == DistanceType.L2SqrtExpanded
+    assert resolve_metric(0) == DistanceType.L2Expanded
+    assert is_min_close("euclidean")
+    assert not is_min_close("inner_product")
+
+
+def test_row_norms(rng):
+    x = rng.standard_normal((10, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(row_norms_sq(x)), (x * x).sum(1), rtol=1e-5
+    )
+
+
+def test_unsupported_dense_metric(rng):
+    x = np.zeros((4, 4), np.float32)
+    with pytest.raises(NotImplementedError):
+        pairwise_distance(x, x, metric="jaccard")

@@ -1,0 +1,50 @@
+"""select_k tests — compared against a numpy reference across shapes/algos
+(reference pattern: cpp/test/matrix/select_k.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.ops import SelectAlgo, select_k
+
+
+def _ref_select(values, k, select_min):
+    order = np.argsort(values if select_min else -values, axis=-1, kind="stable")
+    idx = order[..., :k]
+    return np.take_along_axis(values, idx, -1), idx
+
+
+@pytest.mark.parametrize("algo", [SelectAlgo.DIRECT, SelectAlgo.TWO_PHASE, SelectAlgo.AUTO])
+@pytest.mark.parametrize("shape,k", [((4, 100), 10), ((1, 17), 17), ((7, 2048), 256), ((3, 100000), 64)])
+@pytest.mark.parametrize("select_min", [True, False])
+def test_select_k(algo, shape, k, select_min, rng):
+    if shape[1] < 100 and algo == SelectAlgo.TWO_PHASE:
+        pytest.skip("two-phase needs wide rows")
+    values = rng.standard_normal(shape).astype(np.float32)
+    got_v, got_i = select_k(values, k, select_min=select_min, algo=algo)
+    want_v, _ = _ref_select(values, k, select_min)
+    np.testing.assert_allclose(np.sort(np.asarray(got_v), -1), np.sort(want_v, -1), rtol=1e-6)
+    # indices must gather the returned values
+    np.testing.assert_allclose(
+        np.take_along_axis(values, np.asarray(got_i), -1), np.asarray(got_v), rtol=1e-6
+    )
+
+
+def test_select_k_with_source_indices(rng):
+    values = rng.standard_normal((3, 50)).astype(np.float32)
+    src = rng.integers(0, 10_000, size=(3, 50))
+    got_v, got_i = select_k(values, 5, indices=src)
+    want_v, want_pos = _ref_select(values, 5, True)
+    np.testing.assert_allclose(np.sort(np.asarray(got_v)), np.sort(want_v), rtol=1e-6)
+    assert set(np.asarray(got_i)[0]) == set(src[0][want_pos[0]])
+
+
+def test_select_k_1d(rng):
+    values = rng.standard_normal(100).astype(np.float32)
+    v, i = select_k(values, 3)
+    assert v.shape == (3,)
+    np.testing.assert_allclose(np.asarray(v), np.sort(values)[:3], rtol=1e-6)
+
+
+def test_k_too_large():
+    with pytest.raises(ValueError):
+        select_k(np.zeros((2, 4), np.float32), 5)
