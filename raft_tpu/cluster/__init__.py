@@ -1,4 +1,8 @@
 """Cluster layer (SURVEY.md §2.6): k-means (Lloyd), balanced hierarchical
 k-means (IVF coarse-quantizer trainer), single-linkage."""
 
-__all__ = []
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansParams
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+
+__all__ = ["kmeans", "kmeans_balanced", "KMeansParams", "KMeansBalancedParams"]

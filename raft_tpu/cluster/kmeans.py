@@ -1,0 +1,244 @@
+"""Lloyd k-means with k-means++ init.
+
+Reference: ``raft::cluster::kmeans`` (cluster/detail/kmeans.cuh:361-1054,
+cluster/kmeans_types.hpp) — ``KMeansParams{n_clusters, max_iter=300,
+tol=1e-4, init: KMeansPlusPlus|Random|Array, n_init=1, rng_state,
+oversampling_factor, inertia_check}``; fit = kmeans++ init
+(``initKMeansPlusPlus``) then Lloyd iterations of fusedL2NN-style assignment
+(``minClusterAndDistanceCompute``, detail/kmeans_common.cuh:354) + centroid
+update via reduce_rows_by_key, stopping on center-shift² < tol.
+
+TPU-native design: assignment = fused-L2 argmin (MXU matmul + fused epilogue,
+tiled by the Resources workspace budget); update = scatter-add segment sum;
+the whole fit is one jitted ``lax.while_loop`` carrying (centers, shift).
+k-means++ is a ``fori_loop`` over centers sampling from the min-distance²
+distribution — the standard single-trial variant of the reference's
+algorithm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
+from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
+
+
+class InitMethod(enum.Enum):
+    KMeansPlusPlus = "k-means++"
+    Random = "random"
+    Array = "array"  # user-provided centroids
+
+
+@dataclasses.dataclass
+class KMeansParams:
+    """reference: cluster/kmeans_types.hpp KMeansParams."""
+
+    n_clusters: int = 8
+    max_iter: int = 300
+    tol: float = 1e-4
+    init: InitMethod = InitMethod.KMeansPlusPlus
+    n_init: int = 1
+    metric: DistanceType = DistanceType.L2Expanded
+    seed: int = 0
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if isinstance(self.init, str):
+            self.init = InitMethod(self.init)
+
+
+def _assign(x, x_norms, centers, tile: int):
+    """E-step: (labels, distance²) via expanded-L2 argmin on the MXU, tiled
+    over x rows so only [tile, n_clusters] distances exist at once (the
+    reference's minibatched minClusterAndDistanceCompute)."""
+    from raft_tpu.utils.shape import cdiv
+
+    cn = row_norms_sq(centers)
+
+    def tile_body(args):
+        xt, xnt = args
+        d = (
+            xnt[:, None]
+            + cn[None, :]
+            - 2.0
+            * jax.lax.dot_general(
+                xt, centers, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+        )
+        d = jnp.maximum(d, 0.0)
+        return jnp.argmin(d, 1).astype(jnp.int32), jnp.min(d, 1)
+
+    m = x.shape[0]
+    if m <= tile:
+        return tile_body((x, x_norms))
+    n_tiles = cdiv(m, tile)
+    pad = n_tiles * tile - m
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    xnp_ = jnp.pad(x_norms, (0, pad))
+    labels, d2 = jax.lax.map(
+        tile_body, (xp.reshape(n_tiles, tile, -1), xnp_.reshape(n_tiles, tile))
+    )
+    return labels.reshape(-1)[:m], d2.reshape(-1)[:m]
+
+
+def _update(x, labels, old_centers):
+    n_clusters = old_centers.shape[0]
+    counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(1.0)
+    sums = jnp.zeros_like(old_centers).at[labels].add(x)
+    # empty clusters keep their previous center (reference behavior)
+    centers = jnp.where(
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], old_centers
+    )
+    return centers, counts
+
+
+@functools.partial(jax.jit, static_argnames=("n_clusters",))
+def _kmeans_pp_init(key, x, x_norms, n_clusters: int):
+    """k-means++ (reference: initKMeansPlusPlus, detail/kmeans.cuh): seed with
+    a uniform row, then sample each next center ∝ min distance²."""
+    n = x.shape[0]
+    k0, key = jax.random.split(key)
+    first = jax.random.randint(k0, (), 0, n)
+    centers0 = jnp.zeros((n_clusters, x.shape[1]), x.dtype).at[0].set(x[first])
+    d0 = row_norms_sq(x - x[first][None, :])
+
+    def body(i, state):
+        centers, min_d, key = state
+        key, kc = jax.random.split(key)
+        # categorical over min_d (gumbel-free: use log weights)
+        logits = jnp.where(min_d > 0, jnp.log(jnp.maximum(min_d, 1e-38)), -jnp.inf)
+        # all-zero distances (duplicate points) → uniform
+        logits = jnp.where(jnp.all(min_d <= 0), jnp.zeros_like(logits), logits)
+        nxt = jax.random.categorical(kc, logits)
+        c = x[nxt]
+        centers = centers.at[i].set(c)
+        d_new = row_norms_sq(x - c[None, :])
+        return centers, jnp.minimum(min_d, d_new), key
+
+    centers, _, _ = jax.lax.fori_loop(1, n_clusters, body, (centers0, d0, key))
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter", "tile"))
+def _lloyd_jit(x, x_norms, centers0, tol: float, max_iter: int, tile: int):
+    def cond(state):
+        i, shift2, *_ = state
+        return (i < max_iter) & (shift2 >= tol)
+
+    def body(state):
+        i, _, centers = state
+        labels, _ = _assign(x, x_norms, centers, tile)
+        new_centers, _ = _update(x, labels, centers)
+        shift2 = jnp.sum((new_centers - centers) ** 2)
+        return i + 1, shift2, new_centers
+
+    n_iter, _, centers = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.float32(jnp.inf), centers0)
+    )
+    labels, d2 = _assign(x, x_norms, centers, tile)
+    inertia = jnp.sum(d2)
+    return centers, labels, inertia, n_iter
+
+
+def fit(
+    x,
+    params: Optional[KMeansParams] = None,
+    init_centers=None,
+    sample_weights=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """K-means fit (reference: kmeans::fit, detail/kmeans.cuh:361).
+
+    Returns (centers, labels, inertia, n_iter). ``n_init`` restarts keep the
+    lowest-inertia solution, as in the reference.
+    """
+    params = params or KMeansParams()
+    res = ensure_resources(res)
+    if params.metric not in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        raise NotImplementedError("kmeans supports L2 metrics (like the reference)")
+    if sample_weights is not None:
+        raise NotImplementedError("sample_weights not yet supported")
+    if params.init == InitMethod.Array and init_centers is None:
+        raise ValueError("init='array' requires init_centers")
+    x = jnp.asarray(x, jnp.float32)
+    xn = row_norms_sq(x)
+    key = jax.random.key(params.seed)
+    from raft_tpu.ops.fused_l2_nn import _choose_tile
+
+    tile = _choose_tile(x.shape[0], params.n_clusters, res.workspace_limit_bytes)
+
+    best = None
+    for trial in range(max(params.n_init, 1)):
+        key, kt = jax.random.split(key)
+        if params.init == InitMethod.Array or init_centers is not None:
+            c0 = jnp.asarray(init_centers, jnp.float32)
+        elif params.init == InitMethod.Random:
+            idx = jax.random.choice(kt, x.shape[0], (params.n_clusters,), replace=False)
+            c0 = x[idx]
+        else:
+            c0 = _kmeans_pp_init(kt, x, xn, params.n_clusters)
+        centers, labels, inertia, n_iter = _lloyd_jit(
+            x, xn, c0, params.tol, params.max_iter, tile
+        )
+        if best is None or float(inertia) < float(best[2]):
+            best = (centers, labels, inertia, n_iter)
+    return best
+
+
+def predict(centers, x, res: Optional[Resources] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Nearest-center labels + inertia (reference: kmeans::predict)."""
+    res = ensure_resources(res)
+    x = jnp.asarray(x, jnp.float32)
+    d2, labels = fused_l2_nn_argmin(x, jnp.asarray(centers, jnp.float32), res=res)
+    return labels, jnp.sum(d2)
+
+
+def fit_predict(x, params: Optional[KMeansParams] = None,
+                res: Optional[Resources] = None):
+    centers, labels, inertia, n_iter = fit(x, params, res=res)
+    return centers, labels
+
+
+def cluster_cost(x, centers, res: Optional[Resources] = None) -> jax.Array:
+    """Sum of squared distances to nearest center (reference:
+    kmeans::cluster_cost, detail/kmeans.cuh)."""
+    d2, _ = fused_l2_nn_argmin(
+        jnp.asarray(x, jnp.float32), jnp.asarray(centers, jnp.float32), res=res
+    )
+    return jnp.sum(d2)
+
+
+def find_k(
+    x,
+    k_max: int,
+    k_min: int = 2,
+    params: Optional[KMeansParams] = None,
+    res: Optional[Resources] = None,
+) -> int:
+    """Elbow-style auto-find-k (reference: detail/kmeans_auto_find_k.cuh uses
+    a binary search over inertia-vs-k curvature; we scan and pick the knee)."""
+    params = params or KMeansParams()
+    costs = []
+    ks = list(range(k_min, k_max + 1))
+    for k in ks:
+        p = dataclasses.replace(params, n_clusters=k)
+        _, _, inertia, _ = fit(x, p, res=res)
+        costs.append(float(inertia))
+    # knee = max second difference
+    if len(costs) < 3:
+        return ks[int(jnp.argmin(jnp.asarray(costs)))]
+    import numpy as np
+
+    second = np.diff(costs, 2)
+    return ks[int(second.argmax()) + 1]

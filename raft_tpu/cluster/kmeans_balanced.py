@@ -1,0 +1,398 @@
+"""Balanced hierarchical k-means — the IVF coarse-quantizer trainer.
+
+Reference: ``raft::cluster::kmeans_balanced`` (cluster/kmeans_balanced.cuh:76,
+134,199,258 public API; cluster/detail/kmeans_balanced.cuh implementation).
+Behavioral contract reproduced here:
+
+- ``build_clusters`` (detail:700-757): init labels = row_index % n_clusters,
+  compute centers, then balancing EM (pullback=2, threshold=0.25): per
+  iteration (detail:617-697) — (a) ``adjust_centers`` (skipped on iter 0):
+  every cluster with size ≤ average·threshold is re-seeded to gravitate toward
+  a sample from a large (size ≥ average) cluster: new_center =
+  (wc·center[donor_label] + 1·x_donor)/(wc+1) with wc = min(size,
+  kAdjustCentersWeight=7) (detail:439-484); the balancing counter starts at
+  ``pullback`` so the first rebalance immediately grants one extra EM
+  iteration (detail:636); (b) for InnerProduct/Cosine/Correlation metrics the
+  centers are L2-row-normalized every iteration (detail:656-670); (c) E-step
+  predict; (d) M-step calc_centers_and_sizes.
+- ``build_hierarchical`` (detail:956-1090): n_mesoclusters = min(n, round(
+  √n_clusters)); coarse build_clusters over the trainset; fine cluster counts
+  per mesocluster proportional to mesocluster sizes (arrange_fine_clusters,
+  detail:759-818); per-mesocluster build_clusters over exactly that
+  mesocluster's fine count; final fine-tuning EM over all clusters with
+  max(n_iters/10, 2) iterations, pullback=5, threshold=0.2 (detail:1075-1090).
+
+TPU-native design: E-step = fused-L2 argmin (MXU matmul, tiled); M-step =
+scatter-add segment sum; adjust_centers vectorized — starving clusters pick
+donors from a pre-sampled pool of big-cluster rows (the reference's
+pseudo-random host scan, done functionally). One shared jitted
+``lax.while_loop`` EM body serves build_clusters and the fine-tune stage. The
+mesocluster stage pads member sets to a static ``mesocluster_size_max`` with
+row weights, and pads cluster counts to a static ``fine_max`` with an active-
+cluster count, so one compiled kernel serves every mesocluster while each
+trains exactly its own number of clusters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
+from raft_tpu.utils.shape import cdiv
+
+_ADJUST_CENTERS_WEIGHT = 7.0  # detail/kmeans_balanced.cuh:62
+_BUILD_PULLBACK = 2  # detail:752
+_BUILD_THRESHOLD = 0.25  # detail:753
+_TUNE_PULLBACK = 5  # detail:1087
+_TUNE_THRESHOLD = 0.2  # detail:1088
+_DONOR_POOL = 256  # candidate donors sampled per adjust step
+
+
+@dataclasses.dataclass
+class KMeansBalancedParams:
+    """Hyper-parameters (reference: kmeans_balanced_types.hpp:34)."""
+
+    n_iters: int = 20
+    metric: DistanceType = DistanceType.L2Expanded
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if self.metric not in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.InnerProduct,
+            DistanceType.CosineExpanded,
+        ):
+            raise ValueError(
+                f"kmeans_balanced supports L2/IP/Cosine metrics, got {self.metric.name}"
+            )
+
+
+def _needs_normalized_centers(metric: DistanceType) -> bool:
+    # reference detail:656-670: avoid collapse to zero centers
+    return metric in (
+        DistanceType.InnerProduct,
+        DistanceType.CosineExpanded,
+        DistanceType.CorrelationExpanded,
+    )
+
+
+def _predict_labels(x, centers, metric: DistanceType, active_mask=None):
+    """E-step: nearest *active* center per row; the matmul rides the MXU
+    (analog of detail::predict's minibatched fusedL2NN)."""
+    xf = x.astype(jnp.float32)
+    cf = centers.astype(jnp.float32)
+    dots = jax.lax.dot_general(
+        xf, cf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
+        if metric == DistanceType.CosineExpanded:
+            dots = dots / jnp.maximum(
+                jnp.linalg.norm(cf, axis=1)[None, :], 1e-20
+            )
+        score = dots
+        if active_mask is not None:
+            score = jnp.where(active_mask[None, :], score, -jnp.inf)
+        return jnp.argmax(score, axis=1).astype(jnp.int32)
+    d = row_norms_sq(xf)[:, None] + row_norms_sq(cf)[None, :] - 2.0 * dots
+    if active_mask is not None:
+        d = jnp.where(active_mask[None, :], d, jnp.inf)
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
+def calc_centers_and_sizes(x, labels, n_clusters: int, weights=None
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """M-step (reference: kmeans_balanced::helpers::calc_centers_and_sizes,
+    kmeans_balanced.cuh:258): per-cluster mean + counts via scatter-add."""
+    xf = x.astype(jnp.float32)
+    if weights is not None:
+        w = weights.astype(jnp.float32)
+        xf = xf * w[:, None]
+        counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(w)
+    else:
+        counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(1.0)
+    sums = jnp.zeros((n_clusters, x.shape[1]), jnp.float32).at[labels].add(xf)
+    centers = sums / jnp.maximum(counts, 1.0)[:, None]
+    return centers, counts
+
+
+def _adjust_centers(key, centers, sizes, x, labels, weights, active_mask,
+                    threshold: float):
+    """Re-seed starving clusters from big-cluster samples (detail:439-484).
+
+    Vectorized: sample a _DONOR_POOL of row indices, keep those in big
+    clusters, and give starving cluster l the (l mod pool)-th good donor.
+    Returns (adjusted_any, new_centers).
+    """
+    n_rows = x.shape[0]
+    n_clusters = centers.shape[0]
+    n_eff = jnp.sum(weights) if weights is not None else jnp.float32(n_rows)
+    if active_mask is not None:
+        n_active = jnp.sum(active_mask.astype(jnp.float32))
+    else:
+        n_active = jnp.float32(n_clusters)
+    average = n_eff / jnp.maximum(n_active, 1.0)
+
+    starving = sizes <= average * threshold  # includes empty clusters
+    if active_mask is not None:
+        starving = starving & active_mask
+    big = sizes >= average
+
+    pool_idx = jax.random.randint(key, (_DONOR_POOL,), 0, n_rows)
+    pool_ok = big[labels[pool_idx]]
+    if weights is not None:
+        pool_ok = pool_ok & (weights[pool_idx] > 0)
+    # Compact good donors to the front (stable), cycling to fill the pool.
+    order = jnp.argsort(~pool_ok)  # good donors first
+    pool_idx = pool_idx[order]
+    n_good = jnp.sum(pool_ok.astype(jnp.int32))
+    slot = jnp.arange(n_clusters) % jnp.maximum(n_good, 1)
+    donor_rows = pool_idx[slot]  # [n_clusters]
+    have_donor = (n_good > 0) & starving
+
+    donor_label = labels[donor_rows]
+    wc = jnp.minimum(sizes, _ADJUST_CENTERS_WEIGHT)[:, None]
+    new = (wc * centers[donor_label] + x[donor_rows].astype(jnp.float32)) / (wc + 1.0)
+    centers = jnp.where(have_donor[:, None], new, centers)
+    return jnp.any(have_donor), centers
+
+
+def _balancing_em_loop(key, x, weights, active_mask, centers0, labels0, sizes0,
+                       n_iters: int, pullback: int, threshold: float,
+                       metric: DistanceType):
+    """The shared balancing-EM loop (reference: balancing_em_iters,
+    detail:617-697). Counter starts at ``pullback`` so the first rebalance
+    grants an extra iteration (detail:636)."""
+    n_clusters = centers0.shape[0]
+    max_iters = n_iters + cdiv(n_iters, 2) + 1  # bounded extra-iteration budget
+
+    def cond(state):
+        i, iters_target = state[0], state[1]
+        return i < jnp.minimum(iters_target, max_iters)
+
+    def body(state):
+        i, iters_target, balance_ctr, key, centers, labels, sizes = state
+        key, k_adj = jax.random.split(key)
+        adjusted, centers = jax.lax.cond(
+            i > 0,
+            lambda: _adjust_centers(
+                k_adj, centers, sizes, x, labels, weights, active_mask, threshold
+            ),
+            lambda: (jnp.bool_(False), centers),
+        )
+        balance_ctr = balance_ctr + adjusted.astype(jnp.int32)
+        extra = balance_ctr >= pullback
+        balance_ctr = jnp.where(extra, balance_ctr - pullback, balance_ctr)
+        iters_target = iters_target + extra.astype(jnp.int32)
+        if _needs_normalized_centers(metric):
+            centers = centers / jnp.maximum(
+                jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-20
+            )
+        labels = _predict_labels(x, centers, metric, active_mask)
+        centers, sizes = calc_centers_and_sizes(x, labels, n_clusters, weights)
+        return (i + 1, iters_target, balance_ctr, key, centers, labels, sizes)
+
+    state = (jnp.int32(0), jnp.int32(n_iters), jnp.int32(pullback), key,
+             centers0, labels0, sizes0)
+    _, _, _, _, centers, labels, sizes = jax.lax.while_loop(cond, body, state)
+    return centers, labels, sizes
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_clusters", "n_iters", "metric", "has_weights",
+                     "has_active"),
+)
+def _build_clusters_jit(key, x, weights, n_active, n_clusters: int,
+                        n_iters: int, metric: DistanceType, has_weights: bool,
+                        has_active: bool):
+    n_rows = x.shape[0]
+    w = weights if has_weights else None
+    if has_active:
+        active_mask = jnp.arange(n_clusters) < n_active
+        labels0 = (jnp.arange(n_rows) % jnp.maximum(n_active, 1)).astype(jnp.int32)
+    else:
+        active_mask = None
+        labels0 = (jnp.arange(n_rows) % n_clusters).astype(jnp.int32)
+    centers0, sizes0 = calc_centers_and_sizes(x, labels0, n_clusters, w)
+    return _balancing_em_loop(
+        key, x, w, active_mask, centers0, labels0, sizes0,
+        n_iters, _BUILD_PULLBACK, _BUILD_THRESHOLD, metric,
+    )
+
+
+def build_clusters(
+    key,
+    x,
+    n_clusters: int,
+    params: Optional[KMeansBalancedParams] = None,
+    weights: Optional[jax.Array] = None,
+    n_active: Optional[jax.Array] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-level balanced k-means (reference: helpers::build_clusters,
+    kmeans_balanced.cuh:258). Returns (centers, labels, sizes).
+
+    ``n_clusters`` is the (static) center-array size; ``n_active`` optionally
+    limits training to the first n_active clusters (used by the hierarchical
+    fine stage so one compilation serves all mesoclusters).
+    """
+    params = params or KMeansBalancedParams()
+    ensure_resources(res)
+    x = jnp.asarray(x)
+    return _build_clusters_jit(
+        key, x,
+        weights if weights is not None else jnp.zeros((0,)),
+        n_active if n_active is not None else jnp.int32(0),
+        int(n_clusters), int(params.n_iters), params.metric,
+        weights is not None, n_active is not None,
+    )
+
+
+def _arrange_fine_clusters(n_clusters: int, n_meso: int, n_rows: int,
+                           meso_sizes: np.ndarray) -> np.ndarray:
+    """Fine-cluster count per mesocluster, proportional to its size
+    (reference: arrange_fine_clusters, detail:759-818). Host-side."""
+    fine_nums = np.zeros(n_meso, dtype=np.int64)
+    n_lists_rem = n_clusters
+    n_rows_rem = n_rows
+    n_nonempty_rem = int((meso_sizes > 0).sum())
+    for i in range(n_meso):
+        if i < n_meso - 1:
+            if meso_sizes[i] == 0:
+                fine_nums[i] = 0
+            else:
+                n_nonempty_rem -= 1
+                # proportional share, rounded; keep ≥1 per nonempty, and leave
+                # ≥1 for each remaining nonempty mesocluster
+                share = int(n_lists_rem * meso_sizes[i] / max(n_rows_rem, 1) + 0.5)
+                fine_nums[i] = min(
+                    max(share, 1), max(n_lists_rem - n_nonempty_rem, 1)
+                )
+        else:
+            fine_nums[i] = n_lists_rem if meso_sizes[i] > 0 else 0
+        n_lists_rem -= fine_nums[i]
+        n_rows_rem -= int(meso_sizes[i])
+    return fine_nums
+
+
+def fit(
+    key,
+    x,
+    n_clusters: int,
+    params: Optional[KMeansBalancedParams] = None,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Hierarchical balanced k-means fit (reference: kmeans_balanced::fit,
+    kmeans_balanced.cuh:76 → detail::build_hierarchical:956).
+
+    Returns cluster centers [n_clusters, dim] (fp32).
+    """
+    params = params or KMeansBalancedParams()
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    n_rows, dim = x.shape
+    if n_clusters > n_rows:
+        raise ValueError(f"n_clusters={n_clusters} > n_rows={n_rows}")
+
+    n_meso = min(n_clusters, int(math.sqrt(n_clusters) + 0.5))
+    if n_meso <= 1 or n_clusters <= n_meso:
+        centers, _, _ = build_clusters(key, x, n_clusters, params, res=res)
+        return centers
+
+    k_coarse, k_fine, k_final = jax.random.split(key, 3)
+
+    # --- coarse stage: mesoclusters over the whole trainset
+    _, meso_labels, meso_sizes_f = build_clusters(k_coarse, x, n_meso, params, res=res)
+    meso_labels_np = np.asarray(meso_labels)
+    meso_sizes = np.asarray(meso_sizes_f).astype(np.int64)
+
+    fine_nums = _arrange_fine_clusters(n_clusters, n_meso, n_rows, meso_sizes)
+    assert fine_nums.sum() == n_clusters, (fine_nums.sum(), n_clusters)
+
+    # cap per-mesocluster trainset like the reference's balanced max
+    # (detail:1032-1046)
+    meso_max = int(min(meso_sizes.max(), max(cdiv(2 * n_rows, max(n_meso, 1)), 1)))
+    fine_max = int(fine_nums.max())
+
+    # --- fine stage: one padded, weighted, active-masked build per mesocluster
+    x_np = np.asarray(x)
+    centers_out = np.zeros((n_clusters, dim), np.float32)
+    fine_keys = jax.random.split(k_fine, n_meso)
+    done = 0
+    for i in range(n_meso):
+        if fine_nums[i] == 0:
+            continue
+        members = np.nonzero(meso_labels_np == i)[0][:meso_max]
+        sub = np.zeros((meso_max, dim), x_np.dtype)
+        sub[: len(members)] = x_np[members]
+        wts = np.zeros((meso_max,), np.float32)
+        wts[: len(members)] = 1.0
+        # padded shapes + n_active are static/traced → one compile for all
+        c_pad, _, _ = build_clusters(
+            fine_keys[i], jnp.asarray(sub), fine_max, params,
+            weights=jnp.asarray(wts), n_active=jnp.int32(fine_nums[i]), res=res,
+        )
+        centers_out[done : done + fine_nums[i]] = np.asarray(c_pad)[: fine_nums[i]]
+        done += int(fine_nums[i])
+
+    # --- final fine-tuning over all clusters (reference: max(n_iters/10, 2)
+    # iterations, pullback=5, threshold=0.2 — detail:1075-1090)
+    centers = jnp.asarray(centers_out)
+    centers, _, _ = _fine_tune_jit(
+        k_final, x.astype(jnp.float32), centers,
+        max(params.n_iters // 10, 2), params.metric,
+    )
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("n_iters", "metric"))
+def _fine_tune_jit(key, x, centers0, n_iters: int, metric: DistanceType):
+    n_clusters = centers0.shape[0]
+    labels0 = _predict_labels(x, centers0, metric)
+    sizes0 = jnp.zeros((n_clusters,), jnp.float32).at[labels0].add(1.0)
+    return _balancing_em_loop(
+        key, x, None, None, centers0, labels0, sizes0,
+        n_iters, _TUNE_PULLBACK, _TUNE_THRESHOLD, metric,
+    )
+
+
+def predict(
+    centers,
+    x,
+    params: Optional[KMeansBalancedParams] = None,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Assign each row of x to its nearest center (reference:
+    kmeans_balanced::predict, kmeans_balanced.cuh:134)."""
+    params = params or KMeansBalancedParams()
+    ensure_resources(res)
+    return _predict_jit(jnp.asarray(x), jnp.asarray(centers), params.metric)
+
+
+@functools.partial(jax.jit, static_argnames=("metric",))
+def _predict_jit(x, centers, metric: DistanceType):
+    return _predict_labels(x, centers, metric)
+
+
+def fit_predict(
+    key,
+    x,
+    n_clusters: int,
+    params: Optional[KMeansBalancedParams] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """fit + predict (reference: kmeans_balanced::fit_predict,
+    kmeans_balanced.cuh:199)."""
+    centers = fit(key, x, n_clusters, params, res)
+    return centers, predict(centers, x, params, res)

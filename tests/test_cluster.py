@@ -1,0 +1,125 @@
+"""Cluster tests: Lloyd k-means vs sklearn quality; balanced k-means balance
+properties (reference pattern: cpp/test/cluster/kmeans.cu,
+kmeans_balanced.cu — quality + balance assertions, not bitwise)."""
+
+import numpy as np
+import pytest
+import jax
+
+from raft_tpu.cluster import kmeans, kmeans_balanced, KMeansParams, KMeansBalancedParams
+from raft_tpu.ops import rng as rrng
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    x, labels = rrng.make_blobs(3, 2000, 16, n_clusters=8, cluster_std=0.5)
+    return np.asarray(x), np.asarray(labels)
+
+
+class TestKMeans:
+    def test_fit_quality_vs_sklearn(self, blobs):
+        from sklearn.cluster import KMeans as SKKMeans
+
+        x, _ = blobs
+        params = KMeansParams(n_clusters=8, max_iter=100, seed=0)
+        centers, labels, inertia, n_iter = kmeans.fit(x, params)
+        sk = SKKMeans(n_clusters=8, n_init=3, max_iter=100, random_state=0).fit(x)
+        assert float(inertia) <= sk.inertia_ * 1.1
+        assert int(n_iter) < 100  # converged by tol
+
+    def test_predict_matches_fit_labels(self, blobs):
+        x, _ = blobs
+        centers, labels, _, _ = kmeans.fit(x, KMeansParams(n_clusters=8, seed=1))
+        labels2, _ = kmeans.predict(centers, x)
+        assert (np.asarray(labels) == np.asarray(labels2)).mean() > 0.999
+
+    def test_random_init(self, blobs):
+        x, _ = blobs
+        params = KMeansParams(n_clusters=8, init="random", seed=2, max_iter=50)
+        centers, _, inertia, _ = kmeans.fit(x, params)
+        assert centers.shape == (8, 16)
+        assert np.isfinite(float(inertia))
+
+    def test_init_from_array(self, blobs):
+        x, _ = blobs
+        init = x[:8].copy()
+        params = KMeansParams(n_clusters=8, init="array", max_iter=20)
+        centers, _, inertia, _ = kmeans.fit(x, params, init_centers=init)
+        assert np.isfinite(float(inertia))
+
+    def test_cluster_cost(self, blobs):
+        x, _ = blobs
+        centers, _, inertia, _ = kmeans.fit(x, KMeansParams(n_clusters=8, seed=0))
+        cost = kmeans.cluster_cost(x, centers)
+        assert float(cost) == pytest.approx(float(inertia), rel=1e-3)
+
+
+class TestKMeansBalanced:
+    def test_build_clusters_balance(self, blobs):
+        x, _ = blobs
+        key = jax.random.key(0)
+        centers, labels, sizes = kmeans_balanced.build_clusters(
+            key, x, 16, KMeansBalancedParams(n_iters=20)
+        )
+        sizes = np.asarray(sizes)
+        assert sizes.sum() == len(x)
+        # balance: no cluster starving below 25% of average (the adjust
+        # threshold) after convergence, and none grotesquely oversized
+        avg = len(x) / 16
+        assert sizes.min() >= 0.25 * avg * 0.5  # slack for randomness
+        assert sizes.max() <= 4 * avg
+
+    def test_hierarchical_fit(self, blobs):
+        x, _ = blobs
+        key = jax.random.key(1)
+        centers = kmeans_balanced.fit(key, x, 64, KMeansBalancedParams(n_iters=10))
+        assert centers.shape == (64, 16)
+        labels = np.asarray(kmeans_balanced.predict(centers, x))
+        sizes = np.bincount(labels, minlength=64)
+        # hierarchical balanced build: most clusters populated
+        assert (sizes > 0).sum() >= 56
+        avg = len(x) / 64
+        assert sizes.max() <= 8 * avg
+
+    def test_fit_predict_quality(self, blobs):
+        x, true_labels = blobs
+        key = jax.random.key(2)
+        centers, labels = kmeans_balanced.fit_predict(
+            key, x, 8, KMeansBalancedParams(n_iters=20)
+        )
+        # clustering should recover the 8 blobs (high ARI)
+        from sklearn.metrics import adjusted_rand_score
+
+        ari = adjusted_rand_score(true_labels, np.asarray(labels))
+        assert ari > 0.9
+
+    def test_inner_product_metric(self, blobs):
+        x, _ = blobs
+        xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+        key = jax.random.key(3)
+        params = KMeansBalancedParams(n_iters=10, metric="inner_product")
+        centers, labels, sizes = kmeans_balanced.build_clusters(key, xn, 8, params)
+        # labels must be the argmax inner product against the (normalized)
+        # centers the final E-step saw; the loop ends with an M-step so the
+        # returned centers are means — normalize before comparing
+        c = np.asarray(centers)
+        cn = c / np.maximum(np.linalg.norm(c, axis=1, keepdims=True), 1e-20)
+        assert ((xn @ cn.T).argmax(1) == np.asarray(labels)).mean() > 0.95
+        assert float(np.asarray(sizes).sum()) == pytest.approx(len(xn))
+
+    def test_weighted_rows_excluded(self, blobs):
+        x, _ = blobs
+        n = len(x)
+        xpad = np.concatenate([x, 1e6 * np.ones((100, x.shape[1]), np.float32)])
+        w = np.concatenate([np.ones(n, np.float32), np.zeros(100, np.float32)])
+        key = jax.random.key(4)
+        centers, _, sizes = kmeans_balanced.build_clusters(
+            key, xpad, 8, KMeansBalancedParams(n_iters=10), weights=np.asarray(w)
+        )
+        # padded garbage rows must not pull any center to 1e6 range
+        assert np.abs(np.asarray(centers)).max() < 1e3
+        assert float(np.asarray(sizes).sum()) == pytest.approx(n)
+
+    def test_bad_metric_raises(self):
+        with pytest.raises(ValueError):
+            KMeansBalancedParams(metric="canberra")
