@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
-from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
+from raft_tpu.ops.fused_l2_nn import (fused_l2_nn_argmin,
+    _fused_l2_nn_jit, choose_tile_rows)
 
 
 class InitMethod(enum.Enum):
@@ -56,39 +57,12 @@ class KMeansParams:
 
 
 def _assign(x, x_norms, centers, tile: int):
-    """E-step: (labels, distance²) via expanded-L2 argmin on the MXU, tiled
-    over x rows so only [tile, n_clusters] distances exist at once (the
-    reference's minibatched minClusterAndDistanceCompute)."""
-    from raft_tpu.utils.shape import cdiv
-
-    cn = row_norms_sq(centers)
-
-    def tile_body(args):
-        xt, xnt = args
-        d = (
-            xnt[:, None]
-            + cn[None, :]
-            - 2.0
-            * jax.lax.dot_general(
-                xt, centers, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-        )
-        d = jnp.maximum(d, 0.0)
-        return jnp.argmin(d, 1).astype(jnp.int32), jnp.min(d, 1)
-
-    m = x.shape[0]
-    if m <= tile:
-        return tile_body((x, x_norms))
-    n_tiles = cdiv(m, tile)
-    pad = n_tiles * tile - m
-    xp = jnp.pad(x, ((0, pad), (0, 0)))
-    xnp_ = jnp.pad(x_norms, (0, pad))
-    labels, d2 = jax.lax.map(
-        tile_body, (xp.reshape(n_tiles, tile, -1), xnp_.reshape(n_tiles, tile))
-    )
-    return labels.reshape(-1)[:m], d2.reshape(-1)[:m]
+    """E-step: (labels, distance²) via the shared tiled fused-L2 kernel
+    (raft_tpu.ops.fused_l2_nn) — single implementation for kmeans, predict
+    and cluster_cost."""
+    d2, labels = _fused_l2_nn_jit(x, centers, x_norms, row_norms_sq(centers),
+                                  False, tile)
+    return labels, d2
 
 
 def _update(x, labels, old_centers):
@@ -170,17 +144,25 @@ def fit(
         raise NotImplementedError("sample_weights not yet supported")
     if params.init == InitMethod.Array and init_centers is None:
         raise ValueError("init='array' requires init_centers")
+    if init_centers is not None and params.init != InitMethod.Array:
+        raise ValueError(
+            f"init_centers given but init={params.init.value!r}; use init='array'"
+        )
     x = jnp.asarray(x, jnp.float32)
+    if params.n_clusters > x.shape[0]:
+        raise ValueError(
+            f"n_clusters={params.n_clusters} > n_rows={x.shape[0]}"
+        )
     xn = row_norms_sq(x)
     key = jax.random.key(params.seed)
-    from raft_tpu.ops.fused_l2_nn import _choose_tile
+    tile = choose_tile_rows(x.shape[0], params.n_clusters, res.workspace_limit_bytes)
 
-    tile = _choose_tile(x.shape[0], params.n_clusters, res.workspace_limit_bytes)
-
+    # Array init is deterministic — extra restarts are identical
+    n_init = 1 if params.init == InitMethod.Array else max(params.n_init, 1)
     best = None
-    for trial in range(max(params.n_init, 1)):
+    for trial in range(n_init):
         key, kt = jax.random.split(key)
-        if params.init == InitMethod.Array or init_centers is not None:
+        if params.init == InitMethod.Array:
             c0 = jnp.asarray(init_centers, jnp.float32)
         elif params.init == InitMethod.Random:
             idx = jax.random.choice(kt, x.shape[0], (params.n_clusters,), replace=False)

@@ -85,29 +85,41 @@ def _needs_normalized_centers(metric: DistanceType) -> bool:
     )
 
 
-def _predict_labels(x, centers, metric: DistanceType, active_mask=None):
-    """E-step: nearest *active* center per row; the matmul rides the MXU
-    (analog of detail::predict's minibatched fusedL2NN)."""
-    xf = x.astype(jnp.float32)
+def _predict_labels(x, centers, metric: DistanceType, active_mask=None,
+                    tile: int = 65536):
+    """E-step: nearest *active* center per row; the matmul rides the MXU,
+    tiled over rows so only [tile, n_clusters] scores exist at once (analog
+    of detail::predict's minibatched fusedL2NN)."""
     cf = centers.astype(jnp.float32)
-    dots = jax.lax.dot_general(
-        xf, cf, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
-    if metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
-        if metric == DistanceType.CosineExpanded:
-            dots = dots / jnp.maximum(
-                jnp.linalg.norm(cf, axis=1)[None, :], 1e-20
-            )
-        score = dots
+    cn = row_norms_sq(cf)
+    if metric == DistanceType.CosineExpanded:
+        c_inv_norm = 1.0 / jnp.maximum(jnp.sqrt(cn), 1e-20)
+
+    def tile_body(xt):
+        xf = xt.astype(jnp.float32)
+        dots = jax.lax.dot_general(
+            xf, cf, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded):
+            score = dots * c_inv_norm[None, :] if metric == DistanceType.CosineExpanded else dots
+            if active_mask is not None:
+                score = jnp.where(active_mask[None, :], score, -jnp.inf)
+            return jnp.argmax(score, axis=1).astype(jnp.int32)
+        d = row_norms_sq(xf)[:, None] + cn[None, :] - 2.0 * dots
         if active_mask is not None:
-            score = jnp.where(active_mask[None, :], score, -jnp.inf)
-        return jnp.argmax(score, axis=1).astype(jnp.int32)
-    d = row_norms_sq(xf)[:, None] + row_norms_sq(cf)[None, :] - 2.0 * dots
-    if active_mask is not None:
-        d = jnp.where(active_mask[None, :], d, jnp.inf)
-    return jnp.argmin(d, axis=1).astype(jnp.int32)
+            d = jnp.where(active_mask[None, :], d, jnp.inf)
+        return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+    m = x.shape[0]
+    if m <= tile:
+        return tile_body(x)
+    n_tiles = cdiv(m, tile)
+    pad = n_tiles * tile - m
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    labels = jax.lax.map(tile_body, xp.reshape(n_tiles, tile, x.shape[1]))
+    return labels.reshape(-1)[:m]
 
 
 def calc_centers_and_sizes(x, labels, n_clusters: int, weights=None

@@ -26,7 +26,7 @@ from raft_tpu.ops.distance import l2_expanded, row_norms_sq
 from raft_tpu.utils.shape import cdiv
 
 
-def _choose_tile(m: int, n: int, budget_bytes: int) -> int:
+def choose_tile_rows(m: int, n: int, budget_bytes: int) -> int:
     tile = max(1, budget_bytes // (8 * max(n, 1) * 4))
     tile = min(tile, m, 65536)
     if tile >= 128:
@@ -82,5 +82,5 @@ def fused_l2_nn_argmin(
     y = jnp.asarray(y)
     xn = row_norms_sq(x) if x_norms is None else x_norms
     yn = row_norms_sq(y) if y_norms is None else y_norms
-    tile = _choose_tile(x.shape[0], y.shape[0], res.workspace_limit_bytes)
+    tile = choose_tile_rows(x.shape[0], y.shape[0], res.workspace_limit_bytes)
     return _fused_l2_nn_jit(x, y, xn, yn, bool(sqrt), tile)

@@ -1,0 +1,124 @@
+"""IVF-Flat tests — recall against exact brute-force ground truth, the
+reference's acceptance pattern (cpp/test/neighbors/ann_ivf_flat.cuh:
+build→(serialize→load)→search, assert recall ≥ floor)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu import Resources
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((5000, 32)).astype(np.float32)
+    q = rng.standard_normal((100, 32)).astype(np.float32)
+    return db, q
+
+
+@pytest.fixture(scope="module")
+def gt(data):
+    db, q = data
+    _, idx = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    return np.asarray(idx)
+
+
+def test_build_shapes(data):
+    db, _ = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32))
+    assert index.n_lists == 32
+    assert index.size == len(db)
+    assert int(np.asarray(index.list_sizes).sum()) == len(db)
+    # balanced lists
+    sizes = np.asarray(index.list_sizes)
+    assert sizes.max() <= 4 * len(db) / 32
+
+
+@pytest.mark.parametrize("n_probes,floor", [(4, 0.4), (8, 0.6), (32, 0.999)])
+def test_recall_increases_with_probes(data, gt, n_probes, floor):
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32))
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=n_probes))
+    recall = float(neighborhood_recall(np.asarray(i), gt))
+    assert recall >= floor, f"recall {recall} < {floor} at n_probes={n_probes}"
+
+
+def test_full_probe_is_exact(data, gt):
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=16))
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
+    # distances match brute force
+    bf_d, _ = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    np.testing.assert_allclose(np.asarray(d), np.asarray(bf_d), rtol=1e-3, atol=1e-3)
+
+
+def test_inner_product(data):
+    db, q = data
+    dbn = db / np.linalg.norm(db, axis=1, keepdims=True)
+    index = ivf_flat.build(dbn, ivf_flat.IndexParams(n_lists=16, metric="inner_product"))
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=16))
+    ip = q @ dbn.T
+    want = np.argsort(-ip, 1)[:, :10]
+    assert float(neighborhood_recall(np.asarray(i), want)) >= 0.999
+
+
+def test_extend(data, gt):
+    db, q = data
+    half = len(db) // 2
+    index = ivf_flat.build(db[:half], ivf_flat.IndexParams(n_lists=32))
+    index = ivf_flat.extend(index, db[half:])
+    assert index.size == len(db)
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=32))
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
+
+
+def test_build_no_data_then_extend(data, gt):
+    db, q = data
+    params = ivf_flat.IndexParams(n_lists=32, add_data_on_build=False)
+    index = ivf_flat.build(db, params)
+    with pytest.raises(ValueError, match="no data"):
+        ivf_flat.search(index, q, 10)
+    index = ivf_flat.extend(index, db)
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=32))
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
+
+
+def test_bitset_filter(data):
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    # forbid the true top-1 of each query
+    _, bf_i = brute_force.knn(q, db, k=1, metric="sqeuclidean")
+    banned = np.unique(np.asarray(bf_i).ravel())
+    filt = Bitset.create(len(db)).set(banned, value=False)
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=16),
+                           filter=filt)
+    got = np.asarray(i)
+    assert not np.isin(got, banned).any()
+
+
+def test_serialize_roundtrip(data, gt):
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32))
+    buf = io.BytesIO()
+    ivf_flat.serialize(index, buf)
+    buf.seek(0)
+    index2 = ivf_flat.deserialize(buf)
+    d1, i1 = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=8))
+    d2, i2 = ivf_flat.search(index2, q, 10, ivf_flat.SearchParams(n_probes=8))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+def test_small_workspace_tiles(data, gt):
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32))
+    small = Resources(workspace_limit_bytes=8_000_000)
+    d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=32),
+                           res=small)
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
