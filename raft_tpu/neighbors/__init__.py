@@ -1,6 +1,14 @@
 """Neighbors layer — the ANN index suite (SURVEY.md §2.7): brute_force,
 ivf_flat, ivf_pq, cagra, nn_descent, refine, filtering."""
 
-from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.neighbors import (
+    brute_force,
+    cagra,
+    ivf_flat,
+    ivf_pq,
+    nn_descent,
+    refine,
+)
 
-__all__ = ["brute_force", "ivf_flat"]
+__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent",
+           "refine"]

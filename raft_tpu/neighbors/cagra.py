@@ -1,0 +1,461 @@
+"""CAGRA — graph-based ANN index (build + greedy graph search).
+
+Reference: ``raft::neighbors::cagra`` (neighbors/cagra.cuh:299-376; types
+cagra_types.hpp:48-189; build detail/cagra/cagra_build.cuh:43-296; graph
+pruning detail/cagra/graph_core.cuh; search plan detail/cagra/search_plan.cuh
++ single-CTA kernel detail/cagra/search_single_cta_kernel-inl.cuh).
+
+Build = (1) all-neighbors kNN graph at ``intermediate_graph_degree`` via
+IVF-PQ build+search+refine batches (cagra_build.cuh:43-160) or NN-descent
+(:241-258); (2) ``optimize``: detour-count based pruning to ``graph_degree``
+with reverse-edge augmentation (graph_core.cuh).
+
+TPU-native design:
+- **optimize** is pure gather/compare tensor algebra: the 2-hop detour count
+  of edge (i→a) is #{b<a : G[i,a] ∈ G[G[i,b]]}, computed per node tile as a
+  [tile, K, K, K] membership reduction (XLA fuses the compare+reduce; no
+  atomics), then a stable top-``graph_degree`` by (count, rank). Reverse
+  edges fill the tail slots, as in graph_core.cuh's rev-edge pass.
+- **search** replaces the CTA-resident loop + hashmap visited-set with a
+  functional beam state per query: an itopk buffer (dist, id) + a fixed-size
+  expanded-parents list (the visited set — parents are the only nodes that
+  matter for termination, mirroring search_single_cta's parent bitmask trick
+  cagra_types: itopk entries carry a "visited" flag). Each iteration:
+  pick ``search_width`` best unexpanded entries → gather their graph rows →
+  mask already-expanded/duplicate targets → batched einsum distances (MXU) →
+  merge into the buffer by a sort. Fixed ``max_iterations`` under
+  ``lax.fori_loop`` with per-query done-masking keeps it one XLA program;
+  queries batch along the leading axis (the batch analog of one CTA/query).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import (
+    DistanceType,
+    gathered_distances,
+    resolve_metric,
+)
+from raft_tpu.ops.select_k import merge_topk_dedup
+from raft_tpu.utils.shape import cdiv
+
+
+class BuildAlgo(enum.IntEnum):
+    """reference: cagra_types.hpp graph_build_algo."""
+
+    IVF_PQ = 0
+    NN_DESCENT = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """reference: cagra_types.hpp:48-63 index_params."""
+
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: BuildAlgo = BuildAlgo.NN_DESCENT
+    nn_descent_niter: int = 20
+    metric: DistanceType = DistanceType.L2Expanded
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if self.metric not in (DistanceType.L2Expanded,
+                               DistanceType.L2SqrtExpanded,
+                               DistanceType.InnerProduct):
+            raise ValueError(
+                f"cagra supports L2Expanded/L2SqrtExpanded/InnerProduct, got "
+                f"{self.metric.name}")
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """reference: cagra_types.hpp:66-116 search_params (the single-CTA-
+    relevant subset; algo/team_size dispatch is an XLA concern here)."""
+
+    itopk_size: int = 64
+    search_width: int = 1
+    max_iterations: int = 0  # 0 → auto heuristic (search_plan.cuh:31-123)
+    num_random_samplings: int = 1
+    rand_xor_mask: int = 0x128394
+
+
+class Index:
+    """dataset + fixed-degree neighbor graph (cagra_types.hpp:127-189)."""
+
+    def __init__(self, params: IndexParams, dataset, graph):
+        self.params = params
+        self.dataset = dataset  # [n, dim]
+        self.graph = graph  # [n, graph_degree] int32
+
+    @property
+    def metric(self) -> DistanceType:
+        return self.params.metric
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+
+# ------------------------------------------------------------------ optimize
+
+
+@functools.partial(jax.jit, static_argnames=("node_tile",))
+def _detour_counts_jit(graph, node_tile: int):
+    """count[i, a] = #{b < a : G[i,a] ∈ G[G[i,b]]} — 2-hop detour count
+    (functional analog of graph_core.cuh's detourable-edge counting)."""
+    n, k = graph.shape
+    n_tiles = cdiv(n, node_tile)
+    pad = n_tiles * node_tile - n
+    gp = jnp.pad(graph, ((0, pad), (0, 0)), constant_values=-1)
+    rank_lt = jnp.tril(jnp.ones((k, k), bool), k=-1)  # [a, b]: b < a
+
+    def body(gt):
+        nb = jnp.maximum(gt, 0)
+        g2 = graph[nb.reshape(-1)].reshape(-1, k, k)  # [t, b, c] 2-hop targets
+        # member[t, b, a] = G[i,a] ∈ G[G[i,b], :]
+        member = jnp.any(
+            g2[:, :, :, None] == gt[:, None, None, :], axis=2)  # [t, b, a]
+        member = member & (gt[:, None, :] >= 0) & (gt[:, :, None] >= 0)
+        counts = jnp.sum(
+            member & rank_lt.T[None, :, :], axis=1)  # sum over b < a
+        return counts.astype(jnp.int32)
+
+    if n_tiles == 1:
+        counts = body(gp)
+    else:
+        counts = jax.lax.map(
+            body, gp.reshape(n_tiles, node_tile, k)).reshape(-1, k)
+    return counts[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("out_degree",))
+def _prune_jit(graph, counts, out_degree: int):
+    """Keep the ``out_degree`` edges with the smallest (detour count, rank)
+    per node (graph_core.cuh prune pass)."""
+    n, k = graph.shape
+    # composite key: count major, original rank minor; invalid edges last
+    key = counts.astype(jnp.float32) * (k + 1) + jnp.arange(k)[None, :]
+    key = jnp.where(graph >= 0, key, jnp.inf)
+    _, sel = jax.lax.top_k(-key, out_degree)
+    sel = jnp.sort(sel, axis=1)  # preserve rank order among survivors
+    return jnp.take_along_axis(graph, sel, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_rev",))
+def _reverse_graph_jit(graph, max_rev: int):
+    """Reverse adjacency with per-node cap (graph_core.cuh rev-edge pass).
+    Collision policy: random slot, later writers win."""
+    n, d = graph.shape
+    rev = jnp.full((n, max_rev), -1, jnp.int32)
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, d))
+    # invalid edges route out of bounds (dropped) instead of hitting node 0
+    tgt = jnp.where(graph >= 0, graph, n)
+    # deterministic pseudo-random slots: Knuth multiplicative hash in uint32
+    slots = ((src.astype(jnp.uint32) * jnp.uint32(2654435761)
+              + jnp.arange(d, dtype=jnp.uint32)[None, :] * jnp.uint32(40503))
+             % jnp.uint32(max_rev)).astype(jnp.int32)
+    rev = rev.at[tgt.reshape(-1), slots.reshape(-1)].set(
+        src.reshape(-1), mode="drop")
+    return rev
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _augment_reverse_jit(pruned, rev):
+    """Replace tail slots of the pruned graph with reverse edges not already
+    present (graph_core.cuh: forward edges keep priority, reverse edges fill
+    up to half the degree)."""
+    n, d = pruned.shape
+    n_rev = rev.shape[1]
+    # dedupe reverse edges against forward ones
+    dup = jnp.any(rev[:, :, None] == pruned[:, None, :], axis=2)
+    rev = jnp.where(dup | (rev == jnp.arange(n)[:, None]), -1, rev)
+    # compact valid reverse edges to the front
+    order = jnp.argsort(rev < 0, axis=1, stable=True)
+    rev_c = jnp.take_along_axis(rev, order, axis=1)
+    n_valid = jnp.sum(rev_c >= 0, axis=1)
+    n_replace = jnp.minimum(n_valid, d // 2)  # at most half the degree
+    slot = jnp.arange(d)[None, :]
+    take_rev = slot >= (d - n_replace)[:, None]
+    rev_idx = jnp.clip(slot - (d - n_replace)[:, None], 0, n_rev - 1)
+    out = jnp.where(take_rev,
+                    jnp.take_along_axis(rev_c, rev_idx, axis=1), pruned)
+    return out
+
+
+def optimize(knn_graph, graph_degree: int,
+             res: Optional[Resources] = None) -> jax.Array:
+    """Prune an intermediate kNN graph to ``graph_degree`` (reference:
+    cagra::optimize, cagra_build.cuh:266-285 → graph_core.cuh)."""
+    res = ensure_resources(res)
+    g = jnp.asarray(knn_graph, jnp.int32)
+    n, k = g.shape
+    if graph_degree >= k:
+        return g
+    per_node = k * k * (k + 4) * 1  # membership tensor bytes (bool)
+    node_tile = int(np.clip(res.workspace_limit_bytes // max(per_node, 1),
+                            8, 4096))
+    node_tile -= node_tile % 8 or 0
+    counts = _detour_counts_jit(g, max(node_tile, 8))
+    pruned = _prune_jit(g, counts, int(graph_degree))
+    rev = _reverse_graph_jit(pruned, int(graph_degree))
+    return _augment_reverse_jit(pruned, rev)
+
+
+# --------------------------------------------------------------------- build
+
+
+def build(
+    dataset,
+    params: Optional[IndexParams] = None,
+    res: Optional[Resources] = None,
+) -> Index:
+    """Build (reference: cagra::build, cagra.cuh → cagra_build.cuh:296):
+    kNN graph at intermediate degree, then optimize to graph_degree."""
+    params = params or IndexParams()
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    k_inter = int(min(params.intermediate_graph_degree, n - 1))
+
+    if params.build_algo == BuildAlgo.NN_DESCENT:
+        from raft_tpu.neighbors import nn_descent
+
+        nd_params = nn_descent.IndexParams(
+            graph_degree=k_inter,
+            intermediate_graph_degree=min(int(k_inter * 1.5), n - 1),
+            max_iterations=params.nn_descent_niter,
+            metric=params.metric,
+        )
+        knn = nn_descent.build(dataset, nd_params, res=res).graph
+    else:
+        knn = _build_knn_graph_ivf_pq(dataset, k_inter, params, res)
+
+    graph = optimize(knn, int(min(params.graph_degree, k_inter)), res=res)
+    return Index(params, dataset, graph)
+
+
+def _build_knn_graph_ivf_pq(dataset, k_inter: int, params: IndexParams,
+                            res: Resources) -> jax.Array:
+    """IVF-PQ path (cagra_build.cuh:43-160): build ivf_pq on the dataset,
+    batched self-search for top (k_inter+1), refine with exact distances,
+    drop self."""
+    from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+    from raft_tpu.neighbors import refine as refine_mod
+
+    n, dim = dataset.shape
+    n_lists = int(np.clip(int(np.sqrt(n) * 2), 16, 8192))
+    n_lists = min(n_lists, max(n // 64, 16))
+    ipq = ivf_pq_mod.IndexParams(
+        n_lists=n_lists,
+        metric=(DistanceType.L2Expanded
+                if params.metric != DistanceType.InnerProduct
+                else DistanceType.InnerProduct),
+        pq_dim=max(8, (dim // 2 + 7) // 8 * 8),
+    )
+    index = ivf_pq_mod.build(dataset, ipq, res=res)
+    top = k_inter + 1
+    sp = ivf_pq_mod.SearchParams(n_probes=max(min(n_lists, 32), n_lists // 16))
+    graph = np.zeros((n, k_inter), np.int32)
+    batch = 8192
+    for s in range(0, n, batch):
+        q = dataset[s : s + batch]
+        _, cand = ivf_pq_mod.search(index, q, min(top * 2, n), sp, res=res)
+        _, refined = refine_mod.refine(dataset, q, cand, top,
+                                       metric=params.metric, res=res)
+        r = np.asarray(refined)
+        # drop self where present, else drop last — vectorized: push the
+        # self id (or the last slot) past everything with a stable argsort
+        rows = np.arange(len(r))
+        is_self = r == (rows + s)[:, None]
+        drop = np.where(is_self.any(1)[:, None], is_self,
+                        np.arange(r.shape[1])[None, :] == r.shape[1] - 1)
+        order = np.argsort(drop, axis=1, kind="stable")
+        keep = np.take_along_axis(r, order, axis=1)[:, :k_inter]
+        graph[s : s + batch] = keep.astype(np.int32)
+    return jnp.asarray(graph)
+
+
+# -------------------------------------------------------------------- search
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("metric", "k", "itopk", "width", "max_iter"),
+)
+def _search_jit(queries, dataset, graph, seed_ids, metric: DistanceType,
+                k: int, itopk: int, width: int, max_iter: int):
+    nq, dim = queries.shape
+    n, degree = graph.shape
+    minimize = metric != DistanceType.InnerProduct
+    bad = jnp.inf
+
+    qf = queries.astype(jnp.float32)
+    # distances are minimized internally; IP negates, L2Sqrt defers the sqrt
+    inner_metric = (DistanceType.L2Expanded
+                    if metric == DistanceType.L2SqrtExpanded else metric)
+
+    def dists_to(ids):  # ids [nq, C] → [nq, C] (minimized quantity)
+        vecs = dataset[jnp.maximum(ids, 0)]
+        d = gathered_distances(qf, vecs, inner_metric)
+        if metric == DistanceType.InnerProduct:
+            d = -d
+        return jnp.where(ids < 0, bad, d)
+
+    # ---- init: random seed nodes (random_samplings, search_plan.cuh)
+    init_ids = seed_ids  # [nq, S]
+    init_d = dists_to(init_ids)
+    buf_size = itopk + width * degree
+    pad_n = buf_size - init_ids.shape[1]
+    buf_ids = jnp.pad(init_ids, ((0, 0), (0, pad_n)), constant_values=-1)
+    buf_d = jnp.pad(init_d, ((0, 0), (0, pad_n)), constant_values=bad)
+    # expanded-parents list = visited set (parents only, like the reference's
+    # parent bitmask; capacity = width per iteration)
+    exp_cap = max(width * max_iter, 1)
+    expanded = jnp.full((nq, exp_cap), -1, jnp.int32)
+
+    buf_ids, buf_d = merge_topk_dedup(buf_ids, buf_d, itopk)
+
+    def body(it, state):
+        buf_ids, buf_d, expanded, done = state
+        # pickup_next_parents: best `width` unexpanded buffer entries
+        is_exp = jnp.any(
+            buf_ids[:, :, None] == expanded[:, None, :], axis=2)
+        cand_d = jnp.where(is_exp | (buf_ids < 0), bad, buf_d)
+        p_d, p_sel = jax.lax.top_k(-cand_d, width)
+        parents = jnp.take_along_axis(buf_ids, p_sel, axis=1)  # [nq, W]
+        has_parent = jnp.isfinite(-p_d[:, 0])
+        newly_done = ~has_parent
+        parents = jnp.where((parents < 0) | newly_done[:, None] | done[:, None],
+                            -1, parents)
+
+        # mark parents expanded
+        expanded = jax.lax.dynamic_update_slice(
+            expanded, parents, (0, it * width))
+
+        # expand: gather graph rows of parents
+        targets = graph[jnp.maximum(parents, 0)].reshape(-1, width * degree)
+        targets = jnp.where(
+            jnp.repeat(parents < 0, degree, axis=1), -1, targets)
+        # drop targets already expanded
+        t_exp = jnp.any(
+            targets[:, :, None] == expanded[:, None, :], axis=2)
+        targets = jnp.where(t_exp, -1, targets)
+        t_d = dists_to(targets)
+
+        new_ids = jnp.concatenate([buf_ids, targets], axis=1)
+        new_d = jnp.concatenate([buf_d, t_d], axis=1)
+        nb_ids, nb_d = merge_topk_dedup(new_ids, new_d, itopk)
+        # frozen queries keep their state
+        keep = done[:, None]
+        buf_ids = jnp.where(keep, buf_ids, nb_ids)
+        buf_d = jnp.where(keep, buf_d, nb_d)
+        done = done | newly_done
+        return buf_ids, buf_d, expanded, done
+
+    done0 = jnp.zeros((nq,), bool)
+    buf_ids, buf_d, expanded, _ = jax.lax.fori_loop(
+        0, max_iter, body, (buf_ids, buf_d, expanded, done0))
+
+    out_d, out_i = buf_d[:, :k], buf_ids[:, :k]
+    if metric == DistanceType.InnerProduct:
+        out_d = -out_d
+    elif metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+    return out_d, out_i
+
+
+def search(
+    index: Index,
+    queries,
+    k: int,
+    params: Optional[SearchParams] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy graph search (reference: cagra::search, cagra.cuh:299 →
+    search_single_cta_kernel-inl.cuh). Returns (distances, indices)."""
+    params = params or SearchParams()
+    res = ensure_resources(res)
+    queries = jnp.asarray(queries)
+    if queries.ndim == 1:
+        queries = queries[None]
+    if queries.shape[1] != index.dim:
+        raise ValueError(
+            f"query dim {queries.shape[1]} != index dim {index.dim}")
+    itopk = max(int(params.itopk_size), k)
+    width = max(int(params.search_width), 1)
+    max_iter = int(params.max_iterations)
+    if max_iter <= 0:
+        # auto heuristic (search_plan.cuh:31-123): enough hops to drain the
+        # itopk buffer, bounded
+        max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    n_rand = max(int(params.num_random_samplings), 1)
+    buf_size = itopk + width * index.graph_degree
+    n_seeds = min(max(itopk, n_rand * 16), index.size, buf_size)
+    # deterministic pseudo-random seeds per query (rand_xor_mask analog)
+    key = jax.random.fold_in(jax.random.key(params.rand_xor_mask & 0x7FFFFFFF),
+                             queries.shape[0])
+    seed_ids = jax.random.randint(
+        key, (queries.shape[0], n_seeds), 0, index.size, jnp.int32)
+    return _search_jit(
+        queries, index.dataset, index.graph, seed_ids, index.metric, int(k),
+        itopk, width, max_iter)
+
+
+_SERIAL_VERSION = 1
+
+
+def serialize(index: Index, file, include_dataset: bool = True) -> None:
+    """reference: detail/cagra/cagra_serialize.cuh."""
+    stream, close = ser.open_for(file, "wb")
+    try:
+        w = ser.IndexWriter(stream, "cagra", _SERIAL_VERSION)
+        w.scalar(int(index.metric), "<i4")
+        w.scalar(index.graph_degree, "<i4")
+        w.scalar(1 if include_dataset else 0, "<i4")
+        w.array(index.graph)
+        if include_dataset:
+            w.array(index.dataset)
+    finally:
+        if close:
+            stream.close()
+
+
+def deserialize(file, dataset=None, res: Optional[Resources] = None) -> Index:
+    ensure_resources(res)
+    stream, close = ser.open_for(file, "rb")
+    try:
+        r = ser.IndexReader(stream, "cagra", _SERIAL_VERSION)
+        metric = DistanceType(r.scalar())
+        graph_degree = r.scalar()
+        has_ds = bool(r.scalar())
+        graph = jnp.asarray(r.array())
+        if has_ds:
+            ds = jnp.asarray(r.array())
+        elif dataset is not None:
+            ds = jnp.asarray(dataset)
+        else:
+            raise ValueError(
+                "index file has no dataset; pass dataset= to deserialize")
+        params = IndexParams(graph_degree=graph_degree, metric=metric)
+        return Index(params, ds, graph)
+    finally:
+        if close:
+            stream.close()

@@ -214,13 +214,11 @@ def _coarse_scores(queries, centers, metric: DistanceType):
     return qn[:, None] + cn[None, :] - 2.0 * dots, True
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter"),
-)
-def _search_jit(queries, centers, list_data, list_indices, list_sizes,
-                filter_words, metric: DistanceType, k: int, n_probes: int,
-                q_tile: int, has_filter: bool):
+def _search_core(queries, centers, list_data, list_indices, list_sizes,
+                 filter_words, metric: DistanceType, k: int, n_probes: int,
+                 q_tile: int, has_filter: bool):
+    """Traceable search body — jitted below; also shard_mapped by
+    raft_tpu.parallel.sharded for multi-device list-sharded search."""
     nq, dim = queries.shape
     n_lists, list_pad, _ = list_data.shape
     minimize = metric != DistanceType.InnerProduct
@@ -292,6 +290,12 @@ def _search_jit(queries, centers, list_data, list_indices, list_sizes,
         vals = vals.reshape(-1, k)
         idxs = idxs.reshape(-1, k)
     return vals[:nq], idxs[:nq]
+
+
+_search_jit = jax.jit(
+    _search_core,
+    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter"),
+)
 
 
 def search(

@@ -1,0 +1,706 @@
+"""IVF-PQ — inverted-file index with product-quantized residuals.
+
+Reference: ``raft::neighbors::ivf_pq`` (neighbors/ivf_pq-inl.cuh:115-480;
+types ivf_pq_types.hpp:48-146; build detail/ivf_pq_build.cuh:1732; search
+detail/ivf_pq_search.cuh). Build: subsample trainset → balanced k-means
+coarse clustering → random-orthonormal rotation (normal + QR,
+detail/ivf_pq_build.cuh:121-137) → PQ codebooks per-subspace or per-cluster
+(each trained by balanced k-means on residual sub-vectors,
+detail/ivf_pq_build.cuh:394,471) → encode + bit-pack all vectors into
+per-cluster lists (process_and_fill_codes, detail/ivf_pq_build.cuh:1185).
+Search: coarse top-``n_probes`` via gemm + select_k (select_clusters,
+detail/ivf_pq_search.cuh:69-155) → per query×probe look-up-table (LUT) scan
+of packed codes with fp32/fp16/fp8 LUTs (detail/ivf_pq_compute_similarity)
+→ final select_k → postprocess.
+
+TPU-native design:
+- **Storage**: padded dense ``[n_lists, list_pad, n_code_bytes]`` uint8 of
+  bit-packed codes (pq_bits ∈ [4,8], invariant pq_dim·pq_bits ≡ 0 mod 8 —
+  ivf_pq_types.hpp:538-545) + int32 row ids. Lane-aligned padding instead of
+  the GPU's interleaved group-of-32 layout.
+- **LUT build is a batched matmul** (MXU): for each query×probe the LUT is
+  ``||q_sub − codebook||²`` expanded into norms + one einsum over
+  [pq_dim, book_size, pq_len] — the analog of the shared-memory LUT fill.
+- **Code scan**: static two-byte gathers unpack pq_bits codes from the byte
+  stream (each code spans ≤ 2 bytes); scores come from a flat LUT gather and
+  a sum over subspaces. ``lut_dtype``/``internal_distance_dtype`` map to
+  fp32/bf16 (fp8 LUTs are emulated with bf16 — TPUs have no fp8 gather win).
+- **Codebook training**: one jitted Lloyd-EM body ``lax.map``-ed across
+  subspaces (PER_SUBSPACE) or across clusters (PER_CLUSTER), trained on
+  rotated residuals, weights masking ragged membership — one compile serves
+  all groups.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
+from raft_tpu.ops.select_k import select_k
+from raft_tpu.ops import rng as rrng
+from raft_tpu.utils.shape import cdiv, round_up_to
+
+
+class CodebookGen(enum.IntEnum):
+    """reference: ivf_pq_types.hpp codebook_gen."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass
+class IndexParams:
+    """reference: ivf_pq_types.hpp:48-108 index_params."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8
+    pq_dim: int = 0  # 0 → heuristic (see _calc_pq_dim)
+    codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
+    force_random_rotation: bool = False
+    add_data_on_build: bool = True
+
+    def __post_init__(self):
+        self.metric = resolve_metric(self.metric)
+        if not 4 <= self.pq_bits <= 8:
+            raise ValueError(f"pq_bits must be in [4, 8], got {self.pq_bits}")
+        if self.metric not in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.InnerProduct,
+        ):
+            raise ValueError(
+                f"ivf_pq supports L2Expanded/L2SqrtExpanded/InnerProduct, got "
+                f"{self.metric.name}"
+            )
+
+
+@dataclasses.dataclass
+class SearchParams:
+    """reference: ivf_pq_types.hpp:110-146 search_params. ``lut_dtype`` /
+    ``internal_distance_dtype`` accept jnp.float32 or jnp.bfloat16 (the
+    reference's fp16/fp8 LUT compression maps to bf16 on TPU)."""
+
+    n_probes: int = 20
+    lut_dtype: object = jnp.float32
+    internal_distance_dtype: object = jnp.float32
+
+
+def _calc_pq_dim(dim: int) -> int:
+    """Heuristic default pq_dim (analog of the reference's calculate_pq_dim:
+    a power of two close to dim/2, at least 8)."""
+    p = 1
+    while p * 2 <= dim // 2 or p < 8:
+        p *= 2
+        if p >= 512:
+            break
+    return max(min(p, dim + (-dim) % 8), 8)
+
+
+class Index:
+    """IVF-PQ index (reference: ivf_pq_types.hpp:149-560 — coarse centers,
+    rotation matrix, codebooks, packed per-list codes + ids)."""
+
+    def __init__(self, params: IndexParams, pq_dim: int, centers, rotation,
+                 codebooks, list_codes, list_indices, list_sizes, n_rows: int):
+        self.params = params
+        self.pq_dim = int(pq_dim)
+        self.centers = centers  # [n_lists, dim] fp32
+        self.rotation = rotation  # [rot_dim, dim] fp32 (orthonormal columns)
+        # codebooks: PER_SUBSPACE [pq_dim, book, pq_len]
+        #            PER_CLUSTER  [n_lists, book, pq_len]
+        self.codebooks = codebooks
+        self.list_codes = list_codes  # [n_lists, list_pad, n_code_bytes] u8
+        self.list_indices = list_indices  # [n_lists, list_pad] int32, -1 pad
+        self.list_sizes = list_sizes  # [n_lists] int32
+        self.n_rows = int(n_rows)
+
+    @property
+    def metric(self) -> DistanceType:
+        return self.params.metric
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_bits(self) -> int:
+        return self.params.pq_bits
+
+    @property
+    def pq_len(self) -> int:
+        return self.rot_dim // self.pq_dim
+
+    @property
+    def pq_book_size(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def size(self) -> int:
+        return self.n_rows
+
+    @property
+    def centers_rot(self) -> jax.Array:
+        return jnp.matmul(self.centers, self.rotation.T,
+                          precision=jax.lax.Precision.HIGHEST)
+
+
+# ------------------------------------------------------------- rotation matrix
+
+
+def make_rotation_matrix(key, rot_dim: int, dim: int,
+                         force_random: bool) -> jax.Array:
+    """[rot_dim, dim] with orthonormal columns (reference:
+    detail/ivf_pq_build.cuh:121-137 — random normal + in-place QR when
+    force_random or rot_dim != dim, else identity)."""
+    if not force_random and rot_dim == dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    if not force_random:
+        # dim-padding only: identity embedding keeps exactness
+        return jnp.eye(rot_dim, dim, dtype=jnp.float32)
+    a = jax.random.normal(key, (rot_dim, rot_dim), jnp.float32)
+    q, _ = jnp.linalg.qr(a)
+    return q[:, :dim]
+
+
+# --------------------------------------------------------- codebook training
+
+
+def _codebook_em(subvecs, weights, book_size: int, n_iters: int, key):
+    """Lloyd EM for one codebook: subvecs [n, l], weights [n] (0 = padding).
+    Empty codes re-seed from a pseudo-random weighted row (the balancing
+    analog of kmeans_balanced's adjust_centers for tiny codebook fits)."""
+    n, l = subvecs.shape
+
+    def m_step(labels):
+        w = weights
+        sums = jnp.zeros((book_size, l), jnp.float32).at[labels].add(
+            subvecs * w[:, None])
+        counts = jnp.zeros((book_size,), jnp.float32).at[labels].add(w)
+        return sums, counts
+
+    def body(i, state):
+        centers, _ = state
+        cn = jnp.sum(centers * centers, -1)
+        d = cn[None, :] - 2.0 * jnp.matmul(
+            subvecs, centers.T, precision=jax.lax.Precision.HIGHEST)
+        # (+ ||x||², rank-invariant)
+        labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+        sums, counts = m_step(labels)
+        new = sums / jnp.maximum(counts, 1.0)[:, None]
+        # re-seed empty codes from rows offset by the code id (deterministic)
+        donor = jax.random.randint(jax.random.fold_in(key, i), (book_size,), 0, n)
+        empty = counts < 0.5
+        new = jnp.where(empty[:, None], subvecs[donor], new)
+        return new, labels
+
+    # init: ``book_size`` distinct (weight>0) data rows via Gumbel top-k —
+    # the data-point seeding that keeps Lloyd from collapsing to the mean.
+    # Trainsets smaller than the book reuse rows cyclically.
+    g = jax.random.gumbel(jax.random.fold_in(key, n_iters + 1), (n,))
+    g = jnp.where(weights > 0, g, -jnp.inf)
+    _, seed_rows = jax.lax.top_k(g, min(book_size, n))
+    if n < book_size:
+        seed_rows = jnp.tile(seed_rows, cdiv(book_size, n))[:book_size]
+    centers0 = subvecs[seed_rows]
+    labels0 = jnp.zeros((n,), jnp.int32)
+    centers, _ = jax.lax.fori_loop(
+        0, n_iters, body, (centers0, labels0))
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("book_size", "n_iters"))
+def _train_codebooks_jit(keys, subvecs, weights, book_size: int, n_iters: int):
+    """subvecs [G, n, l], weights [G, n] → codebooks [G, book, l]; sequential
+    over groups (one compile), each EM internally vectorized."""
+
+    def one(args):
+        key, sv, w = args
+        return _codebook_em(sv, w, book_size, n_iters, key)
+
+    return jax.lax.map(one, (keys, subvecs, weights))
+
+
+# ----------------------------------------------------------- code (un)packing
+
+
+def _pack_codes_np(codes: np.ndarray, pq_bits: int) -> np.ndarray:
+    """Bit-pack [n, pq_dim] uint8 codes → [n, pq_dim*pq_bits/8] bytes
+    (little-endian bit order; analog of process_and_fill_codes' packing,
+    detail/ivf_pq_build.cuh:1185-1351)."""
+    n, pq_dim = codes.shape
+    bits = (codes[:, :, None] >> np.arange(pq_bits, dtype=np.uint8)) & 1
+    flat = bits.reshape(n, pq_dim * pq_bits)
+    return np.packbits(flat, axis=1, bitorder="little")
+
+
+def _unpack_positions(pq_dim: int, pq_bits: int):
+    """Static per-subspace (lo_byte, hi_byte, shift) for two-byte unpack."""
+    pos = np.arange(pq_dim) * pq_bits
+    lo = pos // 8
+    sh = pos % 8
+    n_bytes = pq_dim * pq_bits // 8
+    hi = np.minimum(lo + 1, n_bytes - 1)
+    return jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(sh)
+
+
+def _unpack_codes(code_bytes: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
+    """[..., n_bytes] uint8 → [..., pq_dim] int32 codes. Each pq_bits field
+    spans ≤ 2 bytes; static gathers keep this a pure vector op."""
+    lo, hi, sh = _unpack_positions(pq_dim, pq_bits)
+    b = code_bytes.astype(jnp.int32)
+    lo_b = jnp.take(b, lo, axis=-1)
+    hi_b = jnp.take(b, hi, axis=-1)
+    word = lo_b | (hi_b << 8)
+    return (word >> sh) & ((1 << pq_bits) - 1)
+
+
+# ----------------------------------------------------------------- encoding
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster", "row_tile"))
+def _encode_jit(x, labels, centers, rotation, codebooks, per_cluster: bool,
+                row_tile: int):
+    """Residual-encode rows → int32 codes [n, pq_dim]."""
+    n, dim = x.shape
+    pq_len = codebooks.shape[2]
+    pq_dim = rotation.shape[0] // pq_len
+
+    n_tiles = cdiv(n, row_tile)
+    pad = n_tiles * row_tile - n
+    xp = jnp.pad(x.astype(jnp.float32), ((0, pad), (0, 0)))
+    lp = jnp.pad(labels, (0, pad))
+
+    def tile_body(args):
+        xt, lt = args
+        res = xt - centers[lt]
+        rr = jax.lax.dot_general(
+            res, rotation, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [t, rot_dim]
+        sub = rr.reshape(-1, pq_dim, pq_len)  # [t, s, l]
+        if per_cluster:
+            cb = codebooks[lt]  # [t, book, l]
+            dots = jnp.einsum("tsl,tcl->tsc", sub, cb,
+                              preferred_element_type=jnp.float32)
+            cn = jnp.sum(cb * cb, -1)  # [t, book]
+            d = cn[:, None, :] - 2.0 * dots
+        else:
+            dots = jnp.einsum("tsl,scl->tsc", sub, codebooks,
+                              preferred_element_type=jnp.float32)
+            cn = jnp.sum(codebooks * codebooks, -1)  # [s, book]
+            d = cn[None, :, :] - 2.0 * dots
+        return jnp.argmin(d, axis=-1).astype(jnp.int32)  # [t, s]
+
+    codes = jax.lax.map(
+        tile_body,
+        (xp.reshape(n_tiles, row_tile, dim), lp.reshape(n_tiles, row_tile)),
+    )
+    return codes.reshape(-1, pq_dim)[:n]
+
+
+def _pack_lists_np(code_bytes: np.ndarray, labels: np.ndarray, n_lists: int,
+                   ids: np.ndarray):
+    """Group packed code rows by cluster into padded list storage."""
+    n_rows, n_bytes = code_bytes.shape
+    order = np.argsort(labels, kind="stable")
+    sizes = np.bincount(labels, minlength=n_lists).astype(np.int32)
+    pad = max(int(round_up_to(max(int(sizes.max()), 1), 8)), 8)
+    data = np.zeros((n_lists, pad, n_bytes), np.uint8)
+    idxs = np.full((n_lists, pad), -1, np.int32)
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    sc = code_bytes[order]
+    si = ids[order]
+    for l in range(n_lists):
+        s, e = starts[l], starts[l + 1]
+        data[l, : e - s] = sc[s:e]
+        idxs[l, : e - s] = si[s:e]
+    return data, idxs, sizes
+
+
+# --------------------------------------------------------------------- build
+
+
+def build(
+    dataset,
+    params: Optional[IndexParams] = None,
+    res: Optional[Resources] = None,
+) -> Index:
+    """Build the index (reference: ivf_pq::build, ivf_pq-inl.cuh:273 →
+    detail/ivf_pq_build.cuh:1732)."""
+    params = params or IndexParams()
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    n_rows, dim = dataset.shape
+    if params.n_lists > n_rows:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n_rows}")
+
+    pq_dim = params.pq_dim or _calc_pq_dim(dim)
+    if (pq_dim * params.pq_bits) % 8 != 0:
+        raise ValueError(
+            f"pq_dim*pq_bits must be a multiple of 8 "
+            f"(got {pq_dim}*{params.pq_bits}); see ivf_pq_types.hpp:538-545"
+        )
+    pq_len = cdiv(dim, pq_dim)
+    rot_dim = pq_len * pq_dim
+
+    # trainset subsample (detail/ivf_pq_build.cuh:1759)
+    n_train = max(int(n_rows * params.kmeans_trainset_fraction), params.n_lists)
+    n_train = min(n_train, n_rows)
+    trainset = rrng.subsample_rows(res.next_key(), dataset, n_train)
+    trainset = trainset.astype(jnp.float32)
+
+    # coarse quantizer
+    km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                              metric=params.metric)
+    centers = kmeans_balanced.fit(res.next_key(), trainset, params.n_lists,
+                                  km, res=res)
+
+    rotation = make_rotation_matrix(res.next_key(), rot_dim, dim,
+                                    params.force_random_rotation)
+
+    # residuals of the trainset, rotated
+    labels = kmeans_balanced.predict(centers, trainset, km, res=res)
+    residuals = jnp.matmul(trainset - centers[labels], rotation.T,
+                           precision=jax.lax.Precision.HIGHEST)
+
+    book = 1 << params.pq_bits
+    if params.codebook_kind == CodebookGen.PER_SUBSPACE:
+        # [pq_dim groups] × (subvectors of every training row)
+        sub = jnp.transpose(
+            residuals.reshape(n_train, pq_dim, pq_len), (1, 0, 2)
+        )  # [G=pq_dim, n_train, pq_len]
+        w = jnp.ones((pq_dim, n_train), jnp.float32)
+        keys = jax.random.split(res.next_key(), pq_dim)
+        codebooks = _train_codebooks_jit(keys, sub, w, book,
+                                         params.kmeans_n_iters)
+    else:
+        # group training residuals per coarse cluster (ragged → padded)
+        labels_np = np.asarray(labels)
+        res_np = np.asarray(residuals)
+        sizes = np.bincount(labels_np, minlength=params.n_lists)
+        cap = max(int(min(sizes.max(), max(2 * n_train // params.n_lists, book))), book)
+        grouped = np.zeros((params.n_lists, cap, rot_dim), np.float32)
+        weights = np.zeros((params.n_lists, cap), np.float32)
+        for l in range(params.n_lists):
+            members = np.nonzero(labels_np == l)[0][:cap]
+            grouped[l, : len(members)] = res_np[members]
+            weights[l, : len(members)] = 1.0
+        # pool subspace positions: codebook shared across subspaces
+        sub = jnp.asarray(grouped).reshape(params.n_lists, cap * pq_dim, pq_len)
+        w = jnp.repeat(jnp.asarray(weights), pq_dim, axis=1)
+        keys = jax.random.split(res.next_key(), params.n_lists)
+        codebooks = _train_codebooks_jit(keys, sub, w, book,
+                                         params.kmeans_n_iters)
+
+    index = Index(params, pq_dim, centers, rotation, codebooks,
+                  None, None, None, 0)
+    if params.add_data_on_build:
+        index = extend(index, dataset, res=res)
+    return index
+
+
+def extend(index: Index, new_vectors, new_indices=None,
+           res: Optional[Resources] = None) -> Index:
+    """Encode + add vectors (reference: ivf_pq::extend, ivf_pq-inl.cuh:355 →
+    detail/ivf_pq_build.cuh:1653)."""
+    res = ensure_resources(res)
+    new_vectors = jnp.asarray(new_vectors).astype(jnp.float32)
+    km = KMeansBalancedParams(metric=index.metric)
+    labels = kmeans_balanced.predict(index.centers, new_vectors, km, res=res)
+
+    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
+    row_tile = int(np.clip(
+        res.workspace_limit_bytes //
+        max(index.pq_dim * index.pq_book_size * 4 * 4, 1), 8, 4096))
+    row_tile -= row_tile % 8 or 0
+    codes = _encode_jit(new_vectors, labels, index.centers, index.rotation,
+                        index.codebooks, per_cluster, max(row_tile, 8))
+    code_bytes = _pack_codes_np(np.asarray(codes).astype(np.uint8),
+                                index.pq_bits)
+
+    labels_np = np.asarray(labels)
+    if new_indices is None:
+        base = index.n_rows
+        if index.list_indices is not None:
+            base = max(base, int(np.asarray(index.list_indices).max()) + 1)
+        new_ids = np.arange(base, base + len(code_bytes), dtype=np.int32)
+    else:
+        new_ids = np.asarray(new_indices, np.int32)
+
+    if index.list_codes is None:
+        data, idxs, sizes = _pack_lists_np(code_bytes, labels_np,
+                                           index.n_lists, new_ids)
+        n_rows = len(code_bytes)
+    else:
+        old_codes = np.asarray(index.list_codes)
+        old_idx = np.asarray(index.list_indices)
+        old_sizes = np.asarray(index.list_sizes)
+        rows, ids, labs = [], [], []
+        for l in range(index.n_lists):
+            s = int(old_sizes[l])
+            if s:
+                rows.append(old_codes[l, :s])
+                ids.append(old_idx[l, :s])
+                labs.append(np.full(s, l, np.int32))
+        rows.append(code_bytes)
+        ids.append(new_ids)
+        labs.append(labels_np)
+        data, idxs, sizes = _pack_lists_np(
+            np.concatenate(rows), np.concatenate(labs), index.n_lists,
+            np.concatenate(ids))
+        n_rows = index.n_rows + len(code_bytes)
+    return Index(index.params, index.pq_dim, index.centers, index.rotation,
+                 index.codebooks, jnp.asarray(data), jnp.asarray(idxs),
+                 jnp.asarray(sizes), n_rows)
+
+
+# --------------------------------------------------------------------- search
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("metric", "k", "n_probes", "q_tile", "per_cluster",
+                     "pq_dim", "pq_bits", "has_filter", "lut_dtype",
+                     "dist_dtype"),
+)
+def _search_jit(queries, centers, rotation, codebooks, list_codes,
+                list_indices, list_sizes, filter_words,
+                metric: DistanceType, k: int, n_probes: int, q_tile: int,
+                per_cluster: bool, pq_dim: int, pq_bits: int,
+                has_filter: bool, lut_dtype, dist_dtype):
+    nq, dim = queries.shape
+    n_lists, list_pad, _ = list_codes.shape
+    pq_len = codebooks.shape[2]
+    book = codebooks.shape[1]
+    minimize = metric != DistanceType.InnerProduct
+
+    n_q_tiles = cdiv(nq, q_tile)
+    pad_q = n_q_tiles * q_tile - nq
+    qp = jnp.pad(queries.astype(jnp.float32), ((0, pad_q), (0, 0)))
+
+    centers_rot = jax.lax.dot_general(
+        centers, rotation, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [n_lists, rot_dim]
+    cb_norms = jnp.sum(codebooks.astype(jnp.float32) ** 2, -1)  # [G, book]
+    valid_slot = jnp.arange(list_pad)[None, :] < list_sizes[:, None]
+
+    def q_body(qt):
+        # ---- coarse cluster selection (select_clusters,
+        # detail/ivf_pq_search.cuh:69-155)
+        q_rot = jax.lax.dot_general(
+            qt, rotation, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [t, rot_dim]
+        dots_c = jax.lax.dot_general(
+            q_rot, centers_rot, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if metric == DistanceType.InnerProduct:
+            coarse = dots_c
+            _, probes = select_k(coarse, n_probes, select_min=False)
+        else:
+            cn = jnp.sum(centers_rot * centers_rot, -1)
+            coarse = cn[None, :] - 2.0 * dots_c  # + ||q||² (rank-invariant)
+            _, probes = select_k(coarse, n_probes, select_min=True)
+        # [t, P]
+
+        # ---- LUT per (query, probe): [t, P, pq_dim, book]
+        qr_res = q_rot[:, None, :] - centers_rot[probes]  # [t, P, rot]
+        if metric == DistanceType.InnerProduct:
+            qr_res = jnp.broadcast_to(q_rot[:, None, :], qr_res.shape)
+        sub = qr_res.reshape(qt.shape[0], n_probes, pq_dim, pq_len)
+        if per_cluster:
+            cb_p = codebooks[probes]  # [t, P, book, l]
+            dots = jnp.einsum("tpsl,tpcl->tpsc", sub, cb_p,
+                              preferred_element_type=jnp.float32)
+            cbn = cb_norms[probes][:, :, None, :]  # [t, P, 1, book]
+        else:
+            dots = jnp.einsum("tpsl,scl->tpsc", sub, codebooks,
+                              preferred_element_type=jnp.float32)
+            cbn = cb_norms[None, None, :, :]  # [1, 1, s, book]
+        if metric == DistanceType.InnerProduct:
+            # score = q·center + Σ_s q_sub·cb[code_s]
+            lut = dots
+            base = jnp.take_along_axis(
+                dots_c, probes, axis=1)  # [t, P] — q·center term
+        else:
+            # ||q−center−decode||² = ||q_res||² − 2 q_res·cb + ||cb||²
+            qn = jnp.sum(qr_res * qr_res, -1)  # [t, P]
+            lut = cbn - 2.0 * dots
+            base = qn
+        lut = lut.astype(lut_dtype)
+
+        # ---- gather probed lists and scan codes
+        g_codes = list_codes[probes]  # [t, P, pad, n_bytes] u8
+        g_idx = list_indices[probes]  # [t, P, pad]
+        g_valid = valid_slot[probes]
+        codes = _unpack_codes(g_codes, pq_dim, pq_bits)  # [t,P,pad,s]
+        # flat-LUT gather: score contribution LUT[t,P,s,code]
+        flat_lut = lut.reshape(qt.shape[0], n_probes, pq_dim * book)
+        gidx = codes + (jnp.arange(pq_dim) * book)[None, None, None, :]
+        contrib = jnp.take_along_axis(
+            flat_lut[:, :, None, :].astype(dist_dtype),
+            gidx.reshape(qt.shape[0], n_probes, list_pad * pq_dim)[:, :, None, :],
+            axis=-1,
+        ).reshape(qt.shape[0], n_probes, list_pad, pq_dim)
+        d = jnp.sum(contrib.astype(dist_dtype), axis=-1).astype(jnp.float32)
+        d = d + base[:, :, None]
+
+        bad_fill = jnp.inf if minimize else -jnp.inf
+        ok = g_valid
+        if has_filter:
+            safe_ids = jnp.maximum(g_idx, 0)
+            words = filter_words[safe_ids // 32]
+            bits = ((words >> (safe_ids % 32).astype(jnp.uint32)) & 1).astype(bool)
+            ok = ok & bits
+        d = jnp.where(ok, d, bad_fill)
+
+        n_cand = n_probes * list_pad
+        flat_d = d.reshape(qt.shape[0], n_cand)
+        flat_i = g_idx.reshape(qt.shape[0], n_cand)
+        kk = min(k, n_cand)
+        v, sel = select_k(flat_d, kk, select_min=minimize)
+        i_out = jnp.take_along_axis(flat_i, sel, axis=1)
+        if kk < k:
+            v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=bad_fill)
+            i_out = jnp.pad(i_out, ((0, 0), (0, k - kk)), constant_values=-1)
+        if metric == DistanceType.L2SqrtExpanded:
+            v = jnp.sqrt(jnp.maximum(v, 0.0))
+        return v, i_out
+
+    if n_q_tiles == 1:
+        vals, idxs = q_body(qp)
+    else:
+        vals, idxs = jax.lax.map(q_body, qp.reshape(n_q_tiles, q_tile, dim))
+        vals = vals.reshape(-1, k)
+        idxs = idxs.reshape(-1, k)
+    return vals[:nq], idxs[:nq]
+
+
+def search(
+    index: Index,
+    queries,
+    k: int,
+    params: Optional[SearchParams] = None,
+    filter: Optional[Bitset] = None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:480). Distances for
+    L2 metrics exclude nothing — they are the full ADC approximation; indices
+    are source row ids, -1 where fewer than k candidates were probed."""
+    params = params or SearchParams()
+    res = ensure_resources(res)
+    if index.list_codes is None:
+        raise ValueError("index has no data; call extend() first")
+    queries = jnp.asarray(queries)
+    if queries.shape[1] != index.dim:
+        raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    n_probes = int(min(params.n_probes, index.n_lists))
+    list_pad = index.list_codes.shape[1]
+    # workspace: LUT [t,P,s,book] fp32 + gathered codes [t,P,pad,bytes]
+    per_q = n_probes * (index.pq_dim * index.pq_book_size * 4
+                        + list_pad * (index.pq_dim * 4 + 16))
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 256))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
+    return _search_jit(
+        queries, index.centers, index.rotation, index.codebooks,
+        index.list_codes, index.list_indices, index.list_sizes,
+        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
+        index.metric, int(k), n_probes, q_tile, per_cluster,
+        index.pq_dim, index.pq_bits, filter is not None,
+        jnp.dtype(params.lut_dtype).name, jnp.dtype(
+            params.internal_distance_dtype).name,
+    )
+
+
+_SERIAL_VERSION = 1
+
+
+def serialize(index: Index, file) -> None:
+    """reference: detail/ivf_pq_serialize.cuh."""
+    if index.list_codes is None:
+        raise ValueError("index has no data; call extend() before serialize()")
+    stream, close = ser.open_for(file, "wb")
+    try:
+        w = ser.IndexWriter(stream, "ivf_pq", _SERIAL_VERSION)
+        w.scalar(int(index.metric), "<i4")
+        w.scalar(index.params.n_lists, "<i8")
+        w.scalar(index.params.kmeans_n_iters, "<i4")
+        w.scalar(index.params.kmeans_trainset_fraction, "<f8")
+        w.scalar(index.params.pq_bits, "<i4")
+        w.scalar(index.pq_dim, "<i4")
+        w.scalar(int(index.params.codebook_kind), "<i4")
+        w.scalar(1 if index.params.force_random_rotation else 0, "<i4")
+        w.scalar(index.n_rows, "<i8")
+        w.array(index.centers)
+        w.array(index.rotation)
+        w.array(index.codebooks)
+        w.array(index.list_codes)
+        w.array(index.list_indices)
+        w.array(index.list_sizes)
+    finally:
+        if close:
+            stream.close()
+
+
+def deserialize(file, res: Optional[Resources] = None) -> Index:
+    ensure_resources(res)
+    stream, close = ser.open_for(file, "rb")
+    try:
+        r = ser.IndexReader(stream, "ivf_pq", _SERIAL_VERSION)
+        metric = DistanceType(r.scalar())
+        n_lists = r.scalar()
+        kmeans_n_iters = r.scalar()
+        frac = r.scalar()
+        pq_bits = r.scalar()
+        pq_dim = r.scalar()
+        kind = CodebookGen(r.scalar())
+        force_rot = bool(r.scalar())
+        params = IndexParams(
+            n_lists=n_lists, metric=metric, kmeans_n_iters=kmeans_n_iters,
+            kmeans_trainset_fraction=frac, pq_bits=pq_bits, pq_dim=pq_dim,
+            codebook_kind=kind, force_random_rotation=force_rot,
+        )
+        n_rows = r.scalar()
+        centers = jnp.asarray(r.array())
+        rotation = jnp.asarray(r.array())
+        codebooks = jnp.asarray(r.array())
+        codes = jnp.asarray(r.array())
+        idxs = jnp.asarray(r.array())
+        sizes = jnp.asarray(r.array())
+        return Index(params, pq_dim, centers, rotation, codebooks, codes,
+                     idxs, sizes, n_rows)
+    finally:
+        if close:
+            stream.close()

@@ -1,0 +1,106 @@
+"""Refine — exact re-ranking of ANN candidate lists.
+
+Reference: ``raft::neighbors::refine`` (neighbors/refine-inl.cuh:70-100;
+device path detail/refine_device.cuh:40 — a specialized interleaved scan over
+only the candidate vectors; host path detail/refine_host-inl.hpp). Given a
+candidate index list per query (typically from ivf_pq/cagra with
+``k·refine_ratio`` entries), recompute exact distances and keep the top k.
+
+TPU-native design: gather candidate rows to a dense
+``[q_tile, n_cand, dim]`` block, one einsum against the queries (MXU), mask
+invalid (-1) candidates, select_k. Query tiles stream through ``lax.map``
+bounded by the Resources workspace budget.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import (
+    DistanceType,
+    gathered_distances,
+    resolve_metric,
+)
+from raft_tpu.ops.select_k import select_k
+from raft_tpu.utils.shape import cdiv
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "k", "q_tile"))
+def _refine_jit(dataset, queries, candidates, metric: DistanceType, k: int,
+                q_tile: int):
+    nq, n_cand = candidates.shape
+    dim = dataset.shape[1]
+    minimize = metric != DistanceType.InnerProduct
+
+    n_tiles = cdiv(nq, q_tile)
+    pad_q = n_tiles * q_tile - nq
+    qp = jnp.pad(queries.astype(jnp.float32), ((0, pad_q), (0, 0)))
+    cp = jnp.pad(candidates, ((0, pad_q), (0, 0)), constant_values=-1)
+
+    def body(args):
+        qt, ct = args  # [t, dim], [t, C]
+        valid = ct >= 0
+        safe = jnp.maximum(ct, 0)
+        vecs = dataset[safe]  # [t, C, dim]
+        d = gathered_distances(qt, vecs, metric)
+        bad = jnp.inf if minimize else -jnp.inf
+        d = jnp.where(valid, d, bad)
+        kk = min(k, n_cand)
+        v, sel = select_k(d, kk, select_min=minimize)
+        i_out = jnp.take_along_axis(ct, sel, axis=1)
+        i_out = jnp.where(jnp.isfinite(v) if minimize else v > -jnp.inf,
+                          i_out, -1)
+        if kk < k:
+            v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=bad)
+            i_out = jnp.pad(i_out, ((0, 0), (0, k - kk)), constant_values=-1)
+        return v, i_out
+
+    if n_tiles == 1:
+        vals, idxs = body((qp, cp))
+    else:
+        vals, idxs = jax.lax.map(
+            body,
+            (qp.reshape(n_tiles, q_tile, dim),
+             cp.reshape(n_tiles, q_tile, n_cand)),
+        )
+        vals = vals.reshape(-1, k)
+        idxs = idxs.reshape(-1, k)
+    return vals[:nq], idxs[:nq]
+
+
+def refine(
+    dataset,
+    queries,
+    candidates,
+    k: int,
+    metric="sqeuclidean",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Re-rank ``candidates`` [nq, n_cand] (row ids into ``dataset``, -1 =
+    missing) by exact distance; return the top ``k`` (reference:
+    neighbors::refine, refine-inl.cuh:70-100).
+    """
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    candidates = jnp.asarray(candidates, jnp.int32)
+    if queries.shape[1] != dataset.shape[1]:
+        raise ValueError(
+            f"query dim {queries.shape[1]} != dataset dim {dataset.shape[1]}")
+    if candidates.shape[0] != queries.shape[0]:
+        raise ValueError("candidates rows must match queries rows")
+    if k > candidates.shape[1]:
+        raise ValueError(f"k={k} > n_candidates={candidates.shape[1]}")
+    m = resolve_metric(metric)
+    per_q = candidates.shape[1] * dataset.shape[1] * 4 * 2
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 1024))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    return _refine_jit(dataset, queries, candidates, m, int(k), q_tile)

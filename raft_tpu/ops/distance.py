@@ -208,6 +208,38 @@ def kl_divergence(x, y):
     return 0.5 * (x_logx[:, None] - cross)
 
 
+def gathered_distances(queries, vecs, metric: DistanceType, dots=None):
+    """Distances between per-row queries [t, d] and their gathered candidate
+    vectors [t, c, d] — the shared epilogue of candidate-scan paths (refine,
+    nn-descent joins, CAGRA expansion, sharded merges).
+
+    Returns the canonical distance per metric: raw dot products for
+    InnerProduct (caller maximizes or negates), 1−cos for Cosine, clamped
+    squared L2 (sqrt applied for L2SqrtExpanded). ``dots`` may be passed if
+    already computed.
+    """
+    qf = queries.astype(jnp.float32)
+    vf = vecs.astype(jnp.float32)
+    if dots is None:
+        dots = jnp.einsum(
+            "td,tcd->tc", qf, vf,
+            precision=(jax.lax.Precision.HIGHEST
+                       if vecs.dtype == jnp.float32 else None),
+            preferred_element_type=jnp.float32)
+    if metric == DistanceType.InnerProduct:
+        return dots
+    if metric == DistanceType.CosineExpanded:
+        vn = jnp.sqrt(jnp.maximum(jnp.sum(vf * vf, -1), 1e-20))
+        qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
+        return 1.0 - dots / (vn * qn[:, None])
+    vn2 = jnp.sum(vf * vf, -1)
+    qn2 = row_norms_sq(qf)
+    d = jnp.maximum(qn2[:, None] + vn2 - 2.0 * dots, 0.0)
+    if metric == DistanceType.L2SqrtExpanded:
+        d = jnp.sqrt(d)
+    return d
+
+
 # =========================================================== elementwise engine
 
 

@@ -105,3 +105,29 @@ def select_k(
     if indices is not None:
         out_i = jnp.take_along_axis(jnp.asarray(indices), out_i, axis=1)
     return out_v, out_i
+
+
+def merge_topk_dedup(ids, dists, k: int, exclude_ids=None):
+    """Top-``k`` smallest ``dists`` per row with duplicate-id suppression
+    (traceable; the shared merge step of graph algorithms — nn-descent's
+    heap-insert analog and CAGRA's itopk merge).
+
+    ``ids`` [b, m] int32 candidate ids (-1 = invalid), ``dists`` [b, m];
+    ``exclude_ids`` [b] optionally bans one id per row (self-suppression).
+    Returns (ids [b, k], dists [b, k]) sorted ascending by distance; losers
+    padded with (-1, +inf). Ties between duplicate copies keep the first in
+    id-sorted order.
+    """
+    b, m = ids.shape
+    if exclude_ids is not None:
+        ids = jnp.where(ids == exclude_ids[:, None], -1, ids)
+    ds = jnp.where(ids < 0, jnp.inf, dists)
+    order = jnp.argsort(ids, axis=1)
+    ids_s = jnp.take_along_axis(ids, order, axis=1)
+    ds_s = jnp.take_along_axis(ds, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((b, 1), bool), ids_s[:, 1:] == ids_s[:, :-1]], axis=1)
+    ds_s = jnp.where(dup, jnp.inf, ds_s)
+    top, sel = jax.lax.top_k(-ds_s, k)
+    out_ids = jnp.take_along_axis(ids_s, sel, axis=1)
+    return jnp.where(jnp.isfinite(-top), out_ids, -1), -top

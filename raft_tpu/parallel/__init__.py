@@ -1,4 +1,14 @@
 """Distributed layer (SURVEY.md §2.8): comms facade over XLA mesh
 collectives (ICI/DCN), multi-host bootstrap, sharded index build/search."""
 
-__all__ = []
+from raft_tpu.parallel import comms, sharded
+from raft_tpu.parallel.comms import (
+    Comms,
+    ReduceOp,
+    init_comms,
+    init_distributed,
+    inject_comms,
+)
+
+__all__ = ["comms", "sharded", "Comms", "ReduceOp", "init_comms",
+           "init_distributed", "inject_comms"]

@@ -1,0 +1,269 @@
+"""Sharded (multi-device / multi-host) index build & search.
+
+Reference: the MNMG pattern raft-dask + cuML implement over ``raft::comms``
+(SURVEY.md §2.8, §5): each worker holds a data partition with its own local
+index; queries are broadcast; each worker searches locally, and the
+per-worker top-k lists are merged (the
+``knn_merge_parts`` pattern, detail/knn_merge_parts.cuh, applied across
+ranks instead of tiles).
+
+TPU-native design: partitions are mesh shards, not worker processes. The
+whole search (local scan + cross-device merge) is ONE jitted SPMD program:
+``shard_map`` runs the local search per device shard, ``all_gather`` moves
+only the [nq, k] candidate lists over ICI (tiny vs the dataset), and the
+merge is a final top-k — XLA overlaps the collective with compute. Dataset
+shards never move. Build shards rows round-robin; ids stay global.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import DistanceType, resolve_metric, _pairwise_impl
+from raft_tpu.ops.select_k import select_k
+from raft_tpu.parallel.comms import Comms
+from raft_tpu.utils.shape import cdiv
+
+
+# ----------------------------------------------------------- sharded knn
+
+
+def knn(
+    comms: Comms,
+    queries,
+    dataset,
+    k: int,
+    metric="sqeuclidean",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over a row-sharded dataset: local brute force per shard +
+    ICI merge (the SPMD analog of MNMG brute_force over raft::comms).
+
+    ``dataset`` may already be sharded over ``comms.axis``; otherwise it is
+    placed with row sharding here. Returns replicated (distances, indices)
+    with global row ids.
+    """
+    ensure_resources(res)
+    m = resolve_metric(metric)
+    minimize = m != DistanceType.InnerProduct
+    queries = jnp.asarray(queries)
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    size = comms.size
+    shard = cdiv(n, size)
+    n_pad = shard * size
+    if n_pad != n:
+        dataset = jnp.pad(dataset, ((0, n_pad - n), (0, 0)))
+    x = comms.shard(dataset, P(comms.axis, None))
+    q = comms.shard(queries, P(None, None))
+
+    def local(q_rep, x_loc):
+        rank = comms.rank()
+        base = rank * shard
+        d = _pairwise_impl(q_rep, x_loc, m, 2.0, 1 << 30)
+        # mask padding rows of the last shard
+        local_ids = jnp.arange(shard) + base
+        d = jnp.where(local_ids[None, :] < n, d,
+                      jnp.inf if minimize else -jnp.inf)
+        kk = min(k, shard)
+        v, i = select_k(d, kk, select_min=minimize)
+        gids = (i + base).astype(jnp.int32)
+        # merge across ranks: gather all shards' candidates, re-select
+        v_all = comms.allgather(v, axis=1)  # [nq, size*kk]
+        g_all = comms.allgather(gids, axis=1)
+        vm, sel = select_k(v_all, min(k, v_all.shape[1]), select_min=minimize)
+        im = jnp.take_along_axis(g_all, sel, axis=1)
+        return vm, im
+
+    fn = comms.run(local, (P(None, None), P(comms.axis, None)),
+                   (P(None, None), P(None, None)))
+    return jax.jit(fn)(q, x)
+
+
+# ------------------------------------------------------- sharded k-means
+
+
+def kmeans_fit(
+    comms: Comms,
+    x,
+    n_clusters: int,
+    n_iters: int = 20,
+    key=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Data-parallel Lloyd k-means over a row-sharded dataset (the MNMG
+    k-means pattern: local assignment, psum of per-cluster sums/counts —
+    what cuML does over raft::comms allreduce). Returns (centers, labels)."""
+    res = ensure_resources(res)
+    if key is None:
+        key = res.next_key()
+    x = jnp.asarray(x).astype(jnp.float32)
+    n, dim = x.shape
+    size = comms.size
+    shard = cdiv(n, size)
+    n_pad = shard * size
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+    xs = comms.shard(x, P(comms.axis, None))
+    init = jax.random.choice(key, n, (n_clusters,), replace=False)
+    centers0 = comms.shard(jnp.asarray(x)[jnp.sort(init)], P(None, None))
+
+    def local(x_loc, c0):
+        rank = comms.rank()
+        base = rank * shard
+        valid = (jnp.arange(shard) + base) < n
+
+        def step(c, _):
+            cn = jnp.sum(c * c, -1)
+            d = cn[None, :] - 2.0 * jax.lax.dot_general(
+                x_loc, c, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            labels = jnp.argmin(d, axis=1)
+            w = valid.astype(jnp.float32)
+            sums = jnp.zeros((n_clusters, dim), jnp.float32).at[labels].add(
+                x_loc * w[:, None])
+            counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(w)
+            sums = comms.allreduce(sums)  # psum over ICI
+            counts = comms.allreduce(counts)
+            new_c = jnp.where(counts[:, None] > 0,
+                              sums / jnp.maximum(counts, 1.0)[:, None], c)
+            return new_c, None
+
+        c_final, _ = jax.lax.scan(step, c0, None, length=n_iters)
+        cn = jnp.sum(c_final * c_final, -1)
+        d = cn[None, :] - 2.0 * x_loc @ c_final.T
+        labels = jnp.argmin(d, axis=1).astype(jnp.int32)
+        return c_final, labels
+
+    fn = comms.run(local, (P(comms.axis, None), P(None, None)),
+                   (P(None, None), P(comms.axis)))
+    centers, labels = jax.jit(fn)(xs, centers0)
+    return centers, labels[:n]
+
+
+# --------------------------------------------------- sharded ivf_flat search
+
+
+class ShardedIvfFlat:
+    """An IVF-Flat index partitioned over a mesh axis: each device owns a
+    full local index over its row shard (the raft-dask deployment shape);
+    search is one SPMD program with an ICI candidate merge."""
+
+    def __init__(self, comms: Comms, centers, list_data, list_indices,
+                 list_sizes, metric: DistanceType, n_rows: int):
+        self.comms = comms
+        # all leading-axis [size, ...] stacked per-shard arrays
+        self.centers = centers  # [S, L, dim]
+        self.list_data = list_data  # [S, L, pad, dim]
+        self.list_indices = list_indices  # [S, L, pad] global ids
+        self.list_sizes = list_sizes  # [S, L]
+        self.metric = metric
+        self.n_rows = n_rows
+
+
+def build_ivf_flat(
+    comms: Comms,
+    dataset,
+    params=None,
+    res: Optional[Resources] = None,
+) -> ShardedIvfFlat:
+    """Build per-shard IVF-Flat indexes over row partitions with global ids
+    (host-orchestrated like raft-dask's per-worker build; the per-shard
+    build itself is the single-chip path)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    res = ensure_resources(res)
+    params = params or ivf_flat.IndexParams()
+    dataset = np.asarray(dataset)
+    n = len(dataset)
+    size = comms.size
+    bounds = np.linspace(0, n, size + 1).astype(np.int64)
+    min_shard = int(np.diff(bounds).min())
+    if params.n_lists > min_shard:
+        raise ValueError(
+            f"n_lists={params.n_lists} exceeds the smallest shard's "
+            f"{min_shard} rows ({n} rows over {size} devices); every shard "
+            f"builds its own index, so n_lists must be ≤ rows-per-shard")
+    subs = []
+    for r in range(size):
+        lo, hi = bounds[r], bounds[r + 1]
+        idx = ivf_flat.build(dataset[lo:hi], params, res=res)
+        # rewrite ids to global row ids
+        gl_idx = np.asarray(idx.list_indices)
+        gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
+        subs.append((np.asarray(idx.centers), np.asarray(idx.list_data),
+                     gl_idx, np.asarray(idx.list_sizes)))
+    pad = max(s[1].shape[1] for s in subs)
+    dim = dataset.shape[1]
+    L = params.n_lists
+    c = np.stack([s[0] for s in subs])
+    ld = np.zeros((size, L, pad, dim), subs[0][1].dtype)
+    li = np.full((size, L, pad), -1, np.int32)
+    ls = np.stack([s[3] for s in subs])
+    for r, s in enumerate(subs):
+        p = s[1].shape[1]
+        ld[r, :, :p] = s[1]
+        li[r, :, :p] = s[2]
+    ax = comms.axis
+    return ShardedIvfFlat(
+        comms,
+        comms.shard(jnp.asarray(c), P(ax, None, None)),
+        comms.shard(jnp.asarray(ld), P(ax, None, None, None)),
+        comms.shard(jnp.asarray(li), P(ax, None, None)),
+        comms.shard(jnp.asarray(ls), P(ax, None)),
+        params.metric, n)
+
+
+def search_ivf_flat(
+    index: ShardedIvfFlat,
+    queries,
+    k: int,
+    params=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD search: every device scans its local shard's probed lists
+    (reusing the single-chip search core inside shard_map), then one
+    all_gather + top-k merges the per-shard candidates over ICI."""
+    from raft_tpu.neighbors import ivf_flat
+
+    res = ensure_resources(res)
+    params = params or ivf_flat.SearchParams()
+    comms = index.comms
+    queries = jnp.asarray(queries)
+    minimize = index.metric != DistanceType.InnerProduct
+    n_lists = index.centers.shape[1]
+    n_probes = int(min(params.n_probes, n_lists))
+    list_pad = index.list_data.shape[2]
+    per_q = n_probes * list_pad * queries.shape[1] * 4 * 2
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 1024))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    empty_filter = jnp.zeros((0,), jnp.uint32)
+
+    def local(q_rep, c, ld, li, ls):
+        v, i = ivf_flat._search_core(
+            q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
+            int(k), n_probes, q_tile, False)
+        v_all = comms.allgather(v, axis=1)
+        i_all = comms.allgather(i, axis=1)
+        v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)
+        vm, sel = select_k(v_all, int(k), select_min=minimize)
+        return vm, jnp.take_along_axis(i_all, sel, axis=1)
+
+    ax = comms.axis
+    fn = comms.run(
+        local,
+        (P(None, None), P(ax, None, None), P(ax, None, None, None),
+         P(ax, None, None), P(ax, None)),
+        (P(None, None), P(None, None)))
+    q = comms.shard(queries, P(None, None))
+    return jax.jit(fn)(q, index.centers, index.list_data, index.list_indices,
+                       index.list_sizes)

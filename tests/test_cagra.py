@@ -1,0 +1,126 @@
+"""CAGRA tests — build→optimize→search with recall gates against exact
+ground truth (reference pattern: cpp/test/neighbors/ann_cagra.cuh, min_recall
+floors ~0.69+ for low-itopk configs; we gate higher on small data)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, cagra
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((3000, 32)).astype(np.float32)
+    q = rng.standard_normal((100, 32)).astype(np.float32)
+    return db, q
+
+
+@pytest.fixture(scope="module")
+def gt(data):
+    db, q = data
+    _, idx = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    return np.asarray(idx)
+
+
+@pytest.fixture(scope="module")
+def built(data):
+    db, _ = data
+    params = cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24,
+        build_algo=cagra.BuildAlgo.NN_DESCENT, nn_descent_niter=12)
+    return cagra.build(db, params)
+
+
+def test_build_shapes(built, data):
+    db, _ = data
+    assert built.graph.shape == (len(db), 24)
+    g = np.asarray(built.graph)
+    assert (g >= 0).all() and (g < len(db)).all()
+    assert not (g == np.arange(len(db))[:, None]).any()
+
+
+def test_graph_has_no_duplicate_edges(built):
+    g = np.asarray(built.graph)
+    for row in g[:100]:
+        assert len(np.unique(row)) == len(row)
+
+
+def test_search_recall(built, data, gt):
+    _, q = data
+    d, i = cagra.search(built, q, 10,
+                        cagra.SearchParams(itopk_size=64, search_width=2))
+    recall = float(neighborhood_recall(np.asarray(i), gt))
+    assert recall >= 0.9, f"recall {recall}"
+
+
+def test_search_recall_increases_with_itopk(built, data, gt):
+    _, q = data
+    r = []
+    for itopk in (16, 64):
+        _, i = cagra.search(built, q, 10, cagra.SearchParams(itopk_size=itopk))
+        r.append(float(neighborhood_recall(np.asarray(i), gt)))
+    assert r[1] >= r[0] - 0.02
+    assert r[1] >= 0.85
+
+
+def test_search_distances_match_exact(built, data):
+    db, q = data
+    d, i = cagra.search(built, q, 5,
+                        cagra.SearchParams(itopk_size=64, search_width=2))
+    d, i = np.asarray(d), np.asarray(i)
+    # returned distances must equal the true L2² to the returned ids
+    want = ((q[:, None, :] - db[i]) ** 2).sum(-1)
+    np.testing.assert_allclose(d, want, rtol=1e-3, atol=1e-3)
+
+
+def test_optimize_standalone(data):
+    db, _ = data
+    from raft_tpu.neighbors import nn_descent
+
+    nd = nn_descent.build(db, nn_descent.IndexParams(
+        graph_degree=32, intermediate_graph_degree=48, max_iterations=8))
+    g = cagra.optimize(nd.graph, 16)
+    assert g.shape == (len(db), 16)
+    gg = np.asarray(g)
+    assert (gg >= 0).all()
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_ivf_pq_build_path(data, gt):
+    db, q = data
+    params = cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16,
+        build_algo=cagra.BuildAlgo.IVF_PQ)
+    index = cagra.build(db, params)
+    _, i = cagra.search(index, q, 10,
+                        cagra.SearchParams(itopk_size=64, search_width=2))
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.8
+
+
+def test_serialize_roundtrip(built, data, gt):
+    _, q = data
+    buf = io.BytesIO()
+    cagra.serialize(built, buf)
+    buf.seek(0)
+    index2 = cagra.deserialize(buf)
+    d1, i1 = cagra.search(built, q, 10)
+    d2, i2 = cagra.search(index2, q, 10)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_serialize_without_dataset(built, data):
+    db, q = data
+    buf = io.BytesIO()
+    cagra.serialize(built, buf, include_dataset=False)
+    buf.seek(0)
+    with pytest.raises(ValueError, match="no dataset"):
+        cagra.deserialize(buf)
+    buf.seek(0)
+    index2 = cagra.deserialize(buf, dataset=db)
+    _, i1 = cagra.search(built, q, 5)
+    _, i2 = cagra.search(index2, q, 5)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -1,0 +1,39 @@
+"""NN-descent tests — graph recall against exact kNN ground truth
+(reference pattern: cpp/test/neighbors/ann_nn_descent.cuh)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, nn_descent
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    return rng.standard_normal((2000, 32)).astype(np.float32)
+
+
+def test_graph_recall(data):
+    params = nn_descent.IndexParams(
+        graph_degree=32, intermediate_graph_degree=48, max_iterations=12)
+    index = nn_descent.build(data, params)
+    assert index.graph.shape == (len(data), 32)
+    _, gt = brute_force.knn(data, data, k=33, metric="sqeuclidean")
+    gt = np.asarray(gt)[:, 1:33]  # drop self
+    got = np.asarray(index.graph)[:, :32]
+    recall = float(neighborhood_recall(got, gt))
+    assert recall >= 0.9, f"graph recall {recall}"
+
+
+def test_no_self_loops(data):
+    params = nn_descent.IndexParams(
+        graph_degree=16, intermediate_graph_degree=32, max_iterations=8)
+    index = nn_descent.build(data, params)
+    g = np.asarray(index.graph)
+    assert not (g == np.arange(len(data))[:, None]).any()
+
+
+def test_metric_validation():
+    with pytest.raises(ValueError, match="supports"):
+        nn_descent.IndexParams(metric="canberra")

@@ -1,0 +1,60 @@
+"""Refine tests — exact re-ranking recovers brute-force order from a
+candidate superset (reference pattern: cpp/test/neighbors/refine.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import brute_force, refine
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((2000, 48)).astype(np.float32)
+    q = rng.standard_normal((64, 48)).astype(np.float32)
+    return db, q
+
+
+def test_refine_recovers_exact_topk(data):
+    db, q = data
+    _, cand = brute_force.knn(q, db, k=30, metric="sqeuclidean")
+    # shuffle candidates so refine must actually sort
+    rng = np.random.default_rng(0)
+    cand = np.array(cand)
+    for r in cand:
+        rng.shuffle(r)
+    d, i = refine.refine(db, q, cand, k=10, metric="sqeuclidean")
+    gt_d, gt_i = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(gt_i))) >= 0.999
+    np.testing.assert_allclose(np.asarray(d), np.asarray(gt_d), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_refine_handles_missing_candidates(data):
+    db, q = data
+    _, cand = brute_force.knn(q, db, k=20, metric="sqeuclidean")
+    cand = np.asarray(cand).copy()
+    cand[:, 15:] = -1  # only 15 real candidates
+    d, i = refine.refine(db, q, cand, k=10)
+    assert (np.asarray(i) >= 0).all()
+    # all returned came from the first 15
+    assert np.isin(np.asarray(i), cand[:, :15]).all()
+
+
+def test_refine_inner_product(data):
+    db, q = data
+    ip = q @ db.T
+    gt = np.argsort(-ip, 1)[:, :5]
+    cand = np.argsort(-ip, 1)[:, :25].astype(np.int32)
+    rng = np.random.default_rng(1)
+    for r in cand:
+        rng.shuffle(r)
+    d, i = refine.refine(db, q, cand, k=5, metric="inner_product")
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
+
+
+def test_refine_validation(data):
+    db, q = data
+    with pytest.raises(ValueError, match="k="):
+        refine.refine(db, q, np.zeros((len(q), 5), np.int32), k=10)
