@@ -12,7 +12,7 @@ instead of hand-rolled CUDA, jax.sharding.Mesh collectives instead of NCCL.
 """
 
 from raft_tpu.core.resources import Resources
-from raft_tpu import core, ops, cluster, neighbors, parallel, stats, utils
+from raft_tpu import core, ops, cluster, neighbors, parallel, sparse, stats, utils
 
 __version__ = "0.1.0"
 
@@ -23,6 +23,7 @@ __all__ = [
     "cluster",
     "neighbors",
     "parallel",
+    "sparse",
     "stats",
     "utils",
     "__version__",

@@ -1,8 +1,10 @@
 """Cluster layer (SURVEY.md §2.6): k-means (Lloyd), balanced hierarchical
 k-means (IVF coarse-quantizer trainer), single-linkage."""
 
-from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster import kmeans, kmeans_balanced, single_linkage
 from raft_tpu.cluster.kmeans import KMeansParams
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.cluster.single_linkage import SingleLinkageParams
 
-__all__ = ["kmeans", "kmeans_balanced", "KMeansParams", "KMeansBalancedParams"]
+__all__ = ["kmeans", "kmeans_balanced", "single_linkage", "KMeansParams",
+           "KMeansBalancedParams", "SingleLinkageParams"]

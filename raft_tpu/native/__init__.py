@@ -1,0 +1,319 @@
+"""Native C++ runtime components (host side, off the XLA compute path).
+
+Reference analogs: the mmap'd fbin dataset reader
+(cpp/bench/ann/src/common/dataset.hpp), the CAGRA→hnswlib serializer
+(neighbors/detail/hnsw_types.hpp:60-86), the agglomerative labeling kernel
+(cluster/detail/agglomerative.cuh), and the IVF list fill
+(detail/ivf_flat_build.cuh:123-160). The TPU compute path stays JAX/XLA;
+these are the IO/packing/sequential-host pieces the reference also keeps
+native.
+
+Built with g++ into ``libraft_tpu_native.so`` on first use (``ensure_built``)
+and bound via ctypes — no pybind11 dependency. Every entry point has a
+pure-numpy fallback so the package works without a toolchain."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "raft_tpu_native.cpp")
+_SO = os.path.join(_HERE, "libraft_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Compile the shared library if needed; returns availability."""
+    global _build_failed
+    if os.path.exists(_SO) and not force:
+        return True
+    if _build_failed and not force:
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        _build_failed = True
+        return False
+
+
+def _get_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not ensure_built():
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.bin_read_header.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.bin_read_header.restype = ctypes.c_int
+        lib.bin_read_rows.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.bin_read_rows.restype = ctypes.c_int
+        lib.bin_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64]
+        lib.bin_write.restype = ctypes.c_int
+        lib.hnswlib_write.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.hnswlib_write.restype = ctypes.c_int
+        lib.agglomerative_label.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p]
+        lib.agglomerative_label.restype = ctypes.c_int
+        lib.pack_lists.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.pack_lists.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+# ------------------------------------------------------------------- bin IO
+
+
+_DTYPES = {"fbin": np.float32, "ibin": np.int32, "u8bin": np.uint8}
+
+
+def _dtype_for(path: str, dtype=None):
+    if dtype is not None:
+        return np.dtype(dtype)
+    ext = path.rsplit(".", 1)[-1]
+    if ext in _DTYPES:
+        return np.dtype(_DTYPES[ext])
+    return np.dtype(np.float32)
+
+
+def read_bin_header(path: str) -> Tuple[int, int]:
+    """(n_rows, dim) of an fbin/ibin/u8bin file."""
+    lib = _get_lib()
+    if lib is not None:
+        n = ctypes.c_int64()
+        d = ctypes.c_int64()
+        rc = lib.bin_read_header(path.encode(), ctypes.byref(n),
+                                 ctypes.byref(d))
+        if rc != 0:
+            raise IOError(f"bin_read_header({path}) failed rc={rc}")
+        return n.value, d.value
+    with open(path, "rb") as f:
+        hdr = np.fromfile(f, np.int32, 2)
+    return int(hdr[0]), int(hdr[1])
+
+
+def read_bin(path: str, row_start: int = 0, n_rows: Optional[int] = None,
+             dtype=None) -> np.ndarray:
+    """Read a row range of an ANN-benchmark bin file (header int32 n, dim).
+    The C path uses pread (thread-safe, no Python buffering); out-of-core
+    pipelines stream batches through this (SURVEY.md §5 scale axis)."""
+    total, dim = read_bin_header(path)
+    dt = _dtype_for(path, dtype)
+    if n_rows is None:
+        n_rows = total - row_start
+    n_rows = max(min(n_rows, total - row_start), 0)
+    out = np.empty((n_rows, dim), dt)
+    lib = _get_lib()
+    if lib is not None and n_rows:
+        rc = lib.bin_read_rows(path.encode(), row_start, n_rows, dt.itemsize,
+                               out.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise IOError(f"bin_read_rows({path}) failed rc={rc}")
+        return out
+    with open(path, "rb") as f:
+        f.seek(8 + row_start * dim * dt.itemsize)
+        out = np.fromfile(f, dt, n_rows * dim).reshape(n_rows, dim)
+    return out
+
+
+def write_bin(path: str, data: np.ndarray) -> None:
+    data = np.ascontiguousarray(data)
+    lib = _get_lib()
+    if lib is not None:
+        rc = lib.bin_write(path.encode(),
+                           data.ctypes.data_as(ctypes.c_void_p),
+                           data.shape[0], data.shape[1], data.itemsize)
+        if rc != 0:
+            raise IOError(f"bin_write({path}) failed rc={rc}")
+        return
+    with open(path, "wb") as f:
+        np.asarray(data.shape, np.int32).tofile(f)
+        data.tofile(f)
+
+
+def iter_bin_batches(path: str, batch_rows: int, dtype=None):
+    """Stream a bin file in row batches (host→HBM staging loop)."""
+    total, _ = read_bin_header(path)
+    for s in range(0, total, batch_rows):
+        yield s, read_bin(path, s, min(batch_rows, total - s), dtype)
+
+
+# -------------------------------------------------------------- hnsw export
+
+
+def hnswlib_write(path: str, dataset: np.ndarray, graph: np.ndarray,
+                  space: str = "l2") -> None:
+    """Write a base-layer-only hnswlib index file (loadable by hnswlib's
+    HierarchicalNSW::loadIndex): header in saveIndex order, per-element
+    level-0 block [link_count u32][maxM0 u32 links][dim f32][label u64],
+    zero upper-level link lists. Reference: CAGRA→HNSW serializer
+    (neighbors/detail/hnsw_types.hpp:60-86)."""
+    dataset = np.ascontiguousarray(dataset, np.float32)
+    graph = np.ascontiguousarray(graph, np.int32)
+    n, dim = dataset.shape
+    if graph.shape[0] != n:
+        raise ValueError("graph rows must match dataset rows")
+    degree = graph.shape[1]
+    sp = {"l2": 0, "ip": 1}[space]
+    lib = _get_lib()
+    if lib is not None:
+        rc = lib.hnswlib_write(path.encode(),
+                               dataset.ctypes.data_as(ctypes.c_void_p),
+                               graph.ctypes.data_as(ctypes.c_void_p),
+                               n, dim, degree, sp)
+        if rc != 0:
+            raise IOError(f"hnswlib_write({path}) failed rc={rc}")
+        return
+    _hnswlib_write_py(path, dataset, graph)
+
+
+def _hnswlib_write_py(path: str, dataset: np.ndarray,
+                      graph: np.ndarray) -> None:
+    import struct
+
+    n, dim = dataset.shape
+    degree = graph.shape[1]
+    size_links0 = degree * 4 + 4
+    data_size = dim * 4
+    size_per_elem = size_links0 + data_size + 8
+    m = max(degree // 2, 1)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQQQQQiIQQQdQ",
+                            0, n, n, size_per_elem,
+                            size_links0 + data_size, size_links0,
+                            0, 0, m, degree, m,
+                            1.0 / np.log(max(m, 2)), 200))
+        for i in range(n):
+            links = graph[i][graph[i] >= 0].astype(np.uint32)
+            buf = bytearray(size_per_elem)
+            buf[0:4] = struct.pack("<I", len(links))
+            buf[4 : 4 + 4 * len(links)] = links.tobytes()
+            buf[size_links0 : size_links0 + data_size] = (
+                dataset[i].astype(np.float32).tobytes())
+            buf[size_links0 + data_size :] = struct.pack("<Q", i)
+            f.write(bytes(buf))
+        f.write(b"\x00\x00\x00\x00" * n)
+
+
+# --------------------------------------------------- agglomerative labeling
+
+
+def agglomerative_label(src: np.ndarray, dst: np.ndarray, n: int,
+                        n_clusters: int) -> np.ndarray:
+    """Union-find dendrogram labeling over weight-sorted MST edges
+    (cluster/detail/agglomerative.cuh analog). Returns labels [n]."""
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    lib = _get_lib()
+    if lib is not None:
+        labels = np.empty((n,), np.int32)
+        lib.agglomerative_label(
+            src.ctypes.data_as(ctypes.c_void_p),
+            dst.ctypes.data_as(ctypes.c_void_p),
+            len(src), n, n_clusters,
+            labels.ctypes.data_as(ctypes.c_void_p))
+        return labels
+    # numpy fallback
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    target = n - n_clusters
+    merges = 0
+    for e in range(len(src)):
+        if merges >= target:
+            break
+        if src[e] < 0 or dst[e] < 0:
+            continue
+        ra, rb = find(int(src[e])), find(int(dst[e]))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+            merges += 1
+    roots = np.array([find(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels.astype(np.int32)
+
+
+# ------------------------------------------------------------- list packing
+
+
+def pack_lists(rows: np.ndarray, labels: np.ndarray, n_lists: int,
+               list_pad: int, ids: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack rows into padded per-list storage (host half of the IVF list
+    fill, detail/ivf_flat_build.cuh:123-160). Returns (data [L, pad, ...],
+    ids [L, pad] int32, sizes [L] int32)."""
+    rows = np.ascontiguousarray(rows)
+    labels = np.ascontiguousarray(labels, np.int32)
+    n = len(rows)
+    row_bytes = rows.dtype.itemsize * int(np.prod(rows.shape[1:]))
+    out = np.zeros((n_lists, list_pad) + rows.shape[1:], rows.dtype)
+    out_ids = np.empty((n_lists, list_pad), np.int32)
+    sizes = np.zeros((n_lists,), np.int32)
+    lib = _get_lib()
+    if lib is not None:
+        ids_c = (np.ascontiguousarray(ids, np.int32)
+                 if ids is not None else None)
+        rc = lib.pack_lists(
+            rows.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p),
+            ids_c.ctypes.data_as(ctypes.c_void_p) if ids_c is not None
+            else None,
+            n, row_bytes, n_lists, list_pad,
+            out.ctypes.data_as(ctypes.c_void_p),
+            out_ids.ctypes.data_as(ctypes.c_void_p),
+            sizes.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise ValueError(f"pack_lists failed rc={rc} (bad label or "
+                             f"list_pad too small)")
+        return out, out_ids, sizes
+    # numpy fallback
+    out_ids.fill(-1)
+    src_ids = ids if ids is not None else np.arange(n, dtype=np.int32)
+    order = np.argsort(labels, kind="stable")
+    sizes = np.bincount(labels, minlength=n_lists).astype(np.int32)
+    if sizes.max(initial=0) > list_pad:
+        raise ValueError("list_pad too small")
+    starts = np.zeros(n_lists + 1, np.int64)
+    np.cumsum(sizes, out=starts[1:])
+    rs = rows[order]
+    si = np.asarray(src_ids)[order]
+    for l in range(n_lists):
+        s, e = starts[l], starts[l + 1]
+        out[l, : e - s] = rs[s:e]
+        out_ids[l, : e - s] = si[s:e]
+    return out, out_ids, sizes

@@ -1,0 +1,241 @@
+// raft_tpu native runtime — host-side C++ for the pieces the reference
+// implements natively and that sit off the XLA compute path:
+//
+//  * bin dataset IO (fbin/ibin/u8bin) with mmap'd zero-copy batch reads —
+//    the role of the reference's mmap'd fbin reader
+//    (cpp/bench/ann/src/common/dataset.hpp) for out-of-core datasets.
+//  * hnswlib-format serializer: writes a base-layer-only hnswlib index
+//    from a CAGRA graph + dataset, interoperable with hnswlib's
+//    loadIndex (the reference's CAGRA→HNSW export,
+//    neighbors/detail/hnsw_types.hpp:60-86).
+//  * agglomerative union-find labeling over sorted MST edges — the
+//    sequential dendrogram step of single-linkage
+//    (cluster/detail/agglomerative.cuh analog).
+//  * IVF list packing: group rows by cluster label into padded lists —
+//    the host half of build_index_kernel (detail/ivf_flat_build.cuh:123).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cmath>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+#include <algorithm>
+#include <numeric>
+
+extern "C" {
+
+// ------------------------------------------------------------------ bin IO
+
+// Header: int32 n_rows, int32 dim. Returns 0 on success.
+int bin_read_header(const char* path, int64_t* n_rows, int64_t* dim) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t hdr[2];
+  if (std::fread(hdr, sizeof(int32_t), 2, f) != 2) {
+    std::fclose(f);
+    return -2;
+  }
+  *n_rows = hdr[0];
+  *dim = hdr[1];
+  std::fclose(f);
+  return 0;
+}
+
+// Read rows [row_start, row_start+n_rows) into out (caller-allocated,
+// n_rows*dim*elem_size bytes). Uses pread — no seek state, thread-safe.
+int bin_read_rows(const char* path, int64_t row_start, int64_t n_rows,
+                  int64_t elem_size, void* out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int32_t hdr[2];
+  if (pread(fd, hdr, sizeof(hdr), 0) != (ssize_t)sizeof(hdr)) {
+    close(fd);
+    return -2;
+  }
+  const int64_t dim = hdr[1];
+  const int64_t row_bytes = dim * elem_size;
+  const int64_t off = 8 + row_start * row_bytes;
+  const int64_t want = n_rows * row_bytes;
+  int64_t done = 0;
+  while (done < want) {
+    ssize_t got = pread(fd, (char*)out + done, want - done, off + done);
+    if (got <= 0) {
+      close(fd);
+      return -3;
+    }
+    done += got;
+  }
+  close(fd);
+  return 0;
+}
+
+int bin_write(const char* path, const void* data, int64_t n_rows,
+              int64_t dim, int64_t elem_size) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int32_t hdr[2] = {(int32_t)n_rows, (int32_t)dim};
+  if (std::fwrite(hdr, sizeof(int32_t), 2, f) != 2) {
+    std::fclose(f);
+    return -2;
+  }
+  const size_t want = (size_t)(n_rows * dim * elem_size);
+  if (std::fwrite(data, 1, want, f) != want) {
+    std::fclose(f);
+    return -3;
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// --------------------------------------------------------- hnswlib writer
+
+// Writes a base-layer-only hnswlib index: header fields in hnswlib
+// saveIndex order, one level-0 block per element
+// [uint32 n_links][maxM0 x uint32][dim x float][size_t label], then a zero
+// linkListSize per element (no upper layers; maxlevel 0, enterpoint 0).
+// space: 0 = l2, 1 = ip.
+int hnswlib_write(const char* path, const float* data, const int32_t* graph,
+                  int64_t n, int64_t dim, int64_t degree, int64_t /*space*/) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+
+  const uint64_t offset_level0 = 0;
+  const uint64_t max_elements = (uint64_t)n;
+  const uint64_t cur_count = (uint64_t)n;
+  const uint64_t size_links0 = (uint64_t)degree * 4 + 4;
+  const uint64_t data_size = (uint64_t)dim * 4;
+  const uint64_t size_per_elem = size_links0 + data_size + 8;
+  const uint64_t label_offset = size_links0 + data_size;
+  const uint64_t offset_data = size_links0;
+  const int32_t max_level = 0;
+  const uint32_t enterpoint = 0;
+  const uint64_t maxM = (uint64_t)degree / 2 ? (uint64_t)degree / 2 : 1;
+  const uint64_t maxM0 = (uint64_t)degree;
+  const uint64_t M = maxM;
+  const double mult = 1.0 / std::log((double)(M > 1 ? M : 2));
+  const uint64_t ef_construction = 200;
+
+#define W(x) if (std::fwrite(&(x), sizeof(x), 1, f) != 1) { std::fclose(f); return -2; }
+  W(offset_level0);
+  W(max_elements);
+  W(cur_count);
+  W(size_per_elem);
+  W(label_offset);
+  W(offset_data);
+  W(max_level);
+  W(enterpoint);
+  W(maxM);
+  W(maxM0);
+  W(M);
+  W(mult);
+  W(ef_construction);
+#undef W
+
+  std::vector<char> elem(size_per_elem);
+  for (int64_t i = 0; i < n; ++i) {
+    // count valid links (graph entries >= 0)
+    uint32_t cnt = 0;
+    for (int64_t j = 0; j < degree; ++j)
+      if (graph[i * degree + j] >= 0) ++cnt;
+    std::memset(elem.data(), 0, elem.size());
+    std::memcpy(elem.data(), &cnt, 4);
+    uint32_t* links = (uint32_t*)(elem.data() + 4);
+    uint32_t w = 0;
+    for (int64_t j = 0; j < degree; ++j) {
+      int32_t t = graph[i * degree + j];
+      if (t >= 0) links[w++] = (uint32_t)t;
+    }
+    std::memcpy(elem.data() + offset_data, data + i * dim, data_size);
+    uint64_t label = (uint64_t)i;
+    std::memcpy(elem.data() + label_offset, &label, 8);
+    if (std::fwrite(elem.data(), 1, elem.size(), f) != elem.size()) {
+      std::fclose(f);
+      return -3;
+    }
+  }
+  const uint32_t zero = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (std::fwrite(&zero, 4, 1, f) != 1) {
+      std::fclose(f);
+      return -4;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// ------------------------------------------- union-find dendrogram labels
+
+static int64_t uf_find(int64_t* parent, int64_t a) {
+  int64_t root = a;
+  while (parent[root] != root) root = parent[root];
+  while (parent[a] != root) {
+    int64_t next = parent[a];
+    parent[a] = root;
+    a = next;
+  }
+  return root;
+}
+
+// Merge MST edges (already sorted by weight ascending; -1 src = padding)
+// until n_clusters components remain. labels out: [n] compacted 0..k-1.
+int agglomerative_label(const int32_t* src, const int32_t* dst,
+                        int64_t n_edges, int64_t n, int64_t n_clusters,
+                        int32_t* labels) {
+  std::vector<int64_t> parent(n);
+  std::iota(parent.begin(), parent.end(), 0);
+  int64_t target = n - n_clusters;
+  int64_t merges = 0;
+  for (int64_t e = 0; e < n_edges && merges < target; ++e) {
+    if (src[e] < 0 || dst[e] < 0) continue;
+    int64_t ra = uf_find(parent.data(), src[e]);
+    int64_t rb = uf_find(parent.data(), dst[e]);
+    if (ra == rb) continue;
+    parent[std::max(ra, rb)] = std::min(ra, rb);
+    ++merges;
+  }
+  // compact root ids to 0..k-1
+  std::vector<int32_t> remap(n, -1);
+  int32_t next_label = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t r = uf_find(parent.data(), i);
+    if (remap[r] < 0) remap[r] = next_label++;
+    labels[i] = remap[r];
+  }
+  return next_label;
+}
+
+// ----------------------------------------------------- IVF list packing
+
+// Group rows by label into padded [n_lists, list_pad, row_bytes] storage +
+// ids [n_lists, list_pad] (-1 pad) + sizes [n_lists]. Returns 0.
+int pack_lists(const char* rows, const int32_t* labels, const int32_t* ids,
+               int64_t n_rows, int64_t row_bytes, int64_t n_lists,
+               int64_t list_pad, char* out_data, int32_t* out_ids,
+               int32_t* out_sizes) {
+  std::vector<int64_t> cursor(n_lists, 0);
+  std::memset(out_sizes, 0, n_lists * sizeof(int32_t));
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int32_t l = labels[i];
+    if (l < 0 || l >= n_lists) return -1;
+    const int64_t pos = cursor[l]++;
+    if (pos >= list_pad) return -2;
+    std::memcpy(out_data + (l * list_pad + pos) * row_bytes,
+                rows + i * row_bytes, row_bytes);
+    out_ids[l * list_pad + pos] = ids ? ids[i] : (int32_t)i;
+    out_sizes[l] = (int32_t)cursor[l];
+  }
+  // -1-fill unused id slots
+  for (int64_t l = 0; l < n_lists; ++l)
+    for (int64_t p = cursor[l]; p < list_pad; ++p)
+      out_ids[l * list_pad + p] = -1;
+  return 0;
+}
+
+}  // extern "C"

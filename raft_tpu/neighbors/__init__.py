@@ -2,13 +2,16 @@
 ivf_flat, ivf_pq, cagra, nn_descent, refine, filtering."""
 
 from raft_tpu.neighbors import (
+    ball_cover,
     brute_force,
     cagra,
+    epsilon_neighborhood,
+    hnsw,
     ivf_flat,
     ivf_pq,
     nn_descent,
     refine,
 )
 
-__all__ = ["brute_force", "cagra", "ivf_flat", "ivf_pq", "nn_descent",
-           "refine"]
+__all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
+           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "refine"]

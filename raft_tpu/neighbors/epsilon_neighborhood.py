@@ -1,0 +1,37 @@
+"""Epsilon neighborhood — all pairs within a radius.
+
+Reference: ``raft::neighbors::epsilon_neighborhood`` (neighbors/
+epsilon_neighborhood.cuh epsUnexpL2SqNeighborhood — dense boolean adjacency
++ per-row vertex degrees for L2).
+
+TPU-native design: one tiled pairwise-distance pass (ops.distance) with a
+fused threshold — XLA fuses the compare into the distance epilogue; the
+adjacency never materializes distances in HBM beyond the tile."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import pairwise_distance
+
+
+def eps_neighbors(
+    x,
+    y,
+    eps: float,
+    metric="sqeuclidean",
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Boolean adjacency [m, n] (x rows × y rows within ``eps``) and vertex
+    degrees [m] (reference: epsUnexpL2SqNeighborhood's adj + vd outputs;
+    eps is compared against the *squared* L2 distance for the default
+    metric, matching the reference's UnexpL2Sq semantics)."""
+    res = ensure_resources(res)
+    d = pairwise_distance(x, y, metric=metric, res=res)
+    adj = d <= eps
+    return adj, jnp.sum(adj, axis=1).astype(jnp.int32)

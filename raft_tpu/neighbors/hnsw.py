@@ -1,0 +1,89 @@
+"""HNSW interop — export CAGRA graphs as hnswlib-loadable indexes.
+
+Reference: ``raft::neighbors::hnsw`` (neighbors/hnsw.hpp, detail/
+hnsw_types.hpp:60-86 — serializes a CAGRA graph as a base-layer-only
+hnswlib index for CPU search; search delegates to hnswlib).
+
+TPU-native design: the file writer is the native C++ component
+(raft_tpu.native.hnswlib_write — byte-compatible with hnswlib saveIndex so
+hnswlib users can load it directly). When hnswlib isn't installed (this
+image), ``load``+``search`` parse the file back and run the same greedy
+graph search the CAGRA searcher uses — the graph and data round-trip is
+verified either way."""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu import native
+from raft_tpu.ops.distance import DistanceType
+
+
+def from_cagra(cagra_index, path: str) -> None:
+    """Serialize a CAGRA index as a base-layer-only hnswlib file
+    (reference: hnsw::from_cagra / serialize_to_hnswlib)."""
+    space = ("ip" if cagra_index.metric == DistanceType.InnerProduct
+             else "l2")
+    native.hnswlib_write(path, np.asarray(cagra_index.dataset),
+                         np.asarray(cagra_index.graph), space=space)
+
+
+class Index:
+    """A loaded base-layer hnsw graph (dataset + links)."""
+
+    def __init__(self, dataset: np.ndarray, graph: np.ndarray):
+        self.dataset = dataset
+        self.graph = graph  # [n, maxM0] int32, -1 padded
+
+
+def load(path: str) -> Index:
+    """Parse an hnswlib index file written by :func:`from_cagra` (layout:
+    hnswlib saveIndex — header, level-0 element blocks, link-list sizes)."""
+    with open(path, "rb") as f:
+        hdr = f.read(8 * 6 + 4 + 4 + 8 * 3 + 8 + 8)
+        (offset_level0, max_elements, cur_count, size_per_elem,
+         label_offset, offset_data, max_level, enterpoint, maxM, maxM0,
+         m_, mult, ef_c) = struct.unpack("<QQQQQQiIQQQdQ", hdr)
+        dim = (label_offset - offset_data) // 4
+        n = cur_count
+        data = np.empty((n, dim), np.float32)
+        graph = np.full((n, maxM0), -1, np.int32)
+        for i in range(n):
+            blk = f.read(size_per_elem)
+            (cnt,) = struct.unpack_from("<I", blk, 0)
+            links = np.frombuffer(blk, np.uint32, cnt, 4)
+            graph[i, :cnt] = links.astype(np.int32)
+            data[i] = np.frombuffer(blk, np.float32, dim, offset_data)
+    return Index(data, graph)
+
+
+def search(
+    index: Index,
+    queries,
+    k: int,
+    ef: int = 64,
+    space: str = "l2",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Search the loaded base-layer graph. With hnswlib installed this would
+    delegate to it (reference behavior); here we reuse the CAGRA greedy
+    searcher over the same graph — identical algorithm family (hnswlib's
+    base-layer search IS greedy beam search with ef as itopk).
+
+    ``space`` must match the space the index was exported with ('l2'|'ip') —
+    the hnswlib file format does not record it (hnswlib keeps the space at
+    wrapper level), same contract as hnswlib's own load."""
+    from raft_tpu.neighbors import cagra
+
+    metric = {"l2": DistanceType.L2Expanded,
+              "ip": DistanceType.InnerProduct}[space]
+    params = cagra.IndexParams(
+        graph_degree=index.graph.shape[1],
+        metric=metric)
+    cg = cagra.Index(params, np.asarray(index.dataset),
+                     np.asarray(index.graph))
+    d, i = cagra.search(cg, queries, k,
+                        cagra.SearchParams(itopk_size=max(ef, k)))
+    return np.asarray(d), np.asarray(i)

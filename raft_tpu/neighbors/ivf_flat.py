@@ -99,25 +99,14 @@ class Index:
 
 def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
                 ids: Optional[np.ndarray] = None):
-    """Sort rows by list and pack into padded [n_lists, pad, dim] storage
-    (host-side; analog of build_index_kernel's list fill,
+    """Pack rows into padded [n_lists, pad, dim] storage via the native C++
+    packer (host-side; analog of build_index_kernel's list fill,
     detail/ivf_flat_build.cuh:123-160)."""
-    n_rows, dim = dataset.shape
-    order = np.argsort(labels, kind="stable")
+    from raft_tpu import native
+
     sizes = np.bincount(labels, minlength=n_lists).astype(np.int32)
     pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
-    data = np.zeros((n_lists, pad, dim), dataset.dtype)
-    idxs = np.full((n_lists, pad), -1, np.int32)
-    src_ids = ids if ids is not None else np.arange(n_rows, dtype=np.int32)
-    starts = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(sizes, out=starts[1:])
-    sorted_rows = dataset[order]
-    sorted_ids = src_ids[order]
-    for l in range(n_lists):
-        s, e = starts[l], starts[l + 1]
-        data[l, : e - s] = sorted_rows[s:e]
-        idxs[l, : e - s] = sorted_ids[s:e]
-    return data, idxs, sizes
+    return native.pack_lists(dataset, labels, n_lists, pad, ids)
 
 
 def build(

@@ -210,8 +210,9 @@ def _codebook_em(subvecs, weights, book_size: int, n_iters: int, key):
         labels = jnp.argmin(d, axis=1).astype(jnp.int32)
         sums, counts = m_step(labels)
         new = sums / jnp.maximum(counts, 1.0)[:, None]
-        # re-seed empty codes from rows offset by the code id (deterministic)
-        donor = jax.random.randint(jax.random.fold_in(key, i), (book_size,), 0, n)
+        # re-seed empty codes from the weighted seed pool (never padding)
+        donor = seed_rows[jax.random.randint(
+            jax.random.fold_in(key, i), (book_size,), 0, pool_size)]
         empty = counts < 0.5
         new = jnp.where(empty[:, None], subvecs[donor], new)
         return new, labels
@@ -224,6 +225,7 @@ def _codebook_em(subvecs, weights, book_size: int, n_iters: int, key):
     _, seed_rows = jax.lax.top_k(g, min(book_size, n))
     if n < book_size:
         seed_rows = jnp.tile(seed_rows, cdiv(book_size, n))[:book_size]
+    pool_size = seed_rows.shape[0]
     centers0 = subvecs[seed_rows]
     labels0 = jnp.zeros((n,), jnp.int32)
     centers, _ = jax.lax.fori_loop(
@@ -324,22 +326,13 @@ def _encode_jit(x, labels, centers, rotation, codebooks, per_cluster: bool,
 
 def _pack_lists_np(code_bytes: np.ndarray, labels: np.ndarray, n_lists: int,
                    ids: np.ndarray):
-    """Group packed code rows by cluster into padded list storage."""
-    n_rows, n_bytes = code_bytes.shape
-    order = np.argsort(labels, kind="stable")
+    """Group packed code rows by cluster into padded list storage (native
+    C++ packer; analog of process_and_fill_codes' list placement)."""
+    from raft_tpu import native
+
     sizes = np.bincount(labels, minlength=n_lists).astype(np.int32)
     pad = max(int(round_up_to(max(int(sizes.max()), 1), 8)), 8)
-    data = np.zeros((n_lists, pad, n_bytes), np.uint8)
-    idxs = np.full((n_lists, pad), -1, np.int32)
-    starts = np.zeros(n_lists + 1, np.int64)
-    np.cumsum(sizes, out=starts[1:])
-    sc = code_bytes[order]
-    si = ids[order]
-    for l in range(n_lists):
-        s, e = starts[l], starts[l + 1]
-        data[l, : e - s] = sc[s:e]
-        idxs[l, : e - s] = si[s:e]
-    return data, idxs, sizes
+    return native.pack_lists(code_bytes, labels, n_lists, pad, ids)
 
 
 # --------------------------------------------------------------------- build

@@ -9,9 +9,9 @@ from raft_tpu.ops.distance import (
     is_min_close,
     row_norms_sq,
 )
-from raft_tpu.ops.select_k import SelectAlgo, select_k
+from raft_tpu.ops.select_k import SelectAlgo, select_k, merge_topk_dedup
 from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
-from raft_tpu.ops import rng
+from raft_tpu.ops import linalg, matrix, rng
 
 __all__ = [
     "DistanceType",
@@ -21,6 +21,9 @@ __all__ = [
     "row_norms_sq",
     "SelectAlgo",
     "select_k",
+    "merge_topk_dedup",
     "fused_l2_nn_argmin",
+    "linalg",
+    "matrix",
     "rng",
 ]

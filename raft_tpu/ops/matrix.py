@@ -1,0 +1,132 @@
+"""Matrix manipulation primitives.
+
+Reference: ``raft::matrix`` (cpp/include/raft/matrix, ~8.5k LoC) — gather/
+scatter/slice/argmax/argmin/col_wise_sort/linewise_op/copy/init/reverse/
+triangular and ``select_k`` (which lives in ops.select_k here).
+
+TPU-native design: thin functional wrappers over jnp — gathers/scatters are
+XLA-native on TPU; the value is API parity so reference call sites translate
+one-to-one. ``select_k`` is re-exported from ops.select_k (its real home —
+it has a dedicated kernel strategy)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.select_k import SelectAlgo, select_k  # noqa: F401 re-export
+
+
+def gather(matrix, indices, axis: int = 0):
+    """Row (or column) gather (matrix/gather.cuh)."""
+    return jnp.take(jnp.asarray(matrix), jnp.asarray(indices), axis=axis)
+
+
+def gather_if(matrix, indices, mask, fill=0):
+    """Conditional gather (matrix/gather.cuh gather_if): masked-out rows get
+    ``fill``."""
+    out = gather(matrix, indices)
+    return jnp.where(jnp.asarray(mask)[:, None], out, fill)
+
+
+def scatter(matrix, indices, updates):
+    """Row scatter (matrix/scatter.cuh)."""
+    return jnp.asarray(matrix).at[jnp.asarray(indices)].set(
+        jnp.asarray(updates))
+
+
+def slice(matrix, row_start: int, row_end: int, col_start: int = 0,
+          col_end: Optional[int] = None):
+    """Submatrix view (matrix/slice.cuh)."""
+    m = jnp.asarray(matrix)
+    col_end = m.shape[1] if col_end is None else col_end
+    return m[row_start:row_end, col_start:col_end]
+
+
+def argmax(matrix, axis: int = 1):
+    """Per-row argmax (matrix/argmax.cuh)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def argmin(matrix, axis: int = 1):
+    """Per-row argmin (matrix/argmin.cuh)."""
+    return jnp.argmin(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def col_wise_sort(matrix, return_keys: bool = False):
+    """Sort each column ascending (matrix/col_wise_sort.cuh)."""
+    m = jnp.asarray(matrix)
+    if return_keys:
+        keys = jnp.argsort(m, axis=0)
+        return jnp.take_along_axis(m, keys, axis=0), keys.astype(jnp.int32)
+    return jnp.sort(m, axis=0)
+
+
+def row_wise_sort(matrix, return_keys: bool = False):
+    """Sort each row ascending."""
+    m = jnp.asarray(matrix)
+    if return_keys:
+        keys = jnp.argsort(m, axis=1)
+        return jnp.take_along_axis(m, keys, axis=1), keys.astype(jnp.int32)
+    return jnp.sort(m, axis=1)
+
+
+def linewise_op(matrix, vec, op: Callable, along_lines: bool = True):
+    """Apply op(matrix, vec) broadcast along rows or columns
+    (matrix/linewise_op.cuh)."""
+    m = jnp.asarray(matrix)
+    v = jnp.asarray(vec)
+    return op(m, v[None, :] if along_lines else v[:, None])
+
+
+def reverse(matrix, axis: int = 0):
+    """Flip rows/cols (matrix/reverse.cuh)."""
+    return jnp.flip(jnp.asarray(matrix), axis=axis)
+
+
+def init(shape, value, dtype=jnp.float32):
+    """Constant fill (matrix/init.cuh)."""
+    return jnp.full(shape, value, dtype)
+
+
+def eye(n: int, dtype=jnp.float32):
+    return jnp.eye(n, dtype=dtype)
+
+
+def diagonal(matrix):
+    """Extract the main diagonal (matrix/diagonal.cuh)."""
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def set_diagonal(matrix, values):
+    m = jnp.asarray(matrix)
+    n = min(m.shape[0], m.shape[1])
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(jnp.asarray(values))
+
+
+def upper_triangular(matrix):
+    """matrix/triangular.cuh."""
+    return jnp.triu(jnp.asarray(matrix))
+
+
+def lower_triangular(matrix):
+    return jnp.tril(jnp.asarray(matrix))
+
+
+def ratio(matrix):
+    """Normalize so elements sum to 1 (matrix/ratio.cuh)."""
+    m = jnp.asarray(matrix).astype(jnp.float32)
+    return m / jnp.maximum(jnp.sum(m), 1e-20)
+
+
+def weighted_mean(matrix, weights, along_rows: bool = True):
+    """stats-adjacent helper used by matrix consumers (matrix/weighted_mean
+    pattern)."""
+    m = jnp.asarray(matrix).astype(jnp.float32)
+    w = jnp.asarray(weights).astype(jnp.float32)
+    if along_rows:
+        return (m * w[None, :]).sum(1) / jnp.maximum(w.sum(), 1e-20)
+    return (m * w[:, None]).sum(0) / jnp.maximum(w.sum(), 1e-20)

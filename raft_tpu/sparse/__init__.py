@@ -1,0 +1,9 @@
+"""Sparse layer (SURVEY.md §2.4): COO/CSR containers, conversions, sparse
+linalg (spmm/sddmm/degree/norm/symmetrize/transpose/laplacian), sparse
+pairwise distances + kNN, Borůvka MST, spectral partitioning."""
+
+from raft_tpu.sparse import convert, distance, linalg, mst, spectral, types
+from raft_tpu.sparse.types import COO, CSR, coo_from_arrays, csr_from_scipy_like
+
+__all__ = ["convert", "distance", "linalg", "mst", "spectral", "types",
+           "COO", "CSR", "coo_from_arrays", "csr_from_scipy_like"]

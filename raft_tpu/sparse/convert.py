@@ -1,0 +1,71 @@
+"""Sparse format conversions — coo↔csr↔dense.
+
+Reference: ``raft::sparse::convert`` (sparse/convert/csr.cuh, coo.cuh,
+dense.cuh).
+
+TPU-native design: conversions are sorts + segment counts (XLA-native);
+densification is a scatter. COO→CSR requires row-sorted input (documented,
+like the reference's expectation of canonical ordering); ``coo_sort``
+provides it."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """Sort entries by (row, col) — sparse/op/sort.cuh analog."""
+    key = coo.rows.astype(jnp.int64) * coo.shape[1] + coo.cols
+    order = jnp.argsort(key)
+    return COO(coo.rows[order], coo.cols[order], coo.data[order], coo.shape)
+
+
+def coo_to_csr(coo: COO, assume_sorted: bool = False) -> CSR:
+    """sparse/convert/csr.cuh: row counts → prefix sum."""
+    c = coo if assume_sorted else coo_sort(coo)
+    counts = jnp.zeros((coo.shape[0],), jnp.int32).at[c.rows].add(1)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    return CSR(indptr, c.cols, c.data, c.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """sparse/convert/coo.cuh: expand indptr to row ids."""
+    return COO(csr.row_ids(), csr.indices, csr.data, csr.shape)
+
+
+def csr_to_dense(csr: CSR) -> jax.Array:
+    """sparse/convert/dense.cuh. Duplicate coordinates sum (standard COO
+    semantics) — this also makes zero-data padding entries harmless."""
+    out = jnp.zeros(csr.shape, csr.dtype)
+    return out.at[csr.row_ids(), csr.indices].add(csr.data)
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    out = jnp.zeros(coo.shape, coo.dtype)
+    return out.at[coo.rows, coo.cols].add(coo.data)
+
+
+def dense_to_csr(dense, nnz: int = None) -> CSR:
+    """Dense → CSR with a static nnz (TPU shapes must be static: callers pass
+    the known/max nnz; surplus slots become explicit zeros at (0, 0) —
+    harmless under duplicate-sum densification)."""
+    dense = jnp.asarray(dense)
+    n, m = dense.shape
+    mask = dense != 0
+    total = int(jnp.sum(mask)) if nnz is None else int(nnz)
+    flat = mask.reshape(-1)
+    idx = jnp.nonzero(flat, size=total, fill_value=-1)[0]
+    is_real = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    rows = jnp.where(is_real, safe // m, 0).astype(jnp.int32)
+    cols = jnp.where(is_real, safe % m, 0).astype(jnp.int32)
+    data = jnp.where(is_real, dense.reshape(-1)[safe], 0)
+    counts = jnp.zeros((n,), jnp.int32).at[rows].add(1)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    return CSR(indptr, cols, data, (n, m))

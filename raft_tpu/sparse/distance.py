@@ -1,0 +1,121 @@
+"""Pairwise distances between sparse (CSR) row sets.
+
+Reference: ``raft::sparse::distance`` (sparse/distance/distance.cuh:38-48 —
+the supported metric set: L2/L2Sqrt (expanded+unexpanded), IP, L1, Cosine,
+Jaccard, Canberra, Linf, Lp, Hamming, JensenShannon, KL, Dice) with
+load-balanced coo-spmv kernels.
+
+TPU-native design: the GPU's per-nnz load-balancing machinery has no TPU
+analog — the MXU wants dense tiles. Rows are densified in x-tiles (a scatter
+per tile) and fed to the dense pairwise engine (ops.distance), which covers
+every overlap-algebra metric; Jaccard/Dice — the two sparse-only metrics —
+are computed from binarized dot products on the same tiles. For realistic
+sparse-ANN dims (d ≤ ~100k) a [tile, d] dense slab is modest; the tile size
+comes from the Resources workspace budget like every other tiled op."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.ops.distance import (
+    DistanceType,
+    _pairwise_impl,
+    resolve_metric,
+)
+from raft_tpu.sparse.types import CSR
+from raft_tpu.sparse.convert import csr_to_dense
+from raft_tpu.utils.shape import cdiv
+
+SUPPORTED = (
+    DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
+    DistanceType.InnerProduct, DistanceType.L1, DistanceType.CosineExpanded,
+    DistanceType.JaccardExpanded, DistanceType.Canberra, DistanceType.Linf,
+    DistanceType.LpUnexpanded, DistanceType.HammingUnexpanded,
+    DistanceType.JensenShannon, DistanceType.KLDivergence,
+    DistanceType.DiceExpanded,
+)
+
+
+def _binary_overlap(xd, yd):
+    """Row-pair overlap counts of binarized matrices via one matmul."""
+    xb = (xd != 0).astype(jnp.float32)
+    yb = (yd != 0).astype(jnp.float32)
+    inter = jnp.matmul(xb, yb.T, precision=jax.lax.Precision.HIGHEST)
+    nx = jnp.sum(xb, 1)
+    ny = jnp.sum(yb, 1)
+    return inter, nx, ny
+
+
+def pairwise_distance(
+    x: CSR,
+    y: CSR,
+    metric="euclidean",
+    metric_arg: float = 2.0,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """All-pairs distances between CSR row sets [m, d] × [n, d] → [m, n]
+    (reference: sparse::distance::pairwise_distance, distance.cuh)."""
+    res = ensure_resources(res)
+    m = resolve_metric(metric)
+    if m not in SUPPORTED:
+        raise NotImplementedError(
+            f"metric {m.name} not in the sparse metric set "
+            "(sparse/distance/distance.cuh:38-48)")
+    if x.shape[1] != y.shape[1]:
+        raise ValueError(f"dim mismatch {x.shape} vs {y.shape}")
+
+    # y (the dataset side) is densified once; x streams through in row
+    # tiles sized by the workspace budget, each tile densified by a scatter
+    # over its nnz slice (indptr is concrete here, so slicing is host-side)
+    yd = csr_to_dense(y)
+    n_x, d = x.shape
+    tile = int(np.clip(
+        res.workspace_limit_bytes // max(d * 4 * 4, 1), 8, max(n_x, 8)))
+    indptr = np.asarray(x.indptr)
+
+    def block(lo: int, hi: int) -> jax.Array:
+        s, e = int(indptr[lo]), int(indptr[hi])
+        xt = jnp.zeros((hi - lo, d), x.dtype)
+        rows = (jnp.searchsorted(
+            jnp.asarray(indptr[lo : hi + 1] - indptr[lo])[1:-1],
+            jnp.arange(e - s), side="right")).astype(jnp.int32)
+        xt = xt.at[rows, x.indices[s:e]].add(x.data[s:e])
+        if m == DistanceType.JaccardExpanded:
+            inter, nx, ny = _binary_overlap(xt, yd)
+            union = nx[:, None] + ny[None, :] - inter
+            return 1.0 - inter / jnp.maximum(union, 1.0)
+        if m == DistanceType.DiceExpanded:
+            inter, nx, ny = _binary_overlap(xt, yd)
+            return 1.0 - 2.0 * inter / jnp.maximum(
+                nx[:, None] + ny[None, :], 1.0)
+        return _pairwise_impl(xt, yd, m, float(metric_arg),
+                              res.workspace_limit_bytes)
+
+    if n_x <= tile:
+        return block(0, n_x)
+    return jnp.concatenate(
+        [block(lo, min(lo + tile, n_x)) for lo in range(0, n_x, tile)])
+
+
+def knn(
+    queries: CSR,
+    dataset: CSR,
+    k: int,
+    metric="euclidean",
+    res: Optional[Resources] = None,
+):
+    """Sparse brute-force kNN (reference: sparse/neighbors/knn.cuh
+    brute_force_knn over CSR inputs): pairwise distances + select_k."""
+    from raft_tpu.ops.select_k import select_k
+    from raft_tpu.ops.distance import is_min_close
+
+    res = ensure_resources(res)
+    d = pairwise_distance(queries, dataset, metric, res=res)
+    return select_k(d, k, select_min=is_min_close(metric))

@@ -1,0 +1,140 @@
+"""Sparse linear algebra — spmm/sddmm/degree/norm/transpose/symmetrize/
+laplacian.
+
+Reference: ``raft::sparse::linalg`` (sparse/linalg/spmm.hpp — cuSPARSE SpMM;
+sddmm.hpp; degree.cuh; norm.cuh; symmetrize.cuh; transpose.cuh;
+laplacian spectral helpers under spectral/matrix_wrappers.hpp).
+
+TPU-native design: SpMM with a dense RHS is a segment-sum of gathered rows —
+`dense[cols] * data` scatter-added by row id; that is the pattern XLA/TPU
+executes well (no cuSPARSE analog needed). SDDMM samples a dense product at
+nnz positions with two row gathers and an einsum. All ops take/return the
+functional CSR/COO containers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.convert import coo_to_csr, csr_to_coo
+
+
+def spmm(csr: CSR, dense, alpha: float = 1.0) -> jax.Array:
+    """CSR [n, m] @ dense [m, d] → [n, d] (sparse/linalg/spmm.hpp).
+
+    Gather-scatter formulation: each nnz contributes data·dense[col] to its
+    row — one gather + one segment scatter-add, fully fused by XLA."""
+    dense = jnp.asarray(dense)
+    rows = csr.row_ids()
+    contrib = csr.data[:, None] * dense[csr.indices]  # [nnz, d]
+    out = jnp.zeros((csr.n_rows, dense.shape[1]), contrib.dtype)
+    return alpha * out.at[rows].add(contrib)
+
+
+def spmv(csr: CSR, vec) -> jax.Array:
+    """CSR @ vector."""
+    vec = jnp.asarray(vec)
+    rows = csr.row_ids()
+    contrib = csr.data * vec[csr.indices]
+    return jnp.zeros((csr.n_rows,), contrib.dtype).at[rows].add(contrib)
+
+
+def sddmm(a, b, structure: CSR, alpha: float = 1.0) -> CSR:
+    """Sampled dense-dense matmul (sparse/linalg/sddmm.hpp): values of
+    A·Bᵀ at the nnz positions of ``structure``."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    rows = structure.row_ids()
+    vals = jnp.einsum("nd,nd->n", a[rows], b[structure.indices],
+                      preferred_element_type=jnp.float32)
+    return CSR(structure.indptr, structure.indices,
+               alpha * vals.astype(a.dtype), structure.shape)
+
+
+def degree(csr: CSR) -> jax.Array:
+    """Per-row nnz count (sparse/linalg/degree.cuh)."""
+    return jnp.diff(csr.indptr)
+
+
+def row_norm(csr: CSR, ord: str = "l2") -> jax.Array:
+    """Per-row norms over stored values (sparse/linalg/norm.cuh)."""
+    rows = csr.row_ids()
+    if ord == "l1":
+        v = jnp.abs(csr.data)
+        return jnp.zeros((csr.n_rows,), v.dtype).at[rows].add(v)
+    if ord == "l2":
+        v = csr.data * csr.data
+        return jnp.zeros((csr.n_rows,), v.dtype).at[rows].add(v)
+    if ord == "linf":
+        v = jnp.abs(csr.data)
+        return jnp.zeros((csr.n_rows,), v.dtype).at[rows].max(v)
+    raise ValueError(f"unknown norm {ord!r}")
+
+
+def row_normalize(csr: CSR, ord: str = "l1") -> CSR:
+    """Scale rows to unit norm (sparse/linalg/norm.cuh rowNormalize)."""
+    n = row_norm(csr, ord)
+    if ord == "l2":
+        n = jnp.sqrt(n)
+    scale = 1.0 / jnp.maximum(n, 1e-20)
+    return CSR(csr.indptr, csr.indices, csr.data * scale[csr.row_ids()],
+               csr.shape)
+
+
+def transpose(csr: CSR) -> CSR:
+    """sparse/linalg/transpose.cuh — swap roles and re-sort."""
+    coo = csr_to_coo(csr)
+    t = COO(coo.cols, coo.rows, coo.data, (csr.shape[1], csr.shape[0]))
+    return coo_to_csr(t)
+
+
+def symmetrize(coo: COO, op: str = "max") -> COO:
+    """Make A symmetric: combine with Aᵀ (sparse/linalg/symmetrize.cuh).
+    Duplicate (i,j) entries are combined by ``op`` ('max'|'sum'|'mean') via a
+    dense-keyed segment reduce on the doubled edge list; output keeps the
+    doubled (static) nnz with zero-data entries where a pair collapsed."""
+    both_r = jnp.concatenate([coo.rows, coo.cols])
+    both_c = jnp.concatenate([coo.cols, coo.rows])
+    both_d = jnp.concatenate([coo.data, coo.data])
+    key = both_r.astype(jnp.int64) * coo.shape[1] + both_c
+    order = jnp.argsort(key)
+    key_s = key[order]
+    d_s = both_d[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+    seg = jnp.cumsum(first) - 1  # segment id per entry
+    nseg = both_d.shape[0]
+    if op == "sum":
+        vals = jnp.zeros((nseg,), d_s.dtype).at[seg].add(d_s)
+    elif op == "max":
+        vals = jnp.full((nseg,), -jnp.inf, d_s.dtype).at[seg].max(d_s)
+    elif op == "mean":
+        s = jnp.zeros((nseg,), d_s.dtype).at[seg].add(d_s)
+        c = jnp.zeros((nseg,), jnp.float32).at[seg].add(1.0)
+        vals = s / jnp.maximum(c, 1.0)
+    else:
+        raise ValueError(f"unknown symmetrize op {op!r}")
+    # one representative entry per segment; collapsed duplicates become
+    # zero-data self-loops at (0, 0) — harmless for duplicate-sum
+    # densification AND for MST (self-loops are never selected)
+    d_out = jnp.where(first, vals[seg], 0.0).astype(coo.data.dtype)
+    r_out = jnp.where(first, both_r[order], 0)
+    c_out = jnp.where(first, both_c[order], 0)
+    return COO(r_out, c_out, d_out, coo.shape)
+
+
+def laplacian(adj: CSR, normalized: bool = False) -> jax.Array:
+    """Dense graph Laplacian from a sparse adjacency (the spectral input —
+    reference: spectral/matrix_wrappers.hpp laplacian_matrix_t). Returns
+    dense [n, n]: spectral solvers here use dense matvecs (n is the number
+    of graph nodes, modest by construction)."""
+    from raft_tpu.sparse.convert import csr_to_dense
+
+    a = csr_to_dense(adj)
+    a = jnp.maximum(a, a.T)  # enforce symmetry
+    d = jnp.sum(a, axis=1)
+    if not normalized:
+        return jnp.diag(d) - a
+    inv_sqrt = 1.0 / jnp.sqrt(jnp.maximum(d, 1e-20))
+    return jnp.eye(a.shape[0]) - inv_sqrt[:, None] * a * inv_sqrt[None, :]

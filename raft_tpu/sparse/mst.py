@@ -1,0 +1,112 @@
+"""Minimum spanning tree — Borůvka over an edge list.
+
+Reference: ``raft::sparse::solver::mst`` (sparse/mst/mst_solver.cuh +
+detail/mst_solver_inl.cuh — a GPU Borůvka with per-supervertex min-edge
+selection, used by single-linkage clustering).
+
+TPU-native design: the GPU's atomic min-edge race is replaced by functional
+segment scatter-mins; supervertex contraction is pointer jumping. Each round:
+(1) per-component minimum outgoing edge via two scatter-min passes (weight,
+then canonical-edge-id tie-break — the strict total order that prevents
+tie cycles), (2) union via parent[max_comp] = min_comp (always points to a
+smaller label → acyclic), (3) log-step pointer jumping to flatten labels.
+ceil(log2 n)+1 rounds suffice (components at least halve). All loops are
+``lax.fori_loop`` with static trip counts — one XLA program."""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.sparse.types import COO
+
+
+@functools.partial(jax.jit, static_argnames=("n", "n_rounds", "n_jumps"))
+def _boruvka_jit(u, v, w, n: int, n_rounds: int, n_jumps: int):
+    ne = u.shape[0]
+    big_w = jnp.inf
+    # direction-invariant lexicographic tie-break key, int32-safe: the
+    # canonical endpoint pair (lo, hi) broken in two scatter passes
+    lo_e = jnp.minimum(u, v)
+    hi_e = jnp.maximum(u, v)
+
+    def round_body(_, state):
+        comp, selected = state
+        cu = comp[u]
+        cv = comp[v]
+        alive = cu != cv
+        w_eff = jnp.where(alive, w, big_w)
+        # pass 1: per-component min outgoing weight
+        min_w = jnp.full((n,), big_w, w.dtype).at[cu].min(w_eff)
+        is_min = alive & (w_eff == min_w[cu])
+        # passes 2+3: lexicographic (lo, hi) tie break — identical for both
+        # directions of an edge, strict total order within a component
+        lo_eff = jnp.where(is_min, lo_e, n)
+        min_lo = jnp.full((n,), n, jnp.int32).at[cu].min(lo_eff)
+        is_min2 = is_min & (lo_e == min_lo[cu])
+        hi_eff = jnp.where(is_min2, hi_e, n)
+        min_hi = jnp.full((n,), n, jnp.int32).at[cu].min(hi_eff)
+        chosen = is_min2 & (hi_e == min_hi[cu])
+        selected = selected | chosen
+        # union: larger component label points at the smaller
+        lo = jnp.minimum(cu, cv)
+        hi = jnp.maximum(cu, cv)
+        parent = jnp.arange(n, dtype=jnp.int32)
+        parent = parent.at[jnp.where(chosen, hi, n)].min(
+            jnp.where(chosen, lo, n), mode="drop")
+        # pointer jumping flattens the union forest
+        parent = jax.lax.fori_loop(
+            0, n_jumps, lambda i, p: p[p], parent)
+        comp = parent[comp]
+        return comp, selected
+
+    comp0 = jnp.arange(n, dtype=jnp.int32)
+    sel0 = jnp.zeros((ne,), bool)
+    comp, selected = jax.lax.fori_loop(
+        0, n_rounds, round_body, (comp0, sel0))
+    return comp, selected
+
+
+def mst(
+    graph: COO,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute the MST (forest, if disconnected) of a weighted undirected
+    graph given as a symmetric COO edge list.
+
+    Returns (src, dst, weight) arrays of the selected edges in canonical
+    (src < dst) direction — padded with (-1, -1, inf) to a static n-1 length
+    (reference: Graph_COO output of mst_solver.cuh).
+    """
+    n = graph.shape[0]
+    u = jnp.asarray(graph.rows, jnp.int32)
+    v = jnp.asarray(graph.cols, jnp.int32)
+    w = jnp.asarray(graph.data, jnp.float32)
+    n_rounds = max(int(math.ceil(math.log2(max(n, 2)))) + 1, 1)
+    n_jumps = n_rounds
+    comp, selected = _boruvka_jit(u, v, w, n, n_rounds, n_jumps)
+
+    # extract canonical selected edges (dedup the two directions) on host —
+    # int64 keys need numpy (jax x64 is disabled by default)
+    un = np.asarray(u)
+    vn = np.asarray(v)
+    wn = np.asarray(w)
+    sel = np.asarray(selected)
+    key = (np.minimum(un, vn).astype(np.int64) * n
+           + np.maximum(un, vn).astype(np.int64))
+    e = np.nonzero(sel)[0]
+    _, first = np.unique(key[e], return_index=True)
+    e = e[np.sort(first)]
+    m = n - 1
+    src = np.full((m,), -1, np.int32)
+    dst = np.full((m,), -1, np.int32)
+    wt = np.full((m,), np.inf, np.float32)
+    cnt = min(len(e), m)
+    src[:cnt] = np.minimum(un[e[:cnt]], vn[e[:cnt]])
+    dst[:cnt] = np.maximum(un[e[:cnt]], vn[e[:cnt]])
+    wt[:cnt] = wn[e[:cnt]]
+    return jnp.asarray(src), jnp.asarray(dst), jnp.asarray(wt)

@@ -1,0 +1,82 @@
+"""Sparse matrix containers — CSR and COO.
+
+Reference: ``raft::core`` sparse types (core/sparse_types.hpp,
+core/device_csr_matrix.hpp, core/device_coo_matrix.hpp) — owning/view
+structure-plus-values containers.
+
+TPU-native design: immutable dataclasses of jax.Arrays. TPUs have no sparse
+MXU; these containers exist to hold graph/matrix structure compactly in HBM
+and to feed either segment ops (degree/reduce) or tile-densification
+(distances, spmm with dense rhs). Fixed static shapes (nnz is part of the
+shape) keep everything jit-stable."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class COO:
+    """Coordinate format (core/device_coo_matrix.hpp analog)."""
+
+    rows: jax.Array  # [nnz] int32
+    cols: jax.Array  # [nnz] int32
+    data: jax.Array  # [nnz]
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class CSR:
+    """Compressed sparse row (core/device_csr_matrix.hpp analog)."""
+
+    indptr: jax.Array  # [n_rows + 1] int32
+    indices: jax.Array  # [nnz] int32 column ids
+    data: jax.Array  # [nnz]
+    shape: Tuple[int, int]
+
+    @property
+    def nnz(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def n_rows(self) -> int:
+        return self.shape[0]
+
+    @property
+    def n_cols(self) -> int:
+        return self.shape[1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to per-nnz row ids (sparse/convert/csr.cuh's
+        csr_to_coo row expansion) — searchsorted keeps it one XLA op."""
+        return (jnp.searchsorted(self.indptr[1:-1],
+                                 jnp.arange(self.nnz, dtype=jnp.int32),
+                                 side="right")).astype(jnp.int32)
+
+
+def csr_from_scipy_like(indptr, indices, data, shape) -> CSR:
+    return CSR(jnp.asarray(indptr, jnp.int32),
+               jnp.asarray(indices, jnp.int32),
+               jnp.asarray(data), tuple(shape))
+
+
+def coo_from_arrays(rows, cols, data, shape) -> COO:
+    return COO(jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32),
+               jnp.asarray(data), tuple(shape))

@@ -1,0 +1,65 @@
+"""Ball-cover (exact landmark-pruned kNN) + epsilon-neighborhood tests
+(reference: cpp/test/neighbors/ball_cover.cu, epsilon_neighborhood.cu)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import ball_cover, brute_force, epsilon_neighborhood
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(9)
+    db = rng.standard_normal((2000, 3)).astype(np.float32)
+    q = rng.standard_normal((64, 3)).astype(np.float32)
+    return db, q
+
+
+def test_ball_cover_exact(data):
+    db, q = data
+    index = ball_cover.build(db, metric="euclidean")
+    d, i = ball_cover.knn(index, q, k=10)
+    gt_d, gt_i = brute_force.knn(q, db, k=10, metric="euclidean")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(gt_i))) >= 0.999
+    np.testing.assert_allclose(np.asarray(d), np.asarray(gt_d), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_ball_cover_sqeuclidean_output(data):
+    db, q = data
+    index = ball_cover.build(db, metric="sqeuclidean")
+    d, i = ball_cover.knn(index, q, k=5)
+    gt_d, gt_i = brute_force.knn(q, db, k=5, metric="sqeuclidean")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(gt_i))) >= 0.999
+    np.testing.assert_allclose(np.asarray(d), np.asarray(gt_d), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_ball_cover_haversine():
+    rng = np.random.default_rng(4)
+    # lat ∈ [-π/2, π/2], lon ∈ [-π, π]
+    db = np.stack([rng.uniform(-np.pi / 2, np.pi / 2, 500),
+                   rng.uniform(-np.pi, np.pi, 500)], 1).astype(np.float32)
+    q = np.stack([rng.uniform(-np.pi / 2, np.pi / 2, 20),
+                  rng.uniform(-np.pi, np.pi, 20)], 1).astype(np.float32)
+    index = ball_cover.build(db, metric="haversine")
+    d, i = ball_cover.knn(index, q, k=5)
+    gt_d, gt_i = brute_force.knn(q, db, k=5, metric="haversine")
+    assert float(neighborhood_recall(np.asarray(i), np.asarray(gt_i))) >= 0.99
+
+
+def test_ball_cover_validation(data):
+    db, _ = data
+    with pytest.raises(ValueError, match="supports"):
+        ball_cover.build(db, metric="cosine")
+
+
+def test_eps_neighbors(data):
+    db, q = data
+    eps = 1.0
+    adj, deg = epsilon_neighborhood.eps_neighbors(q, db, eps)
+    d = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    want = d <= eps
+    np.testing.assert_array_equal(np.asarray(adj), want)
+    np.testing.assert_array_equal(np.asarray(deg), want.sum(1))

@@ -1,0 +1,120 @@
+"""linalg + matrix prim tests — reference-vs-numpy pattern
+(cpp/test/linalg/*, cpp/test/matrix/*)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import linalg, matrix
+
+
+@pytest.fixture()
+def a(rng):
+    return rng.standard_normal((40, 24)).astype(np.float32)
+
+
+def test_gemm_gemv_axpy_dot(a, rng):
+    b = rng.standard_normal((24, 8)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.gemm(a, b)), a @ b,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(linalg.gemm(a, b.T, trans_b=True, alpha=2.0)),
+        2.0 * (a @ b), rtol=1e-5, atol=1e-5)
+    v = rng.standard_normal(24).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.gemv(a, v)), a @ v,
+                               rtol=1e-5, atol=1e-5)
+    y = rng.standard_normal(24).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.axpy(2.5, v, y)),
+                               y + 2.5 * v, rtol=1e-6)
+    np.testing.assert_allclose(float(linalg.dot(v, y)), float(v @ y),
+                               rtol=1e-5)
+
+
+def test_reductions_and_norms(a):
+    np.testing.assert_allclose(np.asarray(linalg.coalesced_reduction(a)),
+                               a.sum(-1), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(linalg.strided_reduction(a)),
+                               a.sum(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(linalg.norm(a, "l2", sqrt=True)),
+                               np.linalg.norm(a, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(linalg.norm(a, "l1")),
+                               np.abs(a).sum(1), rtol=1e-5)
+    nz = np.asarray(linalg.normalize(a))
+    np.testing.assert_allclose(np.linalg.norm(nz, axis=1), 1.0, rtol=1e-5)
+
+
+def test_reduce_rows_by_key(rng):
+    x = rng.standard_normal((30, 4)).astype(np.float32)
+    keys = rng.integers(0, 5, 30)
+    got = np.asarray(linalg.reduce_rows_by_key(x, keys, 5))
+    want = np.zeros((5, 4), np.float32)
+    np.add.at(want, keys, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_decompositions(a):
+    q = np.asarray(linalg.qr_get_q(a))
+    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]), atol=1e-4)
+    s = a.T @ a + 24 * np.eye(24, dtype=np.float32)
+    c = np.asarray(linalg.cholesky(s))
+    np.testing.assert_allclose(c @ c.T, s, rtol=1e-3, atol=1e-2)
+    w, v = linalg.eig_dc(s)
+    w, v = np.asarray(w), np.asarray(v)
+    np.testing.assert_allclose(s @ v, v * w[None, :], rtol=1e-2, atol=1e-2)
+    u, sv, vv = linalg.svd(a)
+    recon = np.asarray(u) * np.asarray(sv)[None, :] @ np.asarray(vv).T
+    np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+
+
+def test_rsvd_approximates_topk(rng):
+    # low-rank + noise: rsvd should capture the dominant subspace
+    u = rng.standard_normal((60, 5)).astype(np.float32)
+    v = rng.standard_normal((5, 40)).astype(np.float32)
+    a = u @ v + 0.01 * rng.standard_normal((60, 40)).astype(np.float32)
+    uu, ss, vv = linalg.rsvd(jax.random.key(0), a, k=5)
+    recon = np.asarray(uu) * np.asarray(ss)[None, :] @ np.asarray(vv).T
+    rel = np.linalg.norm(recon - a) / np.linalg.norm(a)
+    assert rel < 0.05, rel
+
+
+def test_lanczos_extremal_eigs(rng):
+    # symmetric with known spectrum
+    n = 50
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    w_true = np.linspace(1, 100, n).astype(np.float32)
+    s = (q * w_true[None, :]) @ q.T
+    s = ((s + s.T) / 2).astype(np.float32)
+    sj = jnp.asarray(s)
+
+    def matvec(v):
+        return jnp.matmul(sj, v, precision=jax.lax.Precision.HIGHEST)
+
+    w, v = linalg.lanczos(matvec, n, 3, key=jax.random.key(1), ncv=40)
+    np.testing.assert_allclose(np.sort(np.asarray(w)), w_true[:3], rtol=0.05)
+    w2, _ = linalg.lanczos(matvec, n, 2, key=jax.random.key(2), ncv=40,
+                           which="largest")
+    np.testing.assert_allclose(np.sort(np.asarray(w2)), w_true[-2:],
+                               rtol=0.02)
+
+
+def test_matrix_ops(a, rng):
+    idx = rng.integers(0, 40, 10)
+    np.testing.assert_array_equal(np.asarray(matrix.gather(a, idx)), a[idx])
+    np.testing.assert_array_equal(
+        np.asarray(matrix.argmax(a)), a.argmax(1))
+    np.testing.assert_array_equal(
+        np.asarray(matrix.argmin(a)), a.argmin(1))
+    np.testing.assert_array_equal(
+        np.asarray(matrix.slice(a, 5, 15, 2, 10)), a[5:15, 2:10])
+    s = np.asarray(matrix.col_wise_sort(a))
+    np.testing.assert_array_equal(s, np.sort(a, axis=0))
+    v, k = matrix.row_wise_sort(a, return_keys=True)
+    np.testing.assert_array_equal(np.asarray(v), np.sort(a, axis=1))
+    r = np.asarray(matrix.reverse(a, axis=1))
+    np.testing.assert_array_equal(r, a[:, ::-1])
+    # select_k re-export sanity
+    vals, ids = matrix.select_k(a, 3)
+    np.testing.assert_allclose(np.asarray(vals), np.sort(a, 1)[:, :3],
+                               rtol=1e-6)

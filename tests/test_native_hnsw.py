@@ -1,0 +1,104 @@
+"""Native C++ runtime + HNSW export tests (reference: bench dataset.hpp bin
+IO, detail/hnsw_types.hpp serializer, detail/agglomerative.cuh labeling,
+detail/ivf_flat_build.cuh list fill)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu import native
+
+
+def test_native_builds():
+    assert native.ensure_built(), "g++ build of libraft_tpu_native.so failed"
+    assert native.available()
+
+
+def test_bin_roundtrip(tmp_path, rng):
+    x = rng.standard_normal((100, 16)).astype(np.float32)
+    p = str(tmp_path / "data.fbin")
+    native.write_bin(p, x)
+    n, d = native.read_bin_header(p)
+    assert (n, d) == (100, 16)
+    np.testing.assert_array_equal(native.read_bin(p), x)
+    np.testing.assert_array_equal(native.read_bin(p, 10, 20), x[10:30])
+    # batch iterator covers everything
+    got = np.concatenate(
+        [b for _, b in native.iter_bin_batches(p, 32)])
+    np.testing.assert_array_equal(got, x)
+
+
+def test_bin_ibin(tmp_path, rng):
+    g = rng.integers(0, 1000, (50, 10)).astype(np.int32)
+    p = str(tmp_path / "gt.ibin")
+    native.write_bin(p, g)
+    np.testing.assert_array_equal(native.read_bin(p), g)
+
+
+def test_pack_lists_matches_numpy(rng):
+    rows = rng.standard_normal((60, 8)).astype(np.float32)
+    labels = rng.integers(0, 5, 60).astype(np.int32)
+    data, ids, sizes = native.pack_lists(rows, labels, 5, 32)
+    assert sizes.sum() == 60
+    for l in range(5):
+        members = np.nonzero(labels == l)[0]
+        assert sizes[l] == len(members)
+        assert set(ids[l, : sizes[l]].tolist()) == set(members.tolist())
+        assert (ids[l, sizes[l]:] == -1).all()
+        # rows land with their ids
+        for p_ in range(sizes[l]):
+            np.testing.assert_array_equal(data[l, p_], rows[ids[l, p_]])
+
+
+def test_pack_lists_rejects_overflow(rng):
+    rows = rng.standard_normal((20, 4)).astype(np.float32)
+    labels = np.zeros(20, np.int32)
+    with pytest.raises(ValueError):
+        native.pack_lists(rows, labels, 2, 8)
+
+
+def test_agglomerative_label_chain():
+    # chain 0-1-2 and 3-4, cut into 2 clusters
+    src = np.array([0, 1, 3], np.int32)
+    dst = np.array([1, 2, 4], np.int32)
+    labels = native.agglomerative_label(src, dst, 5, 2)
+    assert labels[0] == labels[1] == labels[2]
+    assert labels[3] == labels[4]
+    assert labels[0] != labels[3]
+
+
+def test_hnswlib_export_roundtrip(tmp_path, rng):
+    from raft_tpu.neighbors import brute_force, cagra, hnsw
+    from raft_tpu.stats import neighborhood_recall
+
+    db = rng.standard_normal((1000, 16)).astype(np.float32)
+    q = rng.standard_normal((32, 16)).astype(np.float32)
+    index = cagra.build(db, cagra.IndexParams(
+        intermediate_graph_degree=32, graph_degree=16, nn_descent_niter=8))
+    p = str(tmp_path / "index.hnsw")
+    hnsw.from_cagra(index, p)
+    assert os.path.getsize(p) > 1000 * 16 * 4  # at least the vectors
+
+    loaded = hnsw.load(p)
+    np.testing.assert_allclose(loaded.dataset, db, rtol=1e-6)
+    # links round-trip (order preserved for valid entries)
+    g = np.asarray(index.graph)
+    np.testing.assert_array_equal(loaded.graph[:, : g.shape[1]], g)
+
+    d, i = hnsw.search(loaded, q, k=5, ef=64)
+    _, gt = brute_force.knn(q, db, k=5, metric="sqeuclidean")
+    assert float(neighborhood_recall(i, np.asarray(gt))) >= 0.8
+
+
+def test_hnswlib_python_fallback_writer(tmp_path, rng):
+    from raft_tpu.neighbors import hnsw
+
+    db = rng.standard_normal((50, 8)).astype(np.float32)
+    graph = rng.integers(0, 50, (50, 8)).astype(np.int32)
+    p1 = str(tmp_path / "c.hnsw")
+    p2 = str(tmp_path / "py.hnsw")
+    native.hnswlib_write(p1, db, graph)
+    native._hnswlib_write_py(p2, db, graph)
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read(), "C++ and python writers must agree"

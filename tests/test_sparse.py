@@ -1,0 +1,148 @@
+"""Sparse layer tests — conversions, linalg, distances, MST, spectral,
+single-linkage (reference: cpp/test/sparse/*, cpp/test/cluster/linkage.cu)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import convert, distance, linalg, mst, spectral, types
+from raft_tpu.cluster import single_linkage
+from raft_tpu.cluster.single_linkage import SingleLinkageParams
+
+
+def _random_csr(rng, n, m, density=0.2):
+    dense = rng.standard_normal((n, m)).astype(np.float32)
+    dense[rng.random((n, m)) > density] = 0.0
+    nnz = int((dense != 0).sum())
+    rows, cols = np.nonzero(dense)
+    coo = types.coo_from_arrays(rows, cols, dense[rows, cols], (n, m))
+    return dense, convert.coo_to_csr(coo)
+
+
+def test_conversions_roundtrip(rng):
+    dense, csr = _random_csr(rng, 20, 15)
+    np.testing.assert_allclose(np.asarray(convert.csr_to_dense(csr)), dense)
+    coo = convert.csr_to_coo(csr)
+    np.testing.assert_allclose(np.asarray(convert.coo_to_dense(coo)), dense)
+    back = convert.coo_to_csr(coo)
+    np.testing.assert_allclose(np.asarray(convert.csr_to_dense(back)), dense)
+
+
+def test_spmm_spmv_sddmm(rng):
+    dense, csr = _random_csr(rng, 20, 15)
+    b = rng.standard_normal((15, 6)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.spmm(csr, b)), dense @ b,
+                               rtol=1e-4, atol=1e-4)
+    v = rng.standard_normal(15).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.spmv(csr, v)), dense @ v,
+                               rtol=1e-4, atol=1e-4)
+    # sddmm samples A·Bᵀ at structure nnz
+    a2 = rng.standard_normal((20, 6)).astype(np.float32)
+    b2 = rng.standard_normal((15, 6)).astype(np.float32)
+    out = linalg.sddmm(a2, b2, csr)
+    full = a2 @ b2.T
+    got = np.asarray(convert.csr_to_dense(out))
+    want = np.where(dense != 0, full, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_degree_norms_transpose(rng):
+    dense, csr = _random_csr(rng, 12, 9)
+    np.testing.assert_array_equal(np.asarray(linalg.degree(csr)),
+                                  (dense != 0).sum(1))
+    np.testing.assert_allclose(np.asarray(linalg.row_norm(csr, "l2")),
+                               (dense ** 2).sum(1), rtol=1e-5)
+    t = linalg.transpose(csr)
+    np.testing.assert_allclose(np.asarray(convert.csr_to_dense(t)), dense.T)
+
+
+def test_sparse_pairwise_and_knn(rng):
+    dx, x = _random_csr(rng, 25, 30, 0.3)
+    dy, y = _random_csr(rng, 18, 30, 0.3)
+    d = np.asarray(distance.pairwise_distance(x, y, "euclidean"))
+    want = np.sqrt(((dx[:, None, :] - dy[None, :, :]) ** 2).sum(-1))
+    np.testing.assert_allclose(d, want, rtol=1e-3, atol=1e-3)
+    # jaccard on binary structure
+    dj = np.asarray(distance.pairwise_distance(x, y, "jaccard"))
+    bx = dx != 0
+    by = dy != 0
+    inter = (bx[:, None, :] & by[None, :, :]).sum(-1)
+    union = (bx[:, None, :] | by[None, :, :]).sum(-1)
+    wantj = 1.0 - inter / np.maximum(union, 1)
+    np.testing.assert_allclose(dj, wantj, rtol=1e-5, atol=1e-5)
+    vals, idx = distance.knn(x, y, k=3, metric="euclidean")
+    np.testing.assert_array_equal(np.asarray(idx), np.argsort(want, 1)[:, :3])
+
+
+def test_mst_matches_scipy_style(rng):
+    # build a random connected graph and check MST weight vs a prim's
+    # implementation in numpy
+    n = 30
+    pts = rng.standard_normal((n, 2)).astype(np.float32)
+    full = np.sqrt(((pts[:, None] - pts[None, :]) ** 2).sum(-1))
+    # complete graph edge list (both directions, no self)
+    rows, cols = np.nonzero(~np.eye(n, dtype=bool))
+    coo = types.coo_from_arrays(rows, cols, full[rows, cols], (n, n))
+    src, dst, w = mst.mst(coo)
+    w = np.asarray(w)
+    got_total = w[np.isfinite(w)].sum()
+    assert np.isfinite(w).sum() == n - 1
+
+    # prim's reference
+    in_tree = np.zeros(n, bool)
+    in_tree[0] = True
+    best = full[0].copy()
+    total = 0.0
+    for _ in range(n - 1):
+        best[in_tree] = np.inf
+        j = int(np.argmin(best))
+        total += best[j]
+        in_tree[j] = True
+        best = np.minimum(best, full[j])
+    np.testing.assert_allclose(got_total, total, rtol=1e-5)
+
+
+def test_mst_disconnected_forest():
+    # two triangles, no connection: forest with 4 edges
+    rows = np.array([0, 1, 2, 0, 3, 4, 5, 3])
+    cols = np.array([1, 2, 0, 2, 4, 5, 3, 5])
+    w = np.ones(8, np.float32)
+    both_r = np.concatenate([rows, cols])
+    both_c = np.concatenate([cols, rows])
+    both_w = np.concatenate([w, w])
+    coo = types.coo_from_arrays(both_r, both_c, both_w, (6, 6))
+    src, dst, wt = mst.mst(coo)
+    assert np.isfinite(np.asarray(wt)).sum() == 4
+
+
+def test_spectral_partition_two_blobs(rng):
+    # two dense communities weakly connected
+    n = 40
+    a = np.zeros((n, n), np.float32)
+    a[:20, :20] = rng.random((20, 20)) < 0.5
+    a[20:, 20:] = rng.random((20, 20)) < 0.5
+    a[0, 20] = a[20, 0] = 1.0
+    np.fill_diagonal(a, 0)
+    a = np.maximum(a, a.T).astype(np.float32)
+    rows, cols = np.nonzero(a)
+    csr = convert.coo_to_csr(
+        types.coo_from_arrays(rows, cols, a[rows, cols], (n, n)))
+    labels, emb = spectral.partition(csr, 2)
+    same1 = (labels[:20] == labels[0]).mean()
+    same2 = (labels[20:] == labels[20]).mean()
+    assert same1 >= 0.9 and same2 >= 0.9
+    cut, ratio = spectral.analyze_partition(csr, labels)
+    assert cut <= 4.0  # only the weak bridge should be cut
+
+
+def test_single_linkage_two_moons_style(rng):
+    # two well-separated blobs → single linkage splits them perfectly
+    a = rng.standard_normal((30, 2)).astype(np.float32)
+    b = rng.standard_normal((30, 2)).astype(np.float32) + 20.0
+    x = np.concatenate([a, b])
+    labels = single_linkage.single_linkage(
+        x, SingleLinkageParams(n_clusters=2, connectivity_k=10))
+    assert len(np.unique(labels)) == 2
+    assert len(np.unique(labels[:30])) == 1
+    assert len(np.unique(labels[30:])) == 1
