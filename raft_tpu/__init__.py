@@ -13,6 +13,7 @@ instead of hand-rolled CUDA, jax.sharding.Mesh collectives instead of NCCL.
 
 from raft_tpu.core.resources import Resources
 from raft_tpu import core, ops, cluster, neighbors, parallel, sparse, stats, utils
+from raft_tpu import bench, common, distance, matrix, random
 
 __version__ = "0.1.0"
 
@@ -25,6 +26,11 @@ __all__ = [
     "parallel",
     "sparse",
     "stats",
+    "bench",
+    "common",
+    "distance",
+    "matrix",
+    "random",
     "utils",
     "__version__",
 ]

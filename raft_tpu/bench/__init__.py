@@ -1,0 +1,15 @@
+"""Benchmark harness (SURVEY.md §2.11/§2.10): raft-ann-bench-compatible
+run configs, QPS/recall measurement, CSV + pareto export, groundtruth
+generation. CLI: ``python -m raft_tpu.bench --conf <config.json>``."""
+
+from raft_tpu.bench import export, runner
+from raft_tpu.bench.runner import (
+    ALGOS,
+    AnnAlgo,
+    DatasetSpec,
+    generate_groundtruth,
+    run_benchmark,
+)
+
+__all__ = ["export", "runner", "ALGOS", "AnnAlgo", "DatasetSpec",
+           "generate_groundtruth", "run_benchmark"]

@@ -1,0 +1,42 @@
+"""CLI: ``python -m raft_tpu.bench --conf config.json [--k 10] ...``
+
+The raft-ann-bench.run orchestration analog (python/raft-ann-bench
+run/__main__.py): reads a run config, executes every index/search combo,
+writes JSON-lines + CSV (+ optional pareto plot)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="raft_tpu.bench")
+    p.add_argument("--conf", required=True, help="run config JSON path")
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--out", default="bench_results.jsonl")
+    p.add_argument("--csv", default=None)
+    p.add_argument("--plot", default=None)
+    p.add_argument("--pareto", action="store_true")
+    args = p.parse_args(argv)
+
+    from raft_tpu.bench import export, runner
+
+    with open(args.conf) as f:
+        config = json.load(f)
+    rows = runner.run_benchmark(config, k=args.k, batch_size=args.batch_size,
+                                search_iters=args.iters, out_path=args.out)
+    for r in rows:
+        print(json.dumps(r))
+    if args.csv:
+        export.export_csv(rows, args.csv, pareto=args.pareto)
+    if args.plot:
+        export.plot(rows, args.plot)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

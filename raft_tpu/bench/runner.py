@@ -1,0 +1,325 @@
+"""End-to-end ANN benchmark runner.
+
+Reference: ``raft-ann-bench`` (python/raft-ann-bench/src — the `run`
+orchestrator feeding JSON configs to the C++ gbench harness,
+cpp/bench/ann/src/common/benchmark.hpp:379-509) and the ``ANN<T>`` plugin
+interface (bench/ann/src/common/ann_types.hpp:85-118: build / search /
+set_search_param / save / load).
+
+TPU-native design: one Python process drives JAX directly (the "harness" is
+jit + block_until_ready timing). Config files use the same shape and
+parameter names as raft-ann-bench's run/conf JSONs (nlist/nprobe/pq_dim/
+itopk/…) so existing configs translate 1:1; datasets are fbin/ibin files
+read through the native IO layer. Results are JSON-lines with QPS, recall
+and build time — the columns data_export/plot consume."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from raft_tpu import native
+from raft_tpu.core.resources import Resources
+from raft_tpu.stats import neighborhood_recall
+
+
+# ------------------------------------------------------------ algo registry
+
+
+class AnnAlgo:
+    """The ANN<T>-style plugin seam (ann_types.hpp:85-118): build / search /
+    save / load with dict params."""
+
+    name = "base"
+
+    def build(self, dataset: np.ndarray, build_param: Dict[str, Any],
+              metric: str, res: Resources):
+        raise NotImplementedError
+
+    def search(self, index, queries: np.ndarray, k: int,
+               search_param: Dict[str, Any], res: Resources):
+        raise NotImplementedError
+
+    def save(self, index, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, res: Resources):
+        raise NotImplementedError
+
+
+class BruteForce(AnnAlgo):
+    name = "raft_brute_force"
+
+    def build(self, dataset, build_param, metric, res):
+        from raft_tpu.neighbors import brute_force
+
+        return brute_force.build(dataset, metric=metric, res=res)
+
+    def search(self, index, queries, k, search_param, res):
+        from raft_tpu.neighbors import brute_force
+
+        return brute_force.search(index, queries, k, res=res)
+
+    def save(self, index, path):
+        from raft_tpu.neighbors import brute_force
+
+        brute_force.serialize(index, path)
+
+    def load(self, path, res):
+        from raft_tpu.neighbors import brute_force
+
+        return brute_force.deserialize(path, res=res)
+
+
+class IvfFlat(AnnAlgo):
+    name = "raft_ivf_flat"
+
+    def build(self, dataset, build_param, metric, res):
+        from raft_tpu.neighbors import ivf_flat
+
+        params = ivf_flat.IndexParams(
+            n_lists=int(build_param.get("nlist", 1024)),
+            kmeans_n_iters=int(build_param.get("niter", 20)),
+            kmeans_trainset_fraction=_ratio(build_param.get("ratio", 2)),
+            metric=metric,
+        )
+        return ivf_flat.build(dataset, params, res=res)
+
+    def search(self, index, queries, k, search_param, res):
+        from raft_tpu.neighbors import ivf_flat
+
+        sp = ivf_flat.SearchParams(
+            n_probes=int(search_param.get("nprobe", 20)))
+        return ivf_flat.search(index, queries, k, sp, res=res)
+
+    def save(self, index, path):
+        from raft_tpu.neighbors import ivf_flat
+
+        ivf_flat.serialize(index, path)
+
+    def load(self, path, res):
+        from raft_tpu.neighbors import ivf_flat
+
+        return ivf_flat.deserialize(path, res=res)
+
+
+class IvfPq(AnnAlgo):
+    name = "raft_ivf_pq"
+
+    def build(self, dataset, build_param, metric, res):
+        from raft_tpu.neighbors import ivf_pq
+
+        params = ivf_pq.IndexParams(
+            n_lists=int(build_param.get("nlist", 1024)),
+            pq_dim=int(build_param.get("pq_dim", 0)),
+            pq_bits=int(build_param.get("pq_bits", 8)),
+            kmeans_n_iters=int(build_param.get("niter", 20)),
+            kmeans_trainset_fraction=_ratio(build_param.get("ratio", 2)),
+            metric=metric,
+        )
+        return ivf_pq.build(dataset, params, res=res)
+
+    def search(self, index, queries, k, search_param, res):
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors import ivf_pq, refine
+
+        dtypes = {"float": jnp.float32, "fp32": jnp.float32,
+                  "half": jnp.bfloat16, "fp16": jnp.bfloat16,
+                  "fp8": jnp.bfloat16, "bf16": jnp.bfloat16}
+        sp = ivf_pq.SearchParams(
+            n_probes=int(search_param.get("nprobe", 20)),
+            lut_dtype=dtypes[search_param.get("smemLutDtype", "float")],
+            internal_distance_dtype=dtypes[
+                search_param.get("internalDistanceDtype", "float")],
+        )
+        rr = float(search_param.get("refine_ratio", 1.0))
+        if rr > 1.0:
+            d, i = ivf_pq.search(index, queries,
+                                 int(np.ceil(k * rr)), sp, res=res)
+            return refine.refine(self._dataset, queries, i, k,
+                                 metric=index.metric, res=res)
+        return ivf_pq.search(index, queries, k, sp, res=res)
+
+    def save(self, index, path):
+        from raft_tpu.neighbors import ivf_pq
+
+        ivf_pq.serialize(index, path)
+
+    def load(self, path, res):
+        from raft_tpu.neighbors import ivf_pq
+
+        return ivf_pq.deserialize(path, res=res)
+
+
+class Cagra(AnnAlgo):
+    name = "raft_cagra"
+
+    def build(self, dataset, build_param, metric, res):
+        from raft_tpu.neighbors import cagra
+
+        algo = {"ivf_pq": cagra.BuildAlgo.IVF_PQ,
+                "nn_descent": cagra.BuildAlgo.NN_DESCENT}[
+            build_param.get("graph_build_algo", "nn_descent").lower()]
+        params = cagra.IndexParams(
+            graph_degree=int(build_param.get("graph_degree", 64)),
+            intermediate_graph_degree=int(
+                build_param.get("intermediate_graph_degree", 128)),
+            build_algo=algo,
+            nn_descent_niter=int(build_param.get("nn_descent_niter", 20)),
+            metric=metric,
+        )
+        return cagra.build(dataset, params, res=res)
+
+    def search(self, index, queries, k, search_param, res):
+        from raft_tpu.neighbors import cagra
+
+        sp = cagra.SearchParams(
+            itopk_size=int(search_param.get("itopk", 64)),
+            search_width=int(search_param.get("search_width", 1)),
+            max_iterations=int(search_param.get("max_iterations", 0)),
+        )
+        return cagra.search(index, queries, k, sp, res=res)
+
+    def save(self, index, path):
+        from raft_tpu.neighbors import cagra
+
+        cagra.serialize(index, path)
+
+    def load(self, path, res):
+        from raft_tpu.neighbors import cagra
+
+        return cagra.deserialize(path, res=res)
+
+
+ALGOS: Dict[str, Callable[[], AnnAlgo]] = {
+    a.name: a for a in (BruteForce, IvfFlat, IvfPq, Cagra)
+}
+
+
+def _ratio(r) -> float:
+    """raft-ann-bench 'ratio' = subsample divisor (2 → half the data)."""
+    r = float(r)
+    return 1.0 / r if r >= 1.0 else r
+
+
+_METRIC_MAP = {"euclidean": "sqeuclidean", "angular": "cosine",
+               "inner_product": "inner_product", "ip": "inner_product",
+               "sqeuclidean": "sqeuclidean", "cosine": "cosine"}
+
+
+# ------------------------------------------------------------------- runner
+
+
+@dataclasses.dataclass
+class DatasetSpec:
+    """Dataset block of a run config (run/conf/*.json 'dataset')."""
+
+    name: str
+    base_file: str
+    query_file: str
+    groundtruth_neighbors_file: Optional[str] = None
+    distance: str = "euclidean"
+    subset_size: Optional[int] = None
+
+    def load(self):
+        base = native.read_bin(self.base_file, 0, self.subset_size)
+        queries = native.read_bin(self.query_file)
+        gt = None
+        if self.groundtruth_neighbors_file and os.path.exists(
+                self.groundtruth_neighbors_file):
+            gt = native.read_bin(self.groundtruth_neighbors_file,
+                                 dtype=np.int32)
+        return base, queries, gt
+
+
+def generate_groundtruth(dataset: np.ndarray, queries: np.ndarray, k: int,
+                         metric: str = "euclidean",
+                         res: Optional[Resources] = None) -> np.ndarray:
+    """Exact ground truth via brute force (the generate_groundtruth CLI,
+    python/raft-ann-bench generate_groundtruth)."""
+    from raft_tpu.neighbors import brute_force
+
+    _, idx = brute_force.knn(queries, dataset,
+                             k=k, metric=_METRIC_MAP.get(metric, metric),
+                             res=res)
+    return np.asarray(idx)
+
+
+def run_benchmark(
+    config: Dict[str, Any],
+    k: int = 10,
+    batch_size: Optional[int] = None,
+    search_iters: int = 3,
+    out_path: Optional[str] = None,
+    res: Optional[Resources] = None,
+) -> List[Dict[str, Any]]:
+    """Run every index/search-param combo in a raft-ann-bench-shaped config.
+
+    ``config``: {"dataset": {...}, "index": [{"name", "algo",
+    "build_param", "search_params": [...]}]}. Returns result rows
+    (one per search param set): name, algo, build_time, qps, recall, k…
+    """
+    res = res or Resources()
+    ds = DatasetSpec(**config["dataset"])
+    base, queries, gt = ds.load()
+    metric = _METRIC_MAP.get(ds.distance, ds.distance)
+    if gt is None:
+        gt = generate_groundtruth(base, queries, k, metric, res=res)
+    gt = gt[:, :k]
+
+    results = []
+    for index_conf in config["index"]:
+        algo = ALGOS[index_conf["algo"]]()
+        t0 = time.perf_counter()
+        index = algo.build(base, index_conf.get("build_param", {}), metric,
+                           res)
+        jax.effects_barrier()
+        build_time = time.perf_counter() - t0
+        if isinstance(algo, IvfPq):
+            algo._dataset = base  # for refine_ratio re-ranking
+        for sp in index_conf.get("search_params", [{}]):
+            row = _run_search(algo, index, queries, k, sp, gt, batch_size,
+                              search_iters, res)
+            row.update({"name": index_conf.get("name", index_conf["algo"]),
+                        "algo": index_conf["algo"],
+                        "dataset": ds.name,
+                        "build_time": round(build_time, 3),
+                        "search_param": sp})
+            results.append(row)
+            if out_path:
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(row) + "\n")
+    return results
+
+
+def _run_search(algo, index, queries, k, search_param, gt, batch_size,
+                iters, res):
+    nq = len(queries)
+    bs = batch_size or nq
+
+    def run_all():
+        outs_d, outs_i = [], []
+        for s in range(0, nq, bs):
+            d, i = algo.search(index, queries[s : s + bs], k, search_param,
+                               res)
+            outs_d.append(d)
+            outs_i.append(i)
+        jax.block_until_ready((outs_d, outs_i))
+        return np.concatenate([np.asarray(i) for i in outs_i])
+
+    idx = run_all()  # warmup + correctness
+    recall = float(neighborhood_recall(idx[:, :k], gt))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_all()
+    dt = (time.perf_counter() - t0) / iters
+    return {"k": k, "batch_size": bs, "qps": round(nq / dt, 1),
+            "latency_ms": round(1000.0 * dt / max(nq // bs, 1), 3),
+            "recall": round(recall, 4)}

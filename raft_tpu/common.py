@@ -1,0 +1,52 @@
+"""pylibraft-parity namespace: ``raft_tpu.common``.
+
+Mirrors ``pylibraft.common`` (python/pylibraft/pylibraft/common —
+DeviceResources handle.pyx:34-138, device_ndarray, auto-sync decorators).
+On TPU the handle is the Resources context; ``device_ndarray`` is a
+jax.Array placed on device — ``__cuda_array_interface__`` interop becomes
+plain ``__array__``/dlpack, which jax.numpy already speaks."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources, default_resources
+
+# pylibraft.common.DeviceResources → the Resources context
+DeviceResources = Resources
+
+
+def device_ndarray(array_like, dtype=None) -> jax.Array:
+    """Place an array on device (pylibraft.common.device_ndarray analog —
+    accepts anything numpy/dlpack-convertible)."""
+    a = jnp.asarray(array_like, dtype=dtype)
+    return jax.device_put(a)
+
+
+def auto_sync_resources(fn):
+    """Decorator: inject a default Resources when ``res=None`` and block on
+    returned arrays (the @auto_sync_handle pattern,
+    neighbors/ivf_pq/ivf_pq.pyx:310-312)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, res=None, **kwargs):
+        res = res or default_resources()
+        out = fn(*args, res=res, **kwargs)
+        res.sync(*[o for o in jax.tree_util.tree_leaves(out)
+                   if isinstance(o, jax.Array)])
+        return out
+
+    return wrapper
+
+
+def to_host(x) -> np.ndarray:
+    """Device → host copy (auto_convert_output analog)."""
+    return np.asarray(jax.device_get(x))
+
+
+__all__ = ["DeviceResources", "Resources", "device_ndarray",
+           "auto_sync_resources", "to_host"]

@@ -1,0 +1,18 @@
+"""pylibraft-parity namespace: ``raft_tpu.distance``.
+
+Mirrors ``pylibraft.distance`` (python/pylibraft/pylibraft/distance —
+pairwise_distance, fused_l2_nn_argmin) so reference users find the same
+import paths; implementations live in ops.distance / ops.fused_l2_nn."""
+
+from raft_tpu.ops.distance import (  # noqa: F401
+    DistanceType,
+    is_min_close,
+    pairwise_distance,
+    resolve_metric,
+)
+from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin  # noqa: F401
+
+DISTANCE_TYPES = [t.name for t in DistanceType]
+
+__all__ = ["DistanceType", "DISTANCE_TYPES", "pairwise_distance",
+           "fused_l2_nn_argmin", "is_min_close", "resolve_metric"]

@@ -1,0 +1,9 @@
+"""pylibraft-parity namespace: ``raft_tpu.matrix``.
+
+Mirrors ``pylibraft.matrix`` (python/pylibraft/pylibraft/matrix —
+select_k); the full matrix-prims surface lives in ops.matrix."""
+
+from raft_tpu.ops.matrix import *  # noqa: F401,F403
+from raft_tpu.ops.matrix import select_k, SelectAlgo  # noqa: F401
+
+__all__ = ["select_k", "SelectAlgo"]

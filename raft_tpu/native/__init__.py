@@ -32,9 +32,11 @@ _build_failed = False
 
 
 def ensure_built(force: bool = False) -> bool:
-    """Compile the shared library if needed; returns availability."""
+    """Compile the shared library if missing or older than its source;
+    returns availability."""
     global _build_failed
-    if os.path.exists(_SO) and not force:
+    if (os.path.exists(_SO) and not force
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
         return True
     if _build_failed and not force:
         return False
@@ -45,17 +47,22 @@ def ensure_built(force: bool = False) -> bool:
         return True
     except Exception:
         _build_failed = True
-        return False
+        return os.path.exists(_SO)
 
 
 def _get_lib():
-    global _lib
+    global _lib, _build_failed
     with _lib_lock:
         if _lib is not None:
             return _lib
         if not ensure_built():
             return None
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            # stale/foreign-arch artifact: the numpy fallbacks take over
+            _build_failed = True
+            return None
         lib.bin_read_header.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64)]

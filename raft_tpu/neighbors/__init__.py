@@ -10,8 +10,9 @@ from raft_tpu.neighbors import (
     ivf_flat,
     ivf_pq,
     nn_descent,
+    rbc,
     refine,
 )
 
 __all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "refine"]
+           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "rbc", "refine"]

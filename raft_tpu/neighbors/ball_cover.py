@@ -7,14 +7,16 @@ triangle-inequality-pruned scan passes). Supported metrics: L2 family and
 haversine, as in the reference.
 
 TPU-native design: the index is an IVF-like padded layout ([L, pad, dim]
-member lists + radii). Search is the two-pass RBC scheme recast for tiles:
-pass 1 scans the ``n_init_probes`` closest landmarks' lists (dense batched
-einsum) for a kth-distance estimate; pass 2 applies the triangle-inequality
-lower bound |d(q, lm)| − radius_lm > kth → the landmark's list cannot
-improve the result. Pruning on TPU pays at *tile* granularity: a list is
-scanned only if any query in the tile still needs it, and per-query masks
-keep exactness. Worst case degrades to brute force — exactly the RBC
-guarantee."""
+member lists + radii). Search is the two-pass RBC scheme split across a
+host decision point: pass 1 (jit) scans the ``n_init_probes`` closest
+landmarks' lists for a kth-distance estimate; the host then applies the
+triangle-inequality lower bound d(q, lm) − radius_lm ≥ kth → such lists
+cannot improve any query and are dropped from pass 2's shape entirely
+(bucketed to powers of two to bound recompiles); pass 2 (jit) scans only
+the surviving union with per-query bound masks for exactness. Pruning on
+TPU must change the *shape*, not mask lanes — the one host sync is what
+buys real compute savings. Worst case degrades to brute force — exactly
+the RBC guarantee."""
 
 from __future__ import annotations
 
@@ -101,70 +103,61 @@ def build(
                           jnp.asarray(sizes), jnp.asarray(radii), m, n)
 
 
+def _scan_gathered(q, g_data, g_valid, metric: DistanceType):
+    nq, dim = q.shape
+    flat = g_data.reshape(nq, -1, dim) if g_data.ndim == 4 else g_data
+    if metric == DistanceType.Haversine:
+        qd = jax.vmap(lambda qq, pts: haversine(qq[None], pts)[0])(q, flat)
+    else:
+        # rooted L2 keeps the triangle inequality valid for pruning
+        qd = gathered_distances(q, flat, DistanceType.L2SqrtExpanded)
+    return jnp.where(g_valid.reshape(nq, -1), qd.reshape(nq, -1), jnp.inf)
+
+
 @functools.partial(jax.jit, static_argnames=("metric", "k", "init_probes"))
-def _search_jit(queries, landmarks, list_data, list_indices, list_sizes,
-                radii, metric: DistanceType, k: int, init_probes: int):
+def _pass1_jit(queries, landmarks, list_data, list_indices, list_sizes,
+               metric: DistanceType, k: int, init_probes: int):
     nq, dim = queries.shape
     L, pad, _ = list_data.shape
     q = queries.astype(jnp.float32)
     lm_d = _rooted_dist(q, landmarks, metric)  # [nq, L] rooted
-
     valid_slot = jnp.arange(pad)[None, :] < list_sizes[:, None]
 
-    def scan_lists(probe_ids):
-        """Scan given landmark lists: probe_ids [nq, P] → (d, ids)."""
-        g_data = list_data[probe_ids]  # [nq, P, pad, dim]
-        g_idx = list_indices[probe_ids]
-        g_valid = valid_slot[probe_ids]
-        flat = g_data.reshape(nq, -1, dim)
-        if metric == DistanceType.Haversine:
-            qd = jax.vmap(lambda qq, pts: haversine(qq[None], pts)[0])(
-                q, flat)
-        else:
-            # rooted L2 keeps the triangle inequality valid for pruning
-            qd = gathered_distances(q, flat, DistanceType.L2SqrtExpanded)
-        d = qd.reshape(nq, -1)
-        d = jnp.where(g_valid.reshape(nq, -1), d, jnp.inf)
-        return d, g_idx.reshape(nq, -1)
-
-    # ---- pass 1: closest landmarks give the kth-distance estimate
     _, probes = select_k(lm_d, init_probes, select_min=True)
-    d1, i1 = scan_lists(probes)
+    d1 = _scan_gathered(q, list_data[probes], valid_slot[probes], metric)
+    i1 = list_indices[probes].reshape(nq, -1)
     kk = min(k, d1.shape[1])
     best_d, best_sel = select_k(d1, kk, select_min=True)
     best_i = jnp.take_along_axis(i1, best_sel, axis=1)
-    kth = best_d[:, -1]  # [nq]
+    return best_d, best_i, lm_d, probes
 
-    # ---- pass 2: triangle-inequality prune — a list can contain a closer
-    # point only if d(q, lm) − radius_lm < kth
-    lower_bound = lm_d - radii[None, :]
-    needed = lower_bound < kth[:, None]  # [nq, L]
-    # mask out already-scanned probes
-    scanned = jnp.zeros((nq, L), bool).at[
-        jnp.arange(nq)[:, None], probes].set(True)
-    needed = needed & ~scanned
-    # scan all lists directly from the query-invariant packed layout — one
-    # [nq, L·pad] distance matrix, NO per-query data copy; the bound mask
-    # delivers exactness and zeroes pruned columns (RBC's win on TPU is the
-    # pass-1/kth-bound structure, not per-element skipping)
-    flat_pts = list_data.reshape(L * pad, dim)
-    if metric == DistanceType.Haversine:
-        d_all = haversine(q, flat_pts)
-    else:
-        d_all = _rooted_dist(q, flat_pts, metric)
-    flat_valid = valid_slot.reshape(1, L * pad)
-    i_all = jnp.broadcast_to(
-        list_indices.reshape(1, L * pad), (nq, L * pad))
-    mask = jnp.repeat(needed, pad, axis=1) & flat_valid
+
+@functools.partial(jax.jit, static_argnames=("metric", "k"))
+def _pass2_jit(queries, sub_data, sub_indices, sub_valid, needed_sub,
+               best_d, best_i, metric: DistanceType, k: int):
+    """Scan only the union-of-needed lists ([M, pad, …], M « L) with the
+    per-query bound mask for exactness."""
+    nq = queries.shape[0]
+    M, pad, dim = sub_data.shape
+    q = queries.astype(jnp.float32)
+    flat_valid = sub_valid.reshape(1, M * pad)
+    d_all = _scan_gathered(
+        q, jnp.broadcast_to(sub_data.reshape(1, M * pad, dim),
+                            (nq, M * pad, dim)),
+        jnp.broadcast_to(flat_valid, (nq, M * pad)), metric)
+    mask = jnp.repeat(needed_sub, pad, axis=1)
     d_all = jnp.where(mask, d_all, jnp.inf)
-
+    i_all = jnp.broadcast_to(sub_indices.reshape(1, M * pad), (nq, M * pad))
     cat_d = jnp.concatenate([best_d, d_all], axis=1)
     cat_i = jnp.concatenate([best_i, i_all], axis=1)
-    out_d, sel = select_k(cat_d, kk, select_min=True)
-    out_i = jnp.take_along_axis(cat_i, sel, axis=1)
+    out_d, sel = select_k(cat_d, min(k, cat_d.shape[1]), select_min=True)
+    return out_d, jnp.take_along_axis(cat_i, sel, axis=1)
+
+
+def _finalize(out_d, out_i, k: int, metric: DistanceType):
+    kk = out_d.shape[1]
     if kk < k:
-        out_d = jnp.pad(out_d, ((0, 0), (0, k - kk)),
-                        constant_values=jnp.inf)
+        out_d = jnp.pad(out_d, ((0, 0), (0, k - kk)), constant_values=jnp.inf)
         out_i = jnp.pad(out_i, ((0, 0), (0, k - kk)), constant_values=-1)
     if metric == DistanceType.L2Expanded:
         out_d = out_d * out_d  # unrooted output for sqeuclidean parity
@@ -183,8 +176,37 @@ def knn(
     ensure_resources(res)
     queries = jnp.asarray(queries)
     L = index.n_landmarks
+    pad = index.list_data.shape[1]
     p = int(n_init_probes or max(min(L, int(math.sqrt(L)) + 1), 1))
     p = min(max(p, 1), L)
-    return _search_jit(queries, index.landmarks, index.list_data,
-                       index.list_indices, index.list_sizes, index.radii,
-                       index.metric, int(k), p)
+
+    best_d, best_i, lm_d, probes = _pass1_jit(
+        queries, index.landmarks, index.list_data, index.list_indices,
+        index.list_sizes, index.metric, int(k), p)
+
+    # host-side pruning decision: union of lists any query still needs
+    # (the triangle-inequality bound |d(q,lm)| − radius > kth ⇒ skip). The
+    # host sync buys real compute savings — pass 2's shape is M« L lists,
+    # bucketed to powers of two to bound recompilation.
+    kth = np.asarray(best_d[:, -1])
+    lb = np.asarray(lm_d) - np.asarray(index.radii)[None, :]
+    needed = lb < kth[:, None]
+    scanned = np.zeros((queries.shape[0], L), bool)
+    np.put_along_axis(scanned, np.asarray(probes), True, axis=1)
+    needed &= ~scanned
+    needed_lists = np.nonzero(needed.any(axis=0))[0]
+    if len(needed_lists) == 0:
+        return _finalize(best_d, best_i, int(k), index.metric)
+    m_bucket = 1 << int(np.ceil(np.log2(len(needed_lists))))
+    m_bucket = min(m_bucket, L)
+    sub = np.full((m_bucket,), int(needed_lists[0]), np.int64)
+    sub[: len(needed_lists)] = needed_lists
+    needed_sub = needed[:, sub]
+    needed_sub[:, len(needed_lists):] = False  # padding lists contribute 0
+    sub_sizes = np.asarray(index.list_sizes)[sub]
+    sub_valid = np.arange(pad)[None, :] < sub_sizes[:, None]
+    out_d, out_i = _pass2_jit(
+        queries, index.list_data[jnp.asarray(sub)],
+        index.list_indices[jnp.asarray(sub)], jnp.asarray(sub_valid),
+        jnp.asarray(needed_sub), best_d, best_i, index.metric, int(k))
+    return _finalize(out_d, out_i, int(k), index.metric)

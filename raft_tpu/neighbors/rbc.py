@@ -1,0 +1,6 @@
+"""pylibraft-parity alias: pylibraft.neighbors.rbc (random ball cover)."""
+
+from raft_tpu.neighbors.ball_cover import *  # noqa: F401,F403
+from raft_tpu.neighbors.ball_cover import BallCoverIndex, build, knn  # noqa: F401
+
+__all__ = ["BallCoverIndex", "build", "knn"]

@@ -1,0 +1,28 @@
+"""pylibraft-parity namespace: ``raft_tpu.random``.
+
+Mirrors ``pylibraft.random`` (python/pylibraft/pylibraft/random — rmat) plus
+the full raft::random generator surface from ops.rng."""
+
+from raft_tpu.ops.rng import (  # noqa: F401
+    RngState,
+    bernoulli,
+    exponential,
+    gumbel,
+    laplace,
+    lognormal,
+    make_blobs,
+    make_regression,
+    multi_variable_gaussian,
+    normal,
+    permute,
+    rayleigh,
+    rmat,
+    sample_without_replacement,
+    subsample_rows,
+    uniform,
+)
+
+__all__ = ["RngState", "rmat", "make_blobs", "make_regression",
+           "multi_variable_gaussian", "normal", "uniform", "laplace",
+           "gumbel", "lognormal", "exponential", "rayleigh", "bernoulli",
+           "permute", "sample_without_replacement", "subsample_rows"]

@@ -18,9 +18,12 @@ from raft_tpu.sparse.types import COO, CSR
 
 
 def coo_sort(coo: COO) -> COO:
-    """Sort entries by (row, col) — sparse/op/sort.cuh analog."""
-    key = coo.rows.astype(jnp.int64) * coo.shape[1] + coo.cols
-    order = jnp.argsort(key)
+    """Sort entries by (row, col) — sparse/op/sort.cuh analog. Two stable
+    int32 argsorts (col minor, row major) — no int64 key, so no silent
+    x64-disabled overflow for large shapes."""
+    o1 = jnp.argsort(coo.cols, stable=True)
+    o2 = jnp.argsort(coo.rows[o1], stable=True)
+    order = o1[o2]
     return COO(coo.rows[order], coo.cols[order], coo.data[order], coo.shape)
 
 
@@ -65,7 +68,9 @@ def dense_to_csr(dense, nnz: int = None) -> CSR:
     rows = jnp.where(is_real, safe // m, 0).astype(jnp.int32)
     cols = jnp.where(is_real, safe % m, 0).astype(jnp.int32)
     data = jnp.where(is_real, dense.reshape(-1)[safe], 0)
-    counts = jnp.zeros((n,), jnp.int32).at[rows].add(1)
+    # padding slots don't count toward any row's structure
+    counts = jnp.zeros((n,), jnp.int32).at[rows].add(
+        is_real.astype(jnp.int32))
     indptr = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
     return CSR(indptr, cols, data, (n, m))

@@ -97,12 +97,17 @@ def symmetrize(coo: COO, op: str = "max") -> COO:
     both_r = jnp.concatenate([coo.rows, coo.cols])
     both_c = jnp.concatenate([coo.cols, coo.rows])
     both_d = jnp.concatenate([coo.data, coo.data])
-    key = both_r.astype(jnp.int64) * coo.shape[1] + both_c
-    order = jnp.argsort(key)
-    key_s = key[order]
+    # lexicographic (row, col) order via two stable int32 argsorts — no
+    # int64 key (would silently overflow with x64 disabled)
+    o1 = jnp.argsort(both_c, stable=True)
+    o2 = jnp.argsort(both_r[o1], stable=True)
+    order = o1[o2]
+    r_s = both_r[order]
+    c_s = both_c[order]
     d_s = both_d[order]
     first = jnp.concatenate(
-        [jnp.ones((1,), bool), key_s[1:] != key_s[:-1]])
+        [jnp.ones((1,), bool),
+         (r_s[1:] != r_s[:-1]) | (c_s[1:] != c_s[:-1])])
     seg = jnp.cumsum(first) - 1  # segment id per entry
     nseg = both_d.shape[0]
     if op == "sum":
@@ -119,8 +124,8 @@ def symmetrize(coo: COO, op: str = "max") -> COO:
     # zero-data self-loops at (0, 0) — harmless for duplicate-sum
     # densification AND for MST (self-loops are never selected)
     d_out = jnp.where(first, vals[seg], 0.0).astype(coo.data.dtype)
-    r_out = jnp.where(first, both_r[order], 0)
-    c_out = jnp.where(first, both_c[order], 0)
+    r_out = jnp.where(first, r_s, 0)
+    c_out = jnp.where(first, c_s, 0)
     return COO(r_out, c_out, d_out, coo.shape)
 
 

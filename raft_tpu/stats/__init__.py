@@ -13,6 +13,8 @@ from raft_tpu.stats.basic import (
     accuracy_score,
     r2_score,
     mean_squared_error,
+    dispersion,
+    trustworthiness_score,
 )
 from raft_tpu.stats.cluster_metrics import (
     silhouette_score,
@@ -36,6 +38,8 @@ __all__ = [
     "accuracy_score",
     "r2_score",
     "mean_squared_error",
+    "dispersion",
+    "trustworthiness_score",
     "silhouette_score",
     "adjusted_rand_index",
     "rand_index",

@@ -66,3 +66,47 @@ def mean_squared_error(y_true, y_pred):
     yt = jnp.asarray(y_true, jnp.float32)
     yp = jnp.asarray(y_pred, jnp.float32)
     return jnp.mean((yt - yp) ** 2)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None):
+    """Between-cluster dispersion: Σ_c size_c·||centroid_c − μ||²
+    (reference: stats/dispersion.cuh — the k-means auto-find-k criterion)."""
+    c = jnp.asarray(centroids, jnp.float32)
+    s = jnp.asarray(cluster_sizes, jnp.float32)
+    if global_centroid is None:
+        global_centroid = jnp.sum(c * s[:, None], 0) / jnp.maximum(
+            jnp.sum(s), 1e-38)
+    d2 = jnp.sum((c - global_centroid[None, :]) ** 2, -1)
+    return jnp.sum(d2 * s)
+
+
+def trustworthiness_score(x, x_embedded, n_neighbors: int = 5,
+                          metric="sqeuclidean", res=None):
+    """Trustworthiness of a low-dim embedding (reference:
+    stats/trustworthiness_score.cuh): 1 − penalty for points that enter a
+    point's embedded k-neighborhood while being far in the original space."""
+    from raft_tpu.neighbors import brute_force
+
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    k = int(n_neighbors)
+    if k >= n / 2:
+        raise ValueError(
+            f"n_neighbors={k} must be < n_samples/2 = {n / 2} (the "
+            "normalizer changes sign beyond that; sklearn's contract)")
+    # ranks in the original space (full argsort — trustworthiness is an
+    # offline quality metric; n here is an evaluation subsample)
+    from raft_tpu.ops.distance import pairwise_distance as pd
+
+    d_orig = pd(x, x, metric=metric, res=res)
+    rank_order = jnp.argsort(d_orig, axis=1)  # [n, n] ids by closeness
+    ranks = jnp.zeros((n, n), jnp.int32).at[
+        jnp.arange(n)[:, None], rank_order].set(
+        jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (n, n)))
+    _, emb_nn = brute_force.knn(x_embedded, x_embedded, k=k + 1,
+                                metric=metric, res=res)
+    emb_nn = jnp.asarray(emb_nn)[:, 1:]  # drop self
+    r = jnp.take_along_axis(ranks, emb_nn, axis=1)  # original-space ranks
+    penalty = jnp.sum(jnp.maximum(r - k, 0).astype(jnp.float32))
+    norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
+    return 1.0 - norm * penalty

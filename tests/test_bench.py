@@ -1,0 +1,105 @@
+"""Benchmark harness tests — config-driven run over a tiny dataset with
+all four algos (reference: raft-ann-bench run/data_export/plot CLIs)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu import native
+from raft_tpu.bench import export, runner
+
+
+@pytest.fixture(scope="module")
+def dataset_files(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("bench_data")
+    rng = np.random.default_rng(11)
+    base = rng.standard_normal((2000, 24)).astype(np.float32)
+    queries = rng.standard_normal((100, 24)).astype(np.float32)
+    bp = str(tmp / "base.fbin")
+    qp = str(tmp / "query.fbin")
+    native.write_bin(bp, base)
+    native.write_bin(qp, queries)
+    gt = runner.generate_groundtruth(base, queries, 10, "euclidean")
+    gp = str(tmp / "gt.ibin")
+    native.write_bin(gp, gt.astype(np.int32))
+    return {"base": bp, "query": qp, "gt": gp}
+
+
+def _config(files, indexes):
+    return {
+        "dataset": {
+            "name": "tiny-24-euclidean",
+            "base_file": files["base"],
+            "query_file": files["query"],
+            "groundtruth_neighbors_file": files["gt"],
+            "distance": "euclidean",
+        },
+        "index": indexes,
+    }
+
+
+def test_run_all_algos(dataset_files, tmp_path):
+    config = _config(dataset_files, [
+        {"name": "bf", "algo": "raft_brute_force", "build_param": {},
+         "search_params": [{}]},
+        {"name": "ivf_flat.n16", "algo": "raft_ivf_flat",
+         "build_param": {"nlist": 16},
+         "search_params": [{"nprobe": 4}, {"nprobe": 16}]},
+        {"name": "ivf_pq.n16", "algo": "raft_ivf_pq",
+         "build_param": {"nlist": 16, "pq_dim": 8},
+         "search_params": [{"nprobe": 16, "smemLutDtype": "fp16"}]},
+        {"name": "cagra.d16", "algo": "raft_cagra",
+         "build_param": {"graph_degree": 16,
+                         "intermediate_graph_degree": 24},
+         "search_params": [{"itopk": 32}]},
+    ])
+    out = str(tmp_path / "results.jsonl")
+    rows = runner.run_benchmark(config, k=10, search_iters=1, out_path=out)
+    assert len(rows) == 5
+    by_name = {}
+    for r in rows:
+        by_name.setdefault(r["name"], []).append(r)
+    assert by_name["bf"][0]["recall"] >= 0.999
+    assert by_name["ivf_flat.n16"][1]["recall"] >= 0.999  # full probe
+    assert by_name["ivf_flat.n16"][0]["recall"] <= by_name[
+        "ivf_flat.n16"][1]["recall"] + 1e-6
+    assert by_name["ivf_pq.n16"][0]["recall"] >= 0.5
+    assert by_name["cagra.d16"][0]["recall"] >= 0.8
+    for r in rows:
+        assert r["qps"] > 0 and r["build_time"] >= 0
+
+    # jsonl round-trips
+    loaded = export.load_results(out)
+    assert len(loaded) == 5
+
+    # csv + pareto + plot
+    csv_path = str(tmp_path / "out.csv")
+    export.export_csv(loaded, csv_path, pareto=True)
+    assert os.path.getsize(csv_path) > 0
+    png = str(tmp_path / "plot.png")
+    export.plot(loaded, png)
+    assert os.path.getsize(png) > 0
+
+
+def test_refine_ratio_path(dataset_files):
+    config = _config(dataset_files, [
+        {"name": "pq_refined", "algo": "raft_ivf_pq",
+         "build_param": {"nlist": 16, "pq_dim": 4},
+         "search_params": [{"nprobe": 16},
+                           {"nprobe": 16, "refine_ratio": 4}]},
+    ])
+    rows = runner.run_benchmark(config, k=10, search_iters=1)
+    plain, refined = rows[0], rows[1]
+    # exact re-ranking must not hurt recall at heavy compression
+    assert refined["recall"] >= plain["recall"]
+    assert refined["recall"] >= 0.85
+
+
+def test_pareto_frontier():
+    rows = [{"recall": 0.9, "qps": 100}, {"recall": 0.95, "qps": 50},
+            {"recall": 0.8, "qps": 120}, {"recall": 0.94, "qps": 40}]
+    front = export.pareto_frontier(rows)
+    assert {(r["recall"], r["qps"]) for r in front} == {
+        (0.95, 50), (0.9, 100), (0.8, 120)}

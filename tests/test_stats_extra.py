@@ -1,0 +1,33 @@
+"""dispersion + trustworthiness tests (reference: cpp/test/stats/
+dispersion.cu, trustworthiness.cu)."""
+
+import numpy as np
+
+from raft_tpu.stats import dispersion, trustworthiness_score
+
+
+def test_dispersion_zero_when_identical():
+    c = np.ones((4, 3), np.float32)
+    s = np.array([5, 5, 5, 5], np.float32)
+    assert float(dispersion(c, s)) < 1e-6
+
+
+def test_dispersion_scales_with_spread():
+    s = np.array([10.0, 10.0], np.float32)
+    near = np.array([[0.0, 0], [1, 0]], np.float32)
+    far = np.array([[0.0, 0], [10, 0]], np.float32)
+    assert float(dispersion(far, s)) > float(dispersion(near, s))
+
+
+def test_trustworthiness_perfect_embedding(rng):
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    t = float(trustworthiness_score(x, x, n_neighbors=5))
+    assert t >= 0.999
+
+
+def test_trustworthiness_degrades_with_shuffle(rng):
+    x = rng.standard_normal((100, 8)).astype(np.float32)
+    bad = x[rng.permutation(100)]
+    t_good = float(trustworthiness_score(x, x, n_neighbors=5))
+    t_bad = float(trustworthiness_score(x, bad, n_neighbors=5))
+    assert t_bad < t_good
