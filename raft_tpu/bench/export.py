@@ -23,11 +23,12 @@ def load_results(path: str) -> List[Dict]:
 
 def pareto_frontier(rows: List[Dict], x_key: str = "recall",
                     y_key: str = "qps") -> List[Dict]:
-    """Points not dominated by any other (higher recall AND higher qps)."""
-    s = sorted(rows, key=lambda r: (-r[x_key], -r[y_key]))
+    """Points not dominated by any other (higher recall AND higher qps).
+    Ties on x are broken by y so a dominated equal-recall point never
+    survives."""
     out = []
     best_y = -float("inf")
-    for r in sorted(rows, key=lambda r: -r[x_key]):
+    for r in sorted(rows, key=lambda r: (-r[x_key], -r[y_key])):
         if r[y_key] > best_y:
             out.append(r)
             best_y = r[y_key]

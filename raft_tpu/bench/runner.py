@@ -112,9 +112,12 @@ class IvfFlat(AnnAlgo):
 class IvfPq(AnnAlgo):
     name = "raft_ivf_pq"
 
+    _dataset = None  # retained by build() for refine_ratio re-ranking
+
     def build(self, dataset, build_param, metric, res):
         from raft_tpu.neighbors import ivf_pq
 
+        self._dataset = dataset
         params = ivf_pq.IndexParams(
             n_lists=int(build_param.get("nlist", 1024)),
             pq_dim=int(build_param.get("pq_dim", 0)),
@@ -141,11 +144,18 @@ class IvfPq(AnnAlgo):
         )
         rr = float(search_param.get("refine_ratio", 1.0))
         if rr > 1.0:
+            if self._dataset is None:
+                raise ValueError(
+                    "refine_ratio needs the raw dataset; a loaded index "
+                    "doesn't carry it — set algo.set_dataset(data) first")
             d, i = ivf_pq.search(index, queries,
                                  int(np.ceil(k * rr)), sp, res=res)
             return refine.refine(self._dataset, queries, i, k,
                                  metric=index.metric, res=res)
         return ivf_pq.search(index, queries, k, sp, res=res)
+
+    def set_dataset(self, dataset):
+        self._dataset = dataset
 
     def save(self, index, path):
         from raft_tpu.neighbors import ivf_pq
@@ -280,10 +290,8 @@ def run_benchmark(
         t0 = time.perf_counter()
         index = algo.build(base, index_conf.get("build_param", {}), metric,
                            res)
-        jax.effects_barrier()
+        _block_on_index(index)
         build_time = time.perf_counter() - t0
-        if isinstance(algo, IvfPq):
-            algo._dataset = base  # for refine_ratio re-ranking
         for sp in index_conf.get("search_params", [{}]):
             row = _run_search(algo, index, queries, k, sp, gt, batch_size,
                               search_iters, res)
@@ -297,6 +305,16 @@ def run_benchmark(
                 with open(out_path, "a") as f:
                     f.write(json.dumps(row) + "\n")
     return results
+
+
+def _block_on_index(index) -> None:
+    """Fence the async build: block on every jax.Array the index holds
+    (effects_barrier only fences side effects, not pure dispatch)."""
+    attrs = getattr(index, "__dict__", {})
+    leaves = jax.tree_util.tree_leaves(list(attrs.values()))
+    for a in leaves:
+        if isinstance(a, jax.Array):
+            a.block_until_ready()
 
 
 def _run_search(algo, index, queries, k, search_param, gt, batch_size,
@@ -320,6 +338,7 @@ def _run_search(algo, index, queries, k, search_param, gt, batch_size,
     for _ in range(iters):
         run_all()
     dt = (time.perf_counter() - t0) / iters
+    n_batches = max(-(-nq // bs), 1)
     return {"k": k, "batch_size": bs, "qps": round(nq / dt, 1),
-            "latency_ms": round(1000.0 * dt / max(nq // bs, 1), 3),
+            "latency_ms": round(1000.0 * dt / n_batches, 3),
             "recall": round(recall, 4)}

@@ -7,13 +7,15 @@ shape/dtype conventions are documented per-API instead of encoded in types.
 
 from raft_tpu.core.resources import Resources, default_resources, ensure_resources
 from raft_tpu.core.bitset import Bitset
-from raft_tpu.core import logger, serialize
+from raft_tpu.core import interruptible, logger, serialize, tracing
 
 __all__ = [
     "Resources",
     "default_resources",
     "ensure_resources",
     "Bitset",
+    "interruptible",
     "logger",
     "serialize",
+    "tracing",
 ]

@@ -1,0 +1,82 @@
+"""Cooperative cross-thread cancellation of device waits.
+
+Reference: ``raft::interruptible`` (core/interruptible.hpp:71-100) — a
+per-thread token lets any other thread cancel a spinning stream-sync;
+``interruptible::synchronize`` polls the flag while waiting and throws
+``interrupted_exception`` when cancelled. Also hooked into comms
+sync_stream.
+
+TPU-native design: JAX dispatch is async; the wait point is
+``block_until_ready``. ``synchronize`` polls array readiness in small
+sleeps, checking the calling thread's token — same cooperative contract,
+no busy device spin."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable
+
+import jax
+
+
+class InterruptedException(RuntimeError):
+    """Raised by synchronize() in a cancelled thread (reference:
+    raft::interruptible::interrupted_exception)."""
+
+
+_tokens: Dict[int, threading.Event] = {}
+_lock = threading.Lock()
+
+
+def get_token(thread_id: int = None) -> threading.Event:
+    """The cancellation token of a thread (reference: get_token())."""
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    with _lock:
+        if tid not in _tokens:
+            _tokens[tid] = threading.Event()
+        return _tokens[tid]
+
+
+def cancel(thread_id: int) -> None:
+    """Cancel another thread's waits (reference: interruptible::cancel)."""
+    get_token(thread_id).set()
+
+
+def yield_now() -> None:
+    """Throw if this thread is cancelled (reference: yield_no_throw's
+    throwing sibling). The consumed token is removed so a reused thread
+    ident never inherits a stale cancellation (the reference clears its
+    per-thread store on thread exit)."""
+    tid = threading.get_ident()
+    with _lock:
+        tok = _tokens.get(tid)
+        if tok is not None and tok.is_set():
+            del _tokens[tid]
+            raise InterruptedException(
+                "interruptible::synchronize cancelled")
+
+
+def release_token(thread_id: int = None) -> None:
+    """Drop a thread's token (call at thread exit in long-lived pools to
+    bound the registry)."""
+    tid = thread_id if thread_id is not None else threading.get_ident()
+    with _lock:
+        _tokens.pop(tid, None)
+
+
+def synchronize(arrays, poll_s: float = 0.01) -> None:
+    """Block until arrays are ready, polling the cancellation token
+    (reference: interruptible::synchronize, core/interruptible.hpp:83-100).
+    """
+    leaves = [a for a in jax.tree_util.tree_leaves(arrays)
+              if isinstance(a, jax.Array)]
+    for a in leaves:
+        while True:
+            yield_now()
+            if a.is_ready():
+                break
+            time.sleep(poll_s)
+    # final fence for anything is_ready() raced with
+    for a in leaves:
+        a.block_until_ready()

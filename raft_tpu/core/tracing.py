@@ -1,0 +1,68 @@
+"""Tracing & profiling annotations.
+
+Reference: NVTX RAII ranges at every nontrivial entry point
+(core/nvtx.hpp:25-91 — ``common::nvtx::range``; enabled by the RAFT_NVTX
+CMake flag, cpp/CMakeLists.txt:262-263) consumed by Nsight.
+
+TPU-native design: ``jax.named_scope`` tags the HLO so ranges appear in
+XLA/xprof traces; ``jax.profiler`` start/stop covers the Nsight role.
+``range`` works as both a context manager and a decorator, like the
+reference's RAII type + RAFT_NVTX_FUNC_RANGE macro."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Optional
+
+import jax
+
+
+class range:  # noqa: A001 — mirrors nvtx::range naming
+    """Named trace scope (context manager or decorator).
+
+    Analog of ``common::nvtx::range`` (core/nvtx.hpp:25-91): inside jit the
+    scope names the emitted HLO ops (visible in xprof); outside jit it
+    annotates the host timeline via TraceAnnotation."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._scope = None
+
+    def __enter__(self):
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        scope, self._scope = self._scope, None
+        return scope.__exit__(*exc)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(self.name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+@contextlib.contextmanager
+def profile(log_dir: str = "/tmp/raft_tpu_trace",
+            host_tracer_level: int = 2):
+    """Capture an xprof/Perfetto trace around a region (the Nsight-capture
+    analog): ``with tracing.profile('/tmp/trace'): search(...)``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator form: @annotate("ivf_pq::search")."""
+
+    def deco(fn):
+        return range(name or fn.__qualname__)(fn)
+
+    return deco

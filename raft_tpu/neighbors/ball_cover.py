@@ -140,12 +140,15 @@ def _pass2_jit(queries, sub_data, sub_indices, sub_valid, needed_sub,
     nq = queries.shape[0]
     M, pad, dim = sub_data.shape
     q = queries.astype(jnp.float32)
-    flat_valid = sub_valid.reshape(1, M * pad)
-    d_all = _scan_gathered(
-        q, jnp.broadcast_to(sub_data.reshape(1, M * pad, dim),
-                            (nq, M * pad, dim)),
-        jnp.broadcast_to(flat_valid, (nq, M * pad)), metric)
-    mask = jnp.repeat(needed_sub, pad, axis=1)
+    # query-invariant candidates → ONE [nq, dim]×[dim, M·pad] MXU GEMM (no
+    # per-query data copy; the batched einsum path is for per-query gathers)
+    flat_pts = sub_data.reshape(M * pad, dim)
+    if metric == DistanceType.Haversine:
+        d_all = haversine(q, flat_pts)
+    else:
+        d_all = _rooted_dist(q, flat_pts, metric)
+    mask = (jnp.repeat(needed_sub, pad, axis=1)
+            & sub_valid.reshape(1, M * pad))
     d_all = jnp.where(mask, d_all, jnp.inf)
     i_all = jnp.broadcast_to(sub_indices.reshape(1, M * pad), (nq, M * pad))
     cat_d = jnp.concatenate([best_d, d_all], axis=1)

@@ -1,0 +1,92 @@
+"""Aux core subsystems: tracing ranges, interruptible sync, pallas kernel
+(interpret mode) — reference: core/nvtx.hpp, core/interruptible.hpp,
+distance/fused_l2_nn-inl.cuh."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import interruptible, tracing
+
+
+def test_tracing_range_context_and_decorator():
+    with tracing.range("test::scope"):
+        x = jnp.ones((4,)) * 2
+
+    @tracing.annotate("test::fn")
+    def fn(a):
+        return a + 1
+
+    np.testing.assert_array_equal(np.asarray(fn(x)), 3.0)
+
+
+def test_tracing_inside_jit():
+    @jax.jit
+    def f(a):
+        with tracing.range("inner"):
+            return a * 2
+
+    assert float(f(jnp.float32(3.0))) == 6.0
+
+
+def test_interruptible_synchronize_ready():
+    x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+    interruptible.synchronize(x)  # completes without raising
+
+
+def test_interruptible_cancel():
+    main_id = threading.get_ident()
+    interruptible.cancel(main_id)
+    with pytest.raises(interruptible.InterruptedException):
+        interruptible.yield_now()
+    # token cleared after raise: next sync passes
+    interruptible.synchronize(jnp.ones((2,)))
+
+
+def test_interruptible_cancel_from_other_thread():
+    target_ready = threading.Event()
+    result = {}
+
+    def worker():
+        result["tid"] = threading.get_ident()
+        target_ready.set()
+        try:
+            while True:
+                interruptible.yield_now()
+                time.sleep(0.005)
+        except interruptible.InterruptedException:
+            result["cancelled"] = True
+
+    t = threading.Thread(target=worker)
+    t.start()
+    target_ready.wait()
+    interruptible.cancel(result["tid"])
+    t.join(timeout=5)
+    assert result.get("cancelled")
+
+
+def test_pallas_fused_l2_argmin_interpret(rng):
+    from raft_tpu.ops import pallas_kernels as pk
+
+    x = rng.standard_normal((100, 32)).astype(np.float32)
+    y = rng.standard_normal((300, 32)).astype(np.float32)
+    v, i = pk.fused_l2_argmin(x, y, interpret=True)
+    d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i), d.argmin(1))
+    np.testing.assert_allclose(np.asarray(v), d.min(1), rtol=1e-3, atol=1e-3)
+
+
+def test_pallas_fused_l2_argmin_unaligned(rng):
+    from raft_tpu.ops import pallas_kernels as pk
+
+    # shapes that aren't multiples of the tile sizes
+    x = rng.standard_normal((37, 24)).astype(np.float32)
+    y = rng.standard_normal((131, 24)).astype(np.float32)
+    v, i = pk.fused_l2_argmin(x, y, tm=16, tn=128, interpret=True)
+    d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i), d.argmin(1))
