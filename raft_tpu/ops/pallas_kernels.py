@@ -38,7 +38,8 @@ def _fused_l2_argmin_kernel(x_ref, y_ref, xn_ref, yn_ref, val_ref, idx_ref):
     dots = jax.lax.dot_general(
         x_ref[:], y_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # [TM, TN]
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [TM, TN] — fp32 MXU passes; default would truncate to bf16
     d = xn_ref[:] + yn_ref[:] - 2.0 * dots  # [TM, TN] (norm bcast)
     local_val = jnp.min(d, axis=1, keepdims=True)  # [TM, 1]
     local_arg = (jnp.argmin(d, axis=1).reshape(-1, 1)
