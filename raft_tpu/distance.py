@@ -10,9 +10,14 @@ from raft_tpu.ops.distance import (  # noqa: F401
     pairwise_distance,
     resolve_metric,
 )
-from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin  # noqa: F401
+from raft_tpu.ops.fused_l2_nn import (  # noqa: F401
+    fused_l2_nn_argmin,
+    masked_l2_nn_argmin,
+)
+from raft_tpu.ops import kernels  # noqa: F401  (raft::distance::kernels)
 
 DISTANCE_TYPES = [t.name for t in DistanceType]
 
 __all__ = ["DistanceType", "DISTANCE_TYPES", "pairwise_distance",
-           "fused_l2_nn_argmin", "is_min_close", "resolve_metric"]
+           "fused_l2_nn_argmin", "masked_l2_nn_argmin", "is_min_close",
+           "resolve_metric", "kernels"]

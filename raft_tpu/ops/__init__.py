@@ -10,8 +10,8 @@ from raft_tpu.ops.distance import (
     row_norms_sq,
 )
 from raft_tpu.ops.select_k import SelectAlgo, select_k, merge_topk_dedup
-from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
-from raft_tpu.ops import linalg, matrix, rng
+from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin, masked_l2_nn_argmin
+from raft_tpu.ops import kernels, linalg, matrix, rng
 
 __all__ = [
     "DistanceType",
@@ -23,6 +23,8 @@ __all__ = [
     "select_k",
     "merge_topk_dedup",
     "fused_l2_nn_argmin",
+    "masked_l2_nn_argmin",
+    "kernels",
     "linalg",
     "matrix",
     "rng",

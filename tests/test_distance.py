@@ -137,3 +137,96 @@ def test_unsupported_dense_metric(rng):
     x = np.zeros((4, 4), np.float32)
     with pytest.raises(NotImplementedError):
         pairwise_distance(x, x, metric="jaccard")
+
+
+# ---------------------------------------------------------------------------
+# gram kernels (reference: distance/detail/kernels/kernel_matrices.cuh)
+
+def test_gram_kernels_dense(rng):
+    from raft_tpu.ops import kernels as K
+
+    x = rng.standard_normal((20, 8)).astype(np.float32)
+    y = rng.standard_normal((15, 8)).astype(np.float32)
+    ip = x @ y.T
+
+    np.testing.assert_allclose(np.asarray(K.linear_kernel(x, y)), ip,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(K.polynomial_kernel(x, y, degree=3, gamma=0.5, coef0=1.0)),
+        (0.5 * ip + 1.0) ** 3, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(K.tanh_kernel(x, y, gamma=0.5, coef0=0.1)),
+        np.tanh(0.5 * ip + 0.1), rtol=1e-4, atol=1e-4)
+    sq = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(np.asarray(K.rbf_kernel(x, y, gamma=0.25)),
+                               np.exp(-0.25 * sq), rtol=1e-4, atol=1e-4)
+
+
+def test_gram_kernels_dispatch_and_sparse(rng):
+    from raft_tpu.ops import kernels as K
+    from raft_tpu.sparse.convert import dense_to_csr
+
+    xd = rng.standard_normal((12, 10)).astype(np.float32)
+    yd = rng.standard_normal((9, 10)).astype(np.float32)
+    xd[rng.random(xd.shape) < 0.5] = 0.0
+    yd[rng.random(yd.shape) < 0.5] = 0.0
+    xs, ys = dense_to_csr(xd), dense_to_csr(yd)
+    ip = xd @ yd.T
+
+    # dispatch via KernelParams
+    p = K.KernelParams(K.KernelType.POLYNOMIAL, degree=2, gamma=1.0, coef0=0.5)
+    np.testing.assert_allclose(np.asarray(K.gram_matrix(xd, yd, p)),
+                               (ip + 0.5) ** 2, rtol=1e-4, atol=1e-4)
+    # CSR×dense, dense×CSR, CSR×CSR all agree with the dense result
+    for a, b in ((xs, yd), (xd, ys), (xs, ys)):
+        np.testing.assert_allclose(np.asarray(K.linear_kernel(a, b)), ip,
+                                   rtol=1e-4, atol=1e-4)
+    sq = ((xd[:, None, :] - yd[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(
+        np.asarray(K.gram_matrix(xs, ys, K.KernelParams(K.KernelType.RBF,
+                                                        gamma=0.1))),
+        np.exp(-0.1 * sq), rtol=1e-4, atol=1e-4)
+
+
+def test_masked_l2_nn_argmin(rng):
+    from raft_tpu.ops.fused_l2_nn import masked_l2_nn_argmin
+
+    m, n, k, g = 50, 40, 8, 4
+    x = rng.standard_normal((m, k)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    # groups of y rows given by end offsets (reference prefix-sum convention)
+    group_idxs = np.array([10, 22, 31, 40], np.int32)
+    adj = rng.random((m, g)) < 0.6
+    adj[0] = False  # a row with no allowed group -> inf
+
+    val, idx = masked_l2_nn_argmin(x, y, adj, group_idxs)
+    d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    starts = np.r_[0, group_idxs[:-1]]
+    group_of_y = np.zeros(n, np.int32)
+    for gi, (s, e) in enumerate(zip(starts, group_idxs)):
+        group_of_y[s:e] = gi
+    allowed = adj[:, group_of_y]
+    dm = np.where(allowed, d, np.inf)
+    ref_val, ref_idx = dm.min(1), dm.argmin(1)
+    np.testing.assert_array_equal(np.asarray(idx), ref_idx)
+    has = np.isfinite(ref_val)
+    np.testing.assert_allclose(np.asarray(val)[has], ref_val[has],
+                               rtol=1e-4, atol=1e-4)
+    assert np.isinf(np.asarray(val)[0])
+
+
+def test_masked_l2_nn_tiled(rng):
+    from raft_tpu.ops.fused_l2_nn import masked_l2_nn_argmin
+    from raft_tpu import Resources
+
+    m, n, k, g = 300, 64, 16, 2
+    x = rng.standard_normal((m, k)).astype(np.float32)
+    y = rng.standard_normal((n, k)).astype(np.float32)
+    group_idxs = np.array([30, 64], np.int32)
+    adj = rng.random((m, g)) < 0.7
+    small = Resources(workspace_limit_bytes=64 * 1024)
+    val, idx = masked_l2_nn_argmin(x, y, adj, group_idxs, res=small)
+    d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    group_of_y = (np.arange(n)[:, None] >= group_idxs[None, :]).sum(1)
+    dm = np.where(adj[:, group_of_y], d, np.inf)
+    np.testing.assert_array_equal(np.asarray(idx), dm.argmin(1))
