@@ -65,13 +65,15 @@ def _assign(x, x_norms, centers, tile: int):
     return labels, d2
 
 
-def _update(x, labels, old_centers):
+def _update(x, labels, old_centers, weights=None):
     n_clusters = old_centers.shape[0]
-    counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(1.0)
-    sums = jnp.zeros_like(old_centers).at[labels].add(x)
+    w = jnp.ones((x.shape[0],), jnp.float32) if weights is None else weights
+    counts = jnp.zeros((n_clusters,), jnp.float32).at[labels].add(w)
+    sums = jnp.zeros_like(old_centers).at[labels].add(x * w[:, None])
     # empty clusters keep their previous center (reference behavior)
     centers = jnp.where(
-        (counts > 0)[:, None], sums / jnp.maximum(counts, 1.0)[:, None], old_centers
+        (counts > 0)[:, None], sums / jnp.maximum(counts, 1e-20)[:, None],
+        old_centers
     )
     return centers, counts
 
@@ -103,8 +105,11 @@ def _kmeans_pp_init(key, x, x_norms, n_clusters: int):
     return centers
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "tile"))
-def _lloyd_jit(x, x_norms, centers0, tol: float, max_iter: int, tile: int):
+@functools.partial(jax.jit, static_argnames=("max_iter", "tile", "weighted"))
+def _lloyd_jit(x, x_norms, centers0, weights, tol: float, max_iter: int,
+               tile: int, weighted: bool):
+    w = weights if weighted else None
+
     def cond(state):
         i, shift2, *_ = state
         return (i < max_iter) & (shift2 >= tol)
@@ -112,7 +117,7 @@ def _lloyd_jit(x, x_norms, centers0, tol: float, max_iter: int, tile: int):
     def body(state):
         i, _, centers = state
         labels, _ = _assign(x, x_norms, centers, tile)
-        new_centers, _ = _update(x, labels, centers)
+        new_centers, _ = _update(x, labels, centers, w)
         shift2 = jnp.sum((new_centers - centers) ** 2)
         return i + 1, shift2, new_centers
 
@@ -120,7 +125,7 @@ def _lloyd_jit(x, x_norms, centers0, tol: float, max_iter: int, tile: int):
         cond, body, (jnp.int32(0), jnp.float32(jnp.inf), centers0)
     )
     labels, d2 = _assign(x, x_norms, centers, tile)
-    inertia = jnp.sum(d2)
+    inertia = jnp.sum(d2 * weights) if weighted else jnp.sum(d2)
     return centers, labels, inertia, n_iter
 
 
@@ -140,8 +145,6 @@ def fit(
     res = ensure_resources(res)
     if params.metric not in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
         raise NotImplementedError("kmeans supports L2 metrics (like the reference)")
-    if sample_weights is not None:
-        raise NotImplementedError("sample_weights not yet supported")
     if params.init == InitMethod.Array and init_centers is None:
         raise ValueError("init='array' requires init_centers")
     if init_centers is not None and params.init != InitMethod.Array:
@@ -154,6 +157,9 @@ def fit(
             f"n_clusters={params.n_clusters} > n_rows={x.shape[0]}"
         )
     xn = row_norms_sq(x)
+    weighted = sample_weights is not None
+    weights = (jnp.asarray(sample_weights, jnp.float32) if weighted
+               else jnp.ones((x.shape[0],), jnp.float32))
     key = jax.random.key(params.seed)
     tile = choose_tile_rows(x.shape[0], params.n_clusters, res.workspace_limit_bytes)
 
@@ -170,7 +176,7 @@ def fit(
         else:
             c0 = _kmeans_pp_init(kt, x, xn, params.n_clusters)
         centers, labels, inertia, n_iter = _lloyd_jit(
-            x, xn, c0, params.tol, params.max_iter, tile
+            x, xn, c0, weights, params.tol, params.max_iter, tile, weighted
         )
         if best is None or float(inertia) < float(best[2]):
             best = (centers, labels, inertia, n_iter)
@@ -201,6 +207,28 @@ def cluster_cost(x, centers, res: Optional[Resources] = None) -> jax.Array:
     return jnp.sum(d2)
 
 
+def update_centroids(
+    x,
+    centroids,
+    sample_weights=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One weighted M-step: assign rows to their nearest centroid, then
+    return (new_centroids, weight_per_cluster) — parity with
+    ``pylibraft.cluster.kmeans.compute_new_centroids`` /
+    ``raft::runtime::cluster::kmeans::update_centroids``. Empty clusters
+    keep their previous centroid."""
+    res = ensure_resources(res)
+    x = jnp.asarray(x, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    w = (None if sample_weights is None
+         else jnp.asarray(sample_weights, jnp.float32))
+    tile = choose_tile_rows(x.shape[0], centroids.shape[0],
+                            res.workspace_limit_bytes)
+    labels, _ = _assign(x, row_norms_sq(x), centroids, tile)
+    return _update(x, labels, centroids, w)
+
+
 def find_k(
     x,
     k_max: int,
@@ -224,3 +252,4 @@ def find_k(
 
     second = np.diff(costs, 2)
     return ks[int(second.argmax()) + 1]
+compute_new_centroids = update_centroids  # pylibraft name

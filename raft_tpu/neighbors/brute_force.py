@@ -88,10 +88,11 @@ def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
 
 @functools.partial(
     jax.jit,
-    static_argnames=("metric", "metric_arg", "k", "q_tile", "db_tile", "budget"),
+    static_argnames=("metric", "metric_arg", "k", "q_tile", "db_tile",
+                     "budget", "has_filter"),
 )
-def _knn_jit(queries, dataset, db_norms, metric, metric_arg, k, q_tile, db_tile,
-             budget):
+def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
+             q_tile, db_tile, budget, has_filter: bool = False):
     nq, dim = queries.shape
     ndb = dataset.shape[0]
     minimize = is_min_close(metric)
@@ -132,6 +133,15 @@ def _knn_jit(queries, dataset, db_norms, metric, metric_arg, k, q_tile, db_tile,
             else:
                 d = _pairwise_impl(qt, db_t, metric, metric_arg, budget)
             bad = jax.lax.dynamic_slice_in_dim(pad_bad, t * db_tile, db_tile, 0)
+            if has_filter:
+                # bitset prefilter in the tile epilogue (reference:
+                # bitset_filter, sample_filter_types.hpp:55-82)
+                ids = t * db_tile + jnp.arange(db_tile)
+                words = filter_words[jnp.minimum(ids // 32,
+                                                 filter_words.shape[0] - 1)]
+                bits = ((words >> (ids % 32).astype(jnp.uint32)) & 1
+                        ).astype(bool)
+                bad = bad | ~bits
             d = jnp.where(bad[None, :], bad_fill, d)
             v, i = select_k(d, min(k, db_tile), select_min=minimize)
             return v, i + t * db_tile
@@ -154,9 +164,13 @@ def _knn_jit(queries, dataset, db_norms, metric, metric_arg, k, q_tile, db_tile,
     return vals[:nq], idxs[:nq]
 
 
-def search(index: Index, queries, k: int, res: Optional[Resources] = None
-           ) -> Tuple[jax.Array, jax.Array]:
-    """Exact kNN search → (distances [nq, k], indices [nq, k])."""
+def search(index: Index, queries, k: int, filter=None,
+           res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN search → (distances [nq, k], indices [nq, k]).
+
+    ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
+    database row ids; cleared bits are excluded (reference: the
+    bitset_filter overloads of brute_force::search)."""
     res = ensure_resources(res)
     queries = jnp.asarray(queries, index.dataset.dtype)
     if queries.shape[1] != index.dim:
@@ -166,15 +180,18 @@ def search(index: Index, queries, k: int, res: Optional[Resources] = None
         queries.shape[0], index.size, index.dim, k, res.workspace_limit_bytes
     )
     return _knn_jit(
-        queries, index.dataset, index.norms, index.metric, index.metric_arg,
-        k, q_tile, db_tile, res.workspace_limit_bytes,
+        queries, index.dataset, index.norms,
+        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
+        index.metric, index.metric_arg,
+        k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
     )
 
 
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
         res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
     """One-shot exact kNN (reference: brute_force::knn)."""
-    return search(build(dataset, metric, metric_arg, res), queries, k, res)
+    return search(build(dataset, metric, metric_arg, res), queries, k,
+                  res=res)
 
 
 _SERIAL_VERSION = 1

@@ -298,10 +298,12 @@ def _build_knn_graph_ivf_pq(dataset, k_inter: int, params: IndexParams,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("metric", "k", "itopk", "width", "max_iter"),
+    static_argnames=("metric", "k", "itopk", "width", "max_iter",
+                     "has_filter"),
 )
-def _search_jit(queries, dataset, graph, seed_ids, metric: DistanceType,
-                k: int, itopk: int, width: int, max_iter: int):
+def _search_jit(queries, dataset, graph, seed_ids, filter_words,
+                metric: DistanceType, k: int, itopk: int, width: int,
+                max_iter: int, has_filter: bool = False):
     nq, dim = queries.shape
     n, degree = graph.shape
     minimize = metric != DistanceType.InnerProduct
@@ -317,6 +319,15 @@ def _search_jit(queries, dataset, graph, seed_ids, metric: DistanceType,
         d = gathered_distances(qf, vecs, inner_metric)
         if metric == DistanceType.InnerProduct:
             d = -d
+        if has_filter:
+            # filtered nodes never enter the candidate buffer — the
+            # reference's filtered search skips them at topk insertion
+            safe = jnp.maximum(ids, 0)
+            words = filter_words[jnp.minimum(
+                safe // 32, filter_words.shape[0] - 1)]
+            bits = ((words >> (safe % 32).astype(jnp.uint32)) & 1
+                    ).astype(bool)
+            d = jnp.where(bits, d, bad)
         return jnp.where(ids < 0, bad, d)
 
     # ---- init: random seed nodes (random_samplings, search_plan.cuh)
@@ -387,10 +398,15 @@ def search(
     queries,
     k: int,
     params: Optional[SearchParams] = None,
+    filter=None,
     res: Optional[Resources] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Greedy graph search (reference: cagra::search, cagra.cuh:299 →
-    search_single_cta_kernel-inl.cuh). Returns (distances, indices)."""
+    search_single_cta_kernel-inl.cuh). Returns (distances, indices).
+
+    ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
+    dataset row ids; cleared bits are excluded from results (and from the
+    candidate buffer, as in the reference's filtered search)."""
     params = params or SearchParams()
     res = ensure_resources(res)
     queries = jnp.asarray(queries)
@@ -415,8 +431,9 @@ def search(
     seed_ids = jax.random.randint(
         key, (queries.shape[0], n_seeds), 0, index.size, jnp.int32)
     return _search_jit(
-        queries, index.dataset, index.graph, seed_ids, index.metric, int(k),
-        itopk, width, max_iter)
+        queries, index.dataset, index.graph, seed_ids,
+        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
+        index.metric, int(k), itopk, width, max_iter, filter is not None)
 
 
 _SERIAL_VERSION = 1

@@ -222,6 +222,138 @@ def build_ivf_flat(
         params.metric, n)
 
 
+# ----------------------------------------------------- sharded ivf_pq
+
+
+class ShardedIvfPq:
+    """An IVF-PQ index partitioned over a mesh axis (BASELINE target #4:
+    DEEP-100M pq_dim=64 sharded over ICI): each device owns a full local
+    IVF-PQ index (coarse centers, rotation, codebooks, decoded scan cache)
+    over its row shard; search is one SPMD program with an ICI top-k merge."""
+
+    def __init__(self, comms: Comms, centers, rotation, list_decoded,
+                 decoded_norms, list_indices, list_sizes,
+                 metric: DistanceType, n_rows: int):
+        self.comms = comms
+        # all leading-axis [S, ...] stacked per-shard arrays
+        self.centers = centers  # [S, L, dim]
+        self.rotation = rotation  # [S, rot, dim]
+        self.list_decoded = list_decoded  # [S, L, pad, rot] bf16
+        self.decoded_norms = decoded_norms  # [S, L, pad] f32
+        self.list_indices = list_indices  # [S, L, pad] global ids
+        self.list_sizes = list_sizes  # [S, L]
+        self.metric = metric
+        self.n_rows = n_rows
+
+
+def build_ivf_pq(
+    comms: Comms,
+    dataset,
+    params=None,
+    res: Optional[Resources] = None,
+) -> ShardedIvfPq:
+    """Build per-shard IVF-PQ indexes over row partitions with global ids
+    (host-orchestrated like raft-dask's per-worker build). The decoded scan
+    cache is materialized per shard so SPMD search runs the MXU scan."""
+    from raft_tpu.neighbors import ivf_pq
+
+    res = ensure_resources(res)
+    params = params or ivf_pq.IndexParams()
+    dataset = np.asarray(dataset)
+    n = len(dataset)
+    size = comms.size
+    bounds = np.linspace(0, n, size + 1).astype(np.int64)
+    min_shard = int(np.diff(bounds).min())
+    if params.n_lists > min_shard:
+        raise ValueError(
+            f"n_lists={params.n_lists} exceeds the smallest shard's "
+            f"{min_shard} rows ({n} rows over {size} devices)")
+    subs = []
+    for r in range(size):
+        lo, hi = bounds[r], bounds[r + 1]
+        idx = ivf_pq.build(dataset[lo:hi], params, res=res)
+        ivf_pq.ensure_scan_cache(idx)
+        gl_idx = np.asarray(idx.list_indices)
+        gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
+        subs.append((np.asarray(idx.centers), np.asarray(idx.rotation),
+                     np.asarray(idx.list_decoded), np.asarray(idx.decoded_norms),
+                     gl_idx, np.asarray(idx.list_sizes)))
+    pad = max(s[2].shape[1] for s in subs)
+    L = params.n_lists
+    rot = subs[0][1].shape[0]
+    c = np.stack([s[0] for s in subs])
+    ro = np.stack([s[1] for s in subs])
+    ld = np.zeros((size, L, pad, rot), subs[0][2].dtype)
+    dn = np.zeros((size, L, pad), np.float32)
+    li = np.full((size, L, pad), -1, np.int32)
+    ls = np.stack([s[5] for s in subs])
+    for r, s in enumerate(subs):
+        p = s[2].shape[1]
+        ld[r, :, :p] = s[2]
+        dn[r, :, :p] = s[3]
+        li[r, :, :p] = s[4]
+    ax = comms.axis
+    return ShardedIvfPq(
+        comms,
+        comms.shard(jnp.asarray(c), P(ax, None, None)),
+        comms.shard(jnp.asarray(ro), P(ax, None, None)),
+        comms.shard(jnp.asarray(ld), P(ax, None, None, None)),
+        comms.shard(jnp.asarray(dn), P(ax, None, None)),
+        comms.shard(jnp.asarray(li), P(ax, None, None)),
+        comms.shard(jnp.asarray(ls), P(ax, None)),
+        params.metric, n)
+
+
+def search_ivf_pq(
+    index: ShardedIvfPq,
+    queries,
+    k: int,
+    params=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD IVF-PQ search: per-device cached ADC scan of its shard's probed
+    lists, then one all_gather + top-k merge over ICI (knn_merge_parts
+    across ranks)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    res = ensure_resources(res)
+    params = params or ivf_pq.SearchParams()
+    comms = index.comms
+    queries = jnp.asarray(queries)
+    minimize = index.metric != DistanceType.InnerProduct
+    n_lists = index.centers.shape[1]
+    n_probes = int(min(params.n_probes, n_lists))
+    list_pad = index.list_decoded.shape[2]
+    rot = index.list_decoded.shape[3]
+    per_q = n_probes * list_pad * (rot * 2 + 12)
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 1024))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    empty_filter = jnp.zeros((0,), jnp.uint32)
+
+    def local(q_rep, c, ro, ld, dn, li, ls):
+        v, i = ivf_pq._search_cache_core(
+            q_rep, c[0], ro[0], ld[0], dn[0], li[0], ls[0], empty_filter,
+            index.metric, int(k), n_probes, q_tile, False)
+        v_all = comms.allgather(v, axis=1)
+        i_all = comms.allgather(i, axis=1)
+        v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)
+        vm, sel = select_k(v_all, int(k), select_min=minimize)
+        return vm, jnp.take_along_axis(i_all, sel, axis=1)
+
+    ax = comms.axis
+    fn = comms.run(
+        local,
+        (P(None, None), P(ax, None, None), P(ax, None, None),
+         P(ax, None, None, None), P(ax, None, None), P(ax, None, None),
+         P(ax, None)),
+        (P(None, None), P(None, None)))
+    q = comms.shard(queries, P(None, None))
+    return jax.jit(fn)(q, index.centers, index.rotation, index.list_decoded,
+                       index.decoded_norms, index.list_indices,
+                       index.list_sizes)
+
+
 def search_ivf_flat(
     index: ShardedIvfFlat,
     queries,

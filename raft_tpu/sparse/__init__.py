@@ -1,9 +1,12 @@
 """Sparse layer (SURVEY.md §2.4): COO/CSR containers, conversions, sparse
-linalg (spmm/sddmm/degree/norm/symmetrize/transpose/laplacian), sparse
-pairwise distances + kNN, Borůvka MST, spectral partitioning."""
+linalg (spmm/sddmm/degree/norm/symmetrize/transpose/laplacian), element/row
+ops (filter/reduce/slice/sort), sparse pairwise distances + kNN,
+cross-component NN, Lanczos solver, Borůvka MST, spectral partitioning."""
 
-from raft_tpu.sparse import convert, distance, linalg, mst, spectral, types
+from raft_tpu.sparse import (convert, distance, linalg, mst, neighbors, op,
+                             solver, spectral, types)
 from raft_tpu.sparse.types import COO, CSR, coo_from_arrays, csr_from_scipy_like
 
-__all__ = ["convert", "distance", "linalg", "mst", "spectral", "types",
+__all__ = ["convert", "distance", "linalg", "mst", "neighbors", "op",
+           "solver", "spectral", "types",
            "COO", "CSR", "coo_from_arrays", "csr_from_scipy_like"]

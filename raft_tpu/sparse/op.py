@@ -1,0 +1,116 @@
+"""Sparse element/row operations.
+
+Reference: ``raft::sparse::op`` (sparse/op/filter.cuh — ``coo_remove_scalar``
+/ ``coo_remove_zeros``; sparse/op/reduce.cuh — ``max_duplicates``;
+sparse/op/row_op.cuh; sparse/op/slice.cuh — ``csr_row_slice``;
+sparse/op/sort.cuh).
+
+TPU-native design: XLA needs static shapes, so "removal" keeps the nnz
+capacity and compacts valid entries to the front, returning the new logical
+nnz alongside; padding entries carry row/col -1 and value 0. This mirrors
+how the reference's stream-compaction output is sized by a prior count —
+here the count travels with the result instead of resizing the buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.convert import coo_to_csr, csr_to_coo
+
+
+def _compact(coo: COO, keep) -> Tuple[COO, jax.Array]:
+    """Stable-compact kept entries to the front; returns (coo, new_nnz)."""
+    order = jnp.argsort(~keep, stable=True)  # kept first, original order
+    rows = jnp.where(keep[order], coo.rows[order], -1)
+    cols = jnp.where(keep[order], coo.cols[order], -1)
+    data = jnp.where(keep[order], coo.data[order], 0)
+    return COO(rows, cols, data, coo.shape), jnp.sum(keep).astype(jnp.int32)
+
+
+def coo_remove_scalar(coo: COO, scalar) -> Tuple[COO, jax.Array]:
+    """Drop entries equal to ``scalar`` (op/filter.cuh: coo_remove_scalar)."""
+    return _compact(coo, coo.data != scalar)
+
+
+def coo_remove_zeros(coo: COO) -> Tuple[COO, jax.Array]:
+    """Drop explicit zeros (op/filter.cuh: coo_remove_zeros)."""
+    return coo_remove_scalar(coo, 0)
+
+
+def coo_sum_duplicates(coo: COO) -> COO:
+    """Sum duplicate (row, col) entries, keeping one representative each
+    (op/reduce.cuh's duplicate coalescing, summing instead of max)."""
+    n_cols = coo.shape[1]
+    valid = coo.rows >= 0
+    lin = jnp.where(valid, coo.rows * n_cols + coo.cols, jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(lin)
+    lin_s = lin[order]
+    data_s = coo.data[order]
+    first = jnp.concatenate([jnp.array([True]), lin_s[1:] != lin_s[:-1]])
+    seg = jnp.cumsum(first) - 1  # segment id per entry
+    sums = jnp.zeros_like(data_s).at[seg].add(data_s)
+    rows = jnp.where(first & (lin_s != jnp.iinfo(jnp.int32).max),
+                     (lin_s // n_cols).astype(jnp.int32), -1)
+    cols = jnp.where(rows >= 0, (lin_s % n_cols).astype(jnp.int32), -1)
+    data = jnp.where(rows >= 0, sums[seg], 0)
+    # compact representatives to the front
+    rep = rows >= 0
+    order2 = jnp.argsort(~rep, stable=True)
+    return COO(rows[order2], cols[order2], data[order2], coo.shape)
+
+
+def coo_max_duplicates(coo: COO) -> COO:
+    """Max-reduce duplicate (row, col) entries (op/reduce.cuh:
+    max_duplicates)."""
+    n_cols = coo.shape[1]
+    valid = coo.rows >= 0
+    lin = jnp.where(valid, coo.rows * n_cols + coo.cols,
+                    jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(lin)
+    lin_s = lin[order]
+    data_s = coo.data[order]
+    first = jnp.concatenate([jnp.array([True]), lin_s[1:] != lin_s[:-1]])
+    seg = jnp.cumsum(first) - 1
+    neg_inf = jnp.array(-jnp.inf, data_s.dtype) if jnp.issubdtype(
+        data_s.dtype, jnp.floating) else jnp.iinfo(data_s.dtype).min
+    maxs = jnp.full_like(data_s, neg_inf).at[seg].max(data_s)
+    rows = jnp.where(first & (lin_s != jnp.iinfo(jnp.int32).max),
+                     (lin_s // n_cols).astype(jnp.int32), -1)
+    cols = jnp.where(rows >= 0, (lin_s % n_cols).astype(jnp.int32), -1)
+    data = jnp.where(rows >= 0, maxs[seg], 0)
+    rep = rows >= 0
+    order2 = jnp.argsort(~rep, stable=True)
+    return COO(rows[order2], cols[order2], data[order2], coo.shape)
+
+
+def csr_row_op(csr: CSR, fn) -> CSR:
+    """Apply ``fn(row_id, values) -> values`` across rows (op/row_op.cuh).
+    ``fn`` receives the per-nnz row-id vector and the data vector."""
+    rows = csr.row_ids()
+    return CSR(csr.indptr, csr.indices, fn(rows, csr.data), csr.shape)
+
+
+def csr_row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """Rows [start, stop) as a new CSR (op/slice.cuh: csr_row_slice).
+    start/stop are Python ints (static shapes)."""
+    start = int(start)
+    stop = int(stop)
+    lo = int(csr.indptr[start])
+    hi = int(csr.indptr[stop])
+    return CSR(csr.indptr[start:stop + 1] - lo, csr.indices[lo:hi],
+               csr.data[lo:hi], (stop - start, csr.shape[1]))
+
+
+def coo_sort(coo: COO) -> COO:
+    """Row-major sort (op/sort.cuh: coo_sort); padding (-1 rows) sinks to
+    the end."""
+    n_cols = coo.shape[1]
+    lin = jnp.where(coo.rows >= 0, coo.rows * n_cols + coo.cols,
+                    jnp.iinfo(jnp.int32).max)
+    order = jnp.argsort(lin)
+    return COO(coo.rows[order], coo.cols[order], coo.data[order], coo.shape)

@@ -1,0 +1,50 @@
+"""Sparse eigensolvers over CSR operators.
+
+Reference: ``raft::sparse::solver`` (sparse/solver/lanczos.cuh —
+``lanczos_compute_smallest_eigenvectors``, the solver behind spectral
+partitioning/embedding) and the MST solver (sparse/solver/mst.cuh, which
+lives in :mod:`raft_tpu.sparse.mst` here).
+
+TPU-native design: the Krylov iteration itself is dense (ops.linalg.lanczos,
+a lax.fori_loop of matvecs); sparsity enters only through the CSR matvec
+(segment-sum spmv), which XLA executes as scatter-adds. For the small
+spectral problems these solvers serve, that is the right split."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops import linalg as rlinalg
+from raft_tpu.sparse.linalg import spmv
+from raft_tpu.sparse.types import CSR
+
+
+def lanczos_eigsh(
+    a: CSR,
+    k: int,
+    key=None,
+    ncv: Optional[int] = None,
+    which: str = "smallest",
+) -> Tuple[jax.Array, jax.Array]:
+    """k extremal eigenpairs of a symmetric CSR matrix via Lanczos
+    (sparse/solver/lanczos.cuh analog). Returns (eigenvalues [k],
+    eigenvectors [n, k])."""
+    n = a.shape[0]
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(f"matrix must be square, got {a.shape}")
+    return rlinalg.lanczos(lambda v: spmv(a, v), n, k, key=key, ncv=ncv,
+                           which=which)
+
+
+def lanczos_smallest(a: CSR, k: int, key=None,
+                     ncv: Optional[int] = None):
+    """``lanczos_compute_smallest_eigenvectors`` parity wrapper."""
+    return lanczos_eigsh(a, k, key=key, ncv=ncv, which="smallest")
+
+
+def lanczos_largest(a: CSR, k: int, key=None, ncv: Optional[int] = None):
+    """``computeLargestEigenvectors`` (linalg/lanczos.cuh) parity wrapper."""
+    return lanczos_eigsh(a, k, key=key, ncv=ncv, which="largest")

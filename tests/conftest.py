@@ -3,8 +3,13 @@ comms tests run anywhere (the driver separately dry-runs the multi-chip path
 via __graft_entry__.dryrun_multichip). Must set flags before jax imports."""
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop external PJRT plugin dirs (e.g. a TPU-tunnel plugin on PYTHONPATH):
+# tests are CPU-only, and plugin registration can hang when the device
+# tunnel behind it is unreachable.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

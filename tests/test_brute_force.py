@@ -79,3 +79,19 @@ def test_serialize_roundtrip(rng):
     d2, i2 = brute_force.search(idx2, q, 5)
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_bitset_filter(rng):
+    from raft_tpu.core.bitset import Bitset
+
+    db = rng.standard_normal((200, 16)).astype(np.float32)
+    q = rng.standard_normal((20, 16)).astype(np.float32)
+    mask = rng.random(200) < 0.5
+    bs = Bitset.from_mask(mask)
+    idx = brute_force.build(db, metric="sqeuclidean")
+    d, i = brute_force.search(idx, q, 10, filter=bs)
+    i = np.asarray(i)
+    assert mask[i].all()  # only allowed rows returned
+    ref = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    ref = np.where(mask[None, :], ref, np.inf)
+    np.testing.assert_array_equal(i[:, 0], ref.argmin(1))

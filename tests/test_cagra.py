@@ -124,3 +124,21 @@ def test_serialize_without_dataset(built, data):
     _, i1 = cagra.search(built, q, 5)
     _, i2 = cagra.search(index2, q, 5)
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+def test_cagra_filtered_search(rng):
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import cagra
+
+    x = rng.standard_normal((500, 16)).astype(np.float32)
+    idx = cagra.build(x, cagra.IndexParams(graph_degree=16,
+                                           intermediate_graph_degree=32))
+    mask = rng.random(500) < 0.7
+    bs = Bitset.from_mask(mask)
+    q = x[:20] + 0.01 * rng.standard_normal((20, 16)).astype(np.float32)
+    d, i = cagra.search(idx, q, 5, cagra.SearchParams(itopk_size=64),
+                        filter=bs)
+    i = np.asarray(i)
+    valid = i >= 0
+    assert valid.any()
+    assert mask[i[valid]].all()

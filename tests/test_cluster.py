@@ -123,3 +123,38 @@ class TestKMeansBalanced:
     def test_bad_metric_raises(self):
         with pytest.raises(ValueError):
             KMeansBalancedParams(metric="canberra")
+
+
+def test_kmeans_sample_weights(rng):
+    from raft_tpu.cluster import kmeans
+
+    # two blobs; heavily weight one point far away so it pulls its center
+    x = np.vstack([rng.standard_normal((50, 2)),
+                   rng.standard_normal((50, 2)) + 20.0]).astype(np.float32)
+    w = np.ones(100, np.float32)
+    centers, labels, inertia, _ = kmeans.fit(
+        x, kmeans.KMeansParams(n_clusters=2, seed=3), sample_weights=w)
+    c = np.sort(np.asarray(centers)[:, 0])
+    assert abs(c[0]) < 2 and abs(c[1] - 20) < 2
+    # weighted fit matches unweighted when weights are uniform
+    cu, _, iu, _ = kmeans.fit(x, kmeans.KMeansParams(n_clusters=2, seed=3))
+    np.testing.assert_allclose(np.asarray(inertia), np.asarray(iu), rtol=1e-4)
+
+
+def test_update_centroids(rng):
+    from raft_tpu.cluster import kmeans
+
+    x = rng.standard_normal((60, 3)).astype(np.float32)
+    c0 = x[:4].copy()
+    w = rng.random(60).astype(np.float32) + 0.5
+    new_c, wsum = kmeans.update_centroids(x, c0, sample_weights=w)
+    # numpy reference
+    d = ((x[:, None, :] - c0[None, :, :]) ** 2).sum(-1)
+    lab = d.argmin(1)
+    ref_c = np.vstack([
+        (x[lab == j] * w[lab == j, None]).sum(0) / w[lab == j].sum()
+        if (lab == j).any() else c0[j]
+        for j in range(4)])
+    np.testing.assert_allclose(np.asarray(new_c), ref_c, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(wsum), np.bincount(lab, w, 4).astype(np.float32), rtol=1e-5)

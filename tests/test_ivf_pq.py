@@ -75,7 +75,13 @@ def test_recall(data, gt, kind):
     index = ivf_pq.build(db, params)
     d, i = ivf_pq.search(index, q, 10, ivf_pq.SearchParams(n_probes=32))
     recall = float(neighborhood_recall(np.asarray(i), gt))
-    assert recall >= 0.8, f"recall {recall} ({kind.name})"
+    # bf16 decoded-scan cache costs ~1e-3 recall vs the f32 LUT path
+    assert recall >= 0.79, f"recall {recall} ({kind.name})"
+    d32, i32 = ivf_pq.search(
+        index, q, 10, ivf_pq.SearchParams(n_probes=32,
+                                          scan_cache_dtype=jnp.float32))
+    recall32 = float(neighborhood_recall(np.asarray(i32), gt))
+    assert recall32 >= 0.8, f"f32-cache recall {recall32} ({kind.name})"
 
 
 def test_recall_increases_with_probes(data, gt):

@@ -4,6 +4,7 @@ single-linkage (reference: cpp/test/sparse/*, cpp/test/cluster/linkage.cu)."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.sparse import convert, distance, linalg, mst, spectral, types
@@ -146,3 +147,115 @@ def test_single_linkage_two_moons_style(rng):
     assert len(np.unique(labels)) == 2
     assert len(np.unique(labels[:30])) == 1
     assert len(np.unique(labels[30:])) == 1
+
+
+# ---------------------------------------------------------------------------
+# sparse.op (reference: sparse/op/{filter,reduce,row_op,slice,sort}.cuh)
+
+def test_coo_remove_scalar_and_zeros(rng):
+    from raft_tpu.sparse import COO, op
+
+    rows = np.array([0, 0, 1, 2, 2, 3], np.int32)
+    cols = np.array([1, 2, 0, 1, 3, 2], np.int32)
+    data = np.array([5.0, 0.0, 3.0, 0.0, 7.0, 2.0], np.float32)
+    coo = COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(data), (4, 4))
+    out, nnz = op.coo_remove_zeros(coo)
+    assert int(nnz) == 4
+    got = {(int(r), int(c)): float(v)
+           for r, c, v in zip(np.asarray(out.rows)[:4], np.asarray(out.cols)[:4],
+                              np.asarray(out.data)[:4])}
+    assert got == {(0, 1): 5.0, (1, 0): 3.0, (2, 3): 7.0, (3, 2): 2.0}
+    assert (np.asarray(out.rows)[4:] == -1).all()
+
+
+def test_coo_sum_and_max_duplicates():
+    from raft_tpu.sparse import COO, op
+
+    rows = np.array([0, 0, 1, 0], np.int32)
+    cols = np.array([1, 1, 2, 1], np.int32)
+    data = np.array([1.0, 2.0, 4.0, 3.0], np.float32)
+    coo = COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(data), (2, 3))
+    s = op.coo_sum_duplicates(coo)
+    got = {(int(r), int(c)): float(v)
+           for r, c, v in zip(np.asarray(s.rows), np.asarray(s.cols),
+                              np.asarray(s.data)) if r >= 0}
+    assert got == {(0, 1): 6.0, (1, 2): 4.0}
+    m = op.coo_max_duplicates(coo)
+    got = {(int(r), int(c)): float(v)
+           for r, c, v in zip(np.asarray(m.rows), np.asarray(m.cols),
+                              np.asarray(m.data)) if r >= 0}
+    assert got == {(0, 1): 3.0, (1, 2): 4.0}
+
+
+def test_csr_row_ops_and_slice(rng):
+    import scipy.sparse as sp
+    from raft_tpu.sparse import csr_from_scipy_like, op
+
+    m = sp.random(8, 6, density=0.4, format="csr", random_state=0,
+                  dtype=np.float32)
+    csr = csr_from_scipy_like(m.indptr, m.indices, m.data, m.shape)
+    doubled = op.csr_row_op(csr, lambda rid, vals: vals * 2.0)
+    np.testing.assert_allclose(np.asarray(doubled.data), m.data * 2, rtol=1e-6)
+
+    sl = op.csr_row_slice(csr, 2, 5)
+    ref = m[2:5]
+    assert sl.shape == (3, 6)
+    np.testing.assert_array_equal(np.asarray(sl.indptr), ref.indptr)
+    np.testing.assert_allclose(np.asarray(sl.data), ref.data, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse.neighbors (knn.cuh, cross_component_nn.cuh)
+
+def test_sparse_brute_force_knn(rng):
+    import scipy.sparse as sp
+    from raft_tpu.sparse import csr_from_scipy_like, neighbors as snn
+
+    db_d = rng.standard_normal((50, 20)).astype(np.float32)
+    q_d = rng.standard_normal((10, 20)).astype(np.float32)
+    db_d[rng.random(db_d.shape) < 0.6] = 0
+    q_d[rng.random(q_d.shape) < 0.6] = 0
+    db = sp.csr_matrix(db_d)
+    q = sp.csr_matrix(q_d)
+    d, i = snn.brute_force_knn(
+        csr_from_scipy_like(db.indptr, db.indices, db.data, db.shape),
+        csr_from_scipy_like(q.indptr, q.indices, q.data, q.shape), 5)
+    ref = ((q_d[:, None, :] - db_d[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
+
+
+def test_cross_component_nn(rng):
+    from raft_tpu.sparse import neighbors as snn
+
+    # two well-separated blobs plus one singleton
+    a = rng.standard_normal((10, 4)).astype(np.float32)
+    b = rng.standard_normal((8, 4)).astype(np.float32) + 50.0
+    x = np.vstack([a, b])
+    colors = np.array([0] * 10 + [1] * 8, np.int32)
+    d, j = snn.cross_component_nn(x, colors)
+    j = np.asarray(j)
+    # every point's cross-NN is in the other component
+    assert (colors[j[:10]] == 1).all()
+    assert (colors[j[10:]] == 0).all()
+    full = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    mask = colors[:, None] == colors[None, :]
+    ref = np.where(mask, np.inf, full)
+    np.testing.assert_array_equal(j, ref.argmin(1))
+
+
+# ---------------------------------------------------------------------------
+# sparse.solver (sparse/solver/lanczos.cuh)
+
+def test_lanczos_eigsh_smallest():
+    import scipy.sparse as sp
+    from raft_tpu.sparse import csr_from_scipy_like, solver
+
+    # path-graph laplacian: known smallest eigenvalue 0
+    n = 24
+    g = sp.diags([-1, 2, -1], [-1, 0, 1], shape=(n, n), format="csr",
+                 dtype=np.float32)
+    a = csr_from_scipy_like(g.indptr, g.indices, g.data, g.shape)
+    vals, vecs = solver.lanczos_eigsh(a, 3, key=jax.random.key(0), ncv=24)
+    dense = g.toarray()
+    ref = np.linalg.eigvalsh(dense)[:3]
+    np.testing.assert_allclose(np.sort(np.asarray(vals)), ref, atol=1e-2)

@@ -1,0 +1,53 @@
+"""Break down ivf_flat-style search costs on TPU."""
+import time, functools, json
+import numpy as np, jax, jax.numpy as jnp
+from raft_tpu.ops.select_k import select_k
+
+def bench(f, *a, iters=5):
+    r = f(*a); jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(*a)
+    jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / iters
+
+rng = np.random.default_rng(0)
+L, pad, dim = 1024, 128, 96
+nq, P, k = 1024, 32, 10
+list_data = jnp.asarray(rng.standard_normal((L, pad, dim)), jnp.float32)
+queries = jnp.asarray(rng.standard_normal((nq, dim)), jnp.float32)
+centers = jnp.asarray(rng.standard_normal((L, dim)), jnp.float32)
+probes = jnp.asarray(rng.integers(0, L, (nq, P)), jnp.int32)
+
+@jax.jit
+def coarse(q):
+    d = q @ centers.T
+    return select_k(d, P, select_min=True)
+
+@jax.jit
+def gather_only(pr):
+    return list_data[pr]  # [nq, P, pad, dim]
+
+@jax.jit
+def gather_dot(q, pr):
+    g = list_data[pr]
+    return jnp.einsum("td,tpld->tpl", q, g, preferred_element_type=jnp.float32)
+
+@jax.jit
+def gather_dot_topk(q, pr):
+    g = list_data[pr]
+    d = jnp.einsum("td,tpld->tpl", q, g, preferred_element_type=jnp.float32)
+    return select_k(d.reshape(nq, -1), k, select_min=True)
+
+@jax.jit
+def topk_only(d):
+    return select_k(d, k, select_min=True)
+
+print("coarse+selP  ", round(bench(coarse, queries)*1e3, 1), "ms")
+print("gather_only  ", round(bench(gather_only, probes)*1e3, 1), "ms")
+print("gather_dot   ", round(bench(gather_dot, queries, probes)*1e3, 1), "ms")
+print("g_d_topk     ", round(bench(gather_dot_topk, queries, probes)*1e3, 1), "ms")
+d = jnp.asarray(rng.standard_normal((nq, P*pad)), jnp.float32)
+print("topk_only    ", round(bench(topk_only, d)*1e3, 1), "ms")
+bytes_probed = nq*P*pad*dim*4
+print("probed GB:", round(bytes_probed/1e9, 2))
