@@ -7,15 +7,23 @@ shape/dtype conventions are documented per-API instead of encoded in types.
 
 from raft_tpu.core.resources import Resources, default_resources, ensure_resources
 from raft_tpu.core.bitset import Bitset
-from raft_tpu.core import interruptible, logger, serialize, tracing
+from raft_tpu.core.errors import RaftError, LogicError, expects, fail
+from raft_tpu.core import (interruptible, logger, operators, resources_manager,
+                           serialize, tracing)
 
 __all__ = [
     "Resources",
     "default_resources",
     "ensure_resources",
     "Bitset",
+    "RaftError",
+    "LogicError",
+    "expects",
+    "fail",
     "interruptible",
     "logger",
+    "operators",
+    "resources_manager",
     "serialize",
     "tracing",
 ]

@@ -1,0 +1,31 @@
+"""Error types and validation helpers.
+
+Reference: ``raft::core`` error machinery (core/error.hpp — ``raft::exception``,
+``logic_error``, ``RAFT_EXPECTS``, ``RAFT_FAIL``). The CUDA/cuBLAS/etc.
+status-check macros have no analog — XLA raises its own exceptions.
+"""
+
+from __future__ import annotations
+
+
+class RaftError(RuntimeError):
+    """Base exception (raft::exception analog)."""
+
+
+class LogicError(RaftError):
+    """Precondition violation (raft::logic_error / RAFT_EXPECTS)."""
+
+
+def expects(condition: bool, message: str = "precondition violated") -> None:
+    """``RAFT_EXPECTS(cond, msg)`` — raise LogicError unless condition.
+
+    Host-side validation only: call on static shapes/params before tracing,
+    never on traced values (use checkify inside jit for those).
+    """
+    if not condition:
+        raise LogicError(message)
+
+
+def fail(message: str) -> None:
+    """``RAFT_FAIL(msg)`` — unconditional LogicError."""
+    raise LogicError(message)

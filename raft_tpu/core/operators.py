@@ -1,0 +1,129 @@
+"""Composable math operators.
+
+Reference: ``raft::core`` operator functors (core/operators.hpp — identity,
+sq_op, abs_op, add_op, sub_op, mul_op, div_op, min/max, pow, sqrt, and the
+``compose_op`` / ``map_args_op`` / ``const_op`` / ``plug_const_op``
+combinators) used to parameterize map/reduce prims.
+
+TPU-native design: plain Python callables over jnp — XLA traces and fuses
+them wherever they are applied, so there is no functor machinery to port;
+these exist so code written against the reference's vocabulary (e.g.
+``linalg.map(ops.sq_op, x)``) reads the same.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def identity_op(x):
+    return x
+
+
+def sq_op(x):
+    return x * x
+
+
+def abs_op(x):
+    return jnp.abs(x)
+
+
+def sqrt_op(x):
+    return jnp.sqrt(x)
+
+
+def nz_op(x):
+    """1 where nonzero else 0 (core/operators.hpp nz_op)."""
+    return jnp.where(x != 0, 1, 0).astype(x.dtype)
+
+
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    """a/b with 0 where b == 0 (core/operators.hpp div_checkzero_op)."""
+    return jnp.where(b == 0, 0, a / jnp.where(b == 0, 1, b))
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def pow_op(a, b):
+    return a ** b
+
+
+def mod_op(a, b):
+    return a % b
+
+
+def equal_op(a, b):
+    return a == b
+
+
+def notequal_op(a, b):
+    return a != b
+
+
+def greater_op(a, b):
+    return a > b
+
+
+def less_op(a, b):
+    return a < b
+
+
+def const_op(c):
+    """Returns an op ignoring its inputs (core/operators.hpp const_op)."""
+    return lambda *args: c
+
+
+def compose_op(*ops):
+    """compose_op(f, g, h)(x) == f(g(h(x))) (core/operators.hpp
+    compose_op — applied innermost-last like the reference)."""
+
+    def composed(*args):
+        out = ops[-1](*args)
+        for f in reversed(ops[:-1]):
+            out = f(out)
+        return out
+
+    return composed
+
+
+def plug_const_op(c, op):
+    """Binds a constant as the second argument (plug_const_op)."""
+    return lambda x: op(x, c)
+
+
+add_const_op = lambda c: plug_const_op(c, add_op)  # noqa: E731
+sub_const_op = lambda c: plug_const_op(c, sub_op)  # noqa: E731
+mul_const_op = lambda c: plug_const_op(c, mul_op)  # noqa: E731
+div_const_op = lambda c: plug_const_op(c, div_op)  # noqa: E731
+pow_const_op = lambda c: plug_const_op(c, pow_op)  # noqa: E731
+
+
+def map_args_op(op, *maps):
+    """Applies per-argument transforms before ``op`` (map_args_op)."""
+
+    def mapped(*args):
+        return op(*(m(a) for m, a in zip(maps, args)))
+
+    return mapped

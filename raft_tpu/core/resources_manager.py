@@ -1,0 +1,84 @@
+"""Pooled per-device Resources for multi-threaded servers.
+
+Reference: ``raft::device_resources_manager``
+(core/device_resources_manager.hpp:36-95) — a process-wide singleton handing
+out pooled ``device_resources`` round-robin so server threads don't each
+construct handles/streams.
+
+TPU-native design: XLA owns streams, so the pooled state reduces to
+Resources objects (PRNG key streams + workspace budgets + resource slots)
+per device. Round-robin across a configurable pool bounds PRNG-key
+contention between threads; hand-out is lock-protected and cheap.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+
+from raft_tpu.core.resources import Resources
+
+
+class _Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pools: Dict[int, List[Resources]] = {}
+        self._next: Dict[int, int] = {}
+        self._pool_size = 1
+        self._workspace_limit: Optional[int] = None
+        self._frozen = False
+
+    def set_resources_per_device(self, n: int) -> None:
+        """Analog of ``set_streams_per_device`` — pool width per device.
+        Must be called before the first hand-out (like the reference, which
+        ignores post-first-use option changes)."""
+        with self._lock:
+            if self._frozen:
+                return  # reference semantics: options frozen after first use
+            self._pool_size = max(int(n), 1)
+
+    def set_workspace_limit(self, n_bytes: int) -> None:
+        with self._lock:
+            if self._frozen:
+                return
+            self._workspace_limit = int(n_bytes)
+
+    def get_resources(self, device: Optional[jax.Device] = None) -> Resources:
+        """Round-robin a pooled Resources for ``device`` (default: jax
+        default device) — ``get_device_resources`` analog."""
+        device = device or jax.devices()[0]
+        did = device.id
+        with self._lock:
+            self._frozen = True
+            pool = self._pools.get(did)
+            if pool is None:
+                kwargs = {}
+                if self._workspace_limit is not None:
+                    kwargs["workspace_limit_bytes"] = self._workspace_limit
+                pool = [Resources(seed=1000 + did * 101 + i, device=device,
+                                  **kwargs)
+                        for i in range(self._pool_size)]
+                self._pools[did] = pool
+                self._next[did] = 0
+            i = self._next[did]
+            self._next[did] = (i + 1) % len(pool)
+            return pool[i]
+
+    def reset(self) -> None:
+        """Testing hook: drop all pools and unfreeze options."""
+        with self._lock:
+            self._pools.clear()
+            self._next.clear()
+            self._pool_size = 1
+            self._workspace_limit = None
+            self._frozen = False
+
+
+_manager = _Manager()
+
+set_resources_per_device = _manager.set_resources_per_device
+set_workspace_limit = _manager.set_workspace_limit
+get_resources = _manager.get_resources
+reset = _manager.reset

@@ -90,3 +90,52 @@ def test_pallas_fused_l2_argmin_unaligned(rng):
     v, i = pk.fused_l2_argmin(x, y, tm=16, tn=128, interpret=True)
     d = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
     np.testing.assert_array_equal(np.asarray(i), d.argmin(1))
+
+
+# ---------------------------------------------------------------------------
+# operators / errors / resources_manager (core/operators.hpp, core/error.hpp,
+# core/device_resources_manager.hpp)
+
+def test_operators():
+    from raft_tpu.core import operators as ops
+
+    x = jnp.asarray([1.0, -2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(ops.sq_op(x)), [1, 4, 9])
+    np.testing.assert_allclose(np.asarray(ops.abs_op(x)), [1, 2, 3])
+    np.testing.assert_allclose(
+        np.asarray(ops.compose_op(ops.sqrt_op, ops.abs_op)(x)),
+        np.sqrt([1, 2, 3]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ops.div_checkzero_op(x, jnp.asarray([1.0, 0.0, 2.0]))),
+        [1.0, 0.0, 1.5])
+    addc = ops.add_const_op(10.0)
+    np.testing.assert_allclose(np.asarray(addc(x)), [11, 8, 13])
+    mapped = ops.map_args_op(ops.add_op, ops.sq_op, ops.abs_op)
+    np.testing.assert_allclose(np.asarray(mapped(x, x)), [2, 6, 12])
+
+
+def test_errors():
+    import pytest
+    from raft_tpu.core import errors
+
+    errors.expects(True, "fine")
+    with pytest.raises(errors.LogicError):
+        errors.expects(False, "boom")
+    with pytest.raises(errors.LogicError):
+        errors.fail("nope")
+    assert issubclass(errors.LogicError, errors.RaftError)
+
+
+def test_resources_manager_round_robin():
+    from raft_tpu.core import resources_manager as rm
+
+    rm.reset()
+    rm.set_resources_per_device(3)
+    got = [rm.get_resources() for _ in range(4)]
+    assert got[0] is got[3]          # pool of 3 wraps around
+    assert len({id(r) for r in got[:3]}) == 3
+    # options are frozen after first hand-out (reference semantics)
+    rm.set_resources_per_device(5)
+    got2 = [rm.get_resources() for _ in range(5)]
+    assert len({id(r) for r in got2}) == 3
+    rm.reset()
