@@ -13,7 +13,8 @@ instead of hand-rolled CUDA, jax.sharding.Mesh collectives instead of NCCL.
 
 from raft_tpu.core.resources import Resources
 from raft_tpu import core, ops, cluster, neighbors, parallel, sparse, stats, utils
-from raft_tpu import bench, common, distance, matrix, random
+from raft_tpu import bench, common, distance, label, matrix, random
+from raft_tpu import solver, spatial
 
 __version__ = "0.1.0"
 
@@ -29,8 +30,11 @@ __all__ = [
     "bench",
     "common",
     "distance",
+    "label",
     "matrix",
     "random",
+    "solver",
+    "spatial",
     "utils",
     "__version__",
 ]

@@ -1,0 +1,75 @@
+"""Label utilities.
+
+Reference: ``raft::label`` (label/classlabels.cuh — ``getUniquelabels``,
+``getOvhaInstance``... i.e. unique-label extraction and monotonic relabeling
+``make_monotonic``; label/merge_labels.cuh — ``merge_labels``, the
+union-find-style label merge used by connected-components).
+
+TPU-native design: unique/relabel ride ``jnp.unique``-style sort machinery
+with static output capacity (XLA needs static shapes — callers pass the
+max number of classes); merge_labels is the same min-propagation fixpoint
+the reference runs, expressed as a bounded ``lax.while_loop``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def get_unique_labels(labels, max_labels: int) -> Tuple[jax.Array, jax.Array]:
+    """Sorted unique labels padded to ``max_labels`` with -1, plus the count
+    (label/classlabels.cuh getUniquelabels analog; capacity is static)."""
+    labels = jnp.asarray(labels, jnp.int32).ravel()
+    uniq = jnp.unique(labels, size=max_labels, fill_value=-1)
+    # jnp.unique sorts ascending; -1 fill can collide with real -1 labels,
+    # which the reference treats as "unlabeled" anyway
+    n = jnp.sum(uniq >= 0) + jnp.any(labels == -1).astype(jnp.int32) * 0
+    return uniq, n
+
+
+def make_monotonic(labels, max_labels: int) -> jax.Array:
+    """Relabel to a dense 0..n-1 range by rank among unique values
+    (label/classlabels.cuh make_monotonic analog). Negative labels pass
+    through unchanged (unlabeled convention)."""
+    labels = jnp.asarray(labels, jnp.int32)
+    uniq = jnp.unique(jnp.where(labels < 0, jnp.iinfo(jnp.int32).max, labels),
+                      size=max_labels, fill_value=jnp.iinfo(jnp.int32).max)
+    rank = jnp.searchsorted(uniq, labels)
+    return jnp.where(labels < 0, labels, rank.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("max_iters",))
+def merge_labels(labels_a, labels_b, max_iters: int = 32) -> jax.Array:
+    """Merge two labelings into their finest common coarsening: rows sharing
+    a label in EITHER input end up with the same (minimum) output label —
+    the connected-components merge of label/merge_labels.cuh.
+
+    Runs min-propagation through both label tables until fixpoint (bounded
+    by ``max_iters``; log₂(n) rounds suffice in practice).
+    """
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    n = a.shape[0]
+    out0 = jnp.arange(n, dtype=jnp.int32)
+
+    def propagate(out, lab):
+        # every group in `lab` adopts the min current out-label of the group
+        big = jnp.iinfo(jnp.int32).max
+        gmin = jnp.full((n,), big, jnp.int32).at[lab].min(out)
+        return jnp.minimum(out, gmin[lab])
+
+    def cond(state):
+        i, out, prev_changed = state
+        return (i < max_iters) & prev_changed
+
+    def body(state):
+        i, out, _ = state
+        new = propagate(propagate(out, a), b)
+        return i + 1, new, jnp.any(new != out)
+
+    _, out, _ = jax.lax.while_loop(cond, body, (0, out0, jnp.bool_(True)))
+    return out

@@ -1,0 +1,42 @@
+"""Legacy ``spatial.knn`` namespace — thin forwarding layer.
+
+Reference: ``raft::spatial::knn`` (spatial/knn/*.cuh) is the deprecated
+pre-``neighbors`` API that still forwards to the same implementations
+(knn.cuh, ball_cover.cuh, epsilon_neighborhood.cuh, ivf_flat.cuh,
+ivf_pq.cuh) and hosts the haversine utilities. Kept here so code written
+against the old paths ports unchanged; new code should import
+``raft_tpu.neighbors`` / ``raft_tpu.distance`` directly.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from raft_tpu.neighbors import (ball_cover, brute_force, epsilon_neighborhood,
+                                ivf_flat, ivf_pq)
+from raft_tpu.neighbors.brute_force import knn as brute_force_knn
+from raft_tpu.ops.distance import pairwise_distance
+
+
+def knn_search(dataset, queries, k: int, metric="euclidean", **kwargs):
+    """Legacy entry (spatial/knn/knn.cuh brute_force_knn shape)."""
+    return brute_force_knn(queries, dataset, k, metric=metric, **kwargs)
+
+
+def haversine_distance(x, y):
+    """Pairwise haversine over [n, 2] (lat, lon) radians
+    (spatial/knn/detail/haversine_distance.cuh)."""
+    return pairwise_distance(x, y, metric="haversine")
+
+
+knn = SimpleNamespace(
+    knn=knn_search,
+    brute_force=brute_force,
+    ball_cover=ball_cover,
+    epsilon_neighborhood=epsilon_neighborhood,
+    ivf_flat=ivf_flat,
+    ivf_pq=ivf_pq,
+    haversine_distance=haversine_distance,
+)
+
+__all__ = ["knn", "knn_search", "haversine_distance"]

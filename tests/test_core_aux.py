@@ -139,3 +139,60 @@ def test_resources_manager_round_robin():
     got2 = [rm.get_resources() for _ in range(5)]
     assert len({id(r) for r in got2}) == 3
     rm.reset()
+
+
+# ---------------------------------------------------------------------------
+# label / solver / spatial namespaces
+
+def test_make_monotonic_and_unique():
+    from raft_tpu import label
+
+    labs = np.array([7, 3, 7, 9, 3, -1], np.int32)
+    mono = np.asarray(label.make_monotonic(labs, max_labels=8))
+    assert mono[0] == mono[2] and mono[1] == mono[4]
+    assert set(mono[[0, 1, 3]]) == {0, 1, 2}
+    assert mono[5] == -1
+    uniq, n = label.get_unique_labels(labs[:-1], max_labels=8)
+    assert int(n) == 3
+    assert list(np.asarray(uniq)[:3]) == [3, 7, 9]
+
+
+def test_merge_labels():
+    from raft_tpu import label
+
+    # a: {0,1},{2,3}; b: {1,2},{0},{3} → all four merge into one group
+    a = np.array([0, 0, 1, 1], np.int32)
+    b = np.array([0, 1, 1, 2], np.int32)
+    out = np.asarray(label.merge_labels(a, b))
+    assert len(set(out)) == 1
+    # disjoint groups stay separate
+    a = np.array([0, 0, 1, 1], np.int32)
+    b = np.array([2, 2, 3, 3], np.int32)
+    out = np.asarray(label.merge_labels(a, b))
+    assert out[0] == out[1] and out[2] == out[3] and out[0] != out[2]
+
+
+def test_lap_auction_matches_scipy(rng):
+    from raft_tpu import solver
+
+    for n in (5, 12):
+        cost = rng.random((n, n)).astype(np.float32)
+        assign, total = solver.solve(cost)
+        ref_assign, ref_total = solver.solve_host(cost)
+        assign = np.asarray(assign)
+        assert (assign >= 0).all() and len(set(assign.tolist())) == n
+        # auction is eps-optimal: within n*eps of the exact optimum
+        assert float(total) <= ref_total + n * (1.0 / (n + 1)) + 1e-4
+
+
+def test_spatial_namespace(rng):
+    from raft_tpu import spatial
+
+    db = rng.standard_normal((50, 8)).astype(np.float32)
+    q = rng.standard_normal((5, 8)).astype(np.float32)
+    d, i = spatial.knn.knn(db, q, k=3, metric="sqeuclidean")
+    ref = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
+    pts = np.radians([[51.5, -0.13], [48.86, 2.35]]).astype(np.float32)
+    h = np.asarray(spatial.haversine_distance(pts, pts))
+    assert h.shape == (2, 2) and h[0, 1] > 0
