@@ -110,3 +110,63 @@ def trustworthiness_score(x, x_embedded, n_neighbors: int = 5,
     penalty = jnp.sum(jnp.maximum(r - k, 0).astype(jnp.float32))
     norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
     return 1.0 - norm * penalty
+
+
+def sum(x, axis=0):
+    """Column/row sums (stats/sum.cuh)."""
+    return jnp.sum(jnp.asarray(x), axis=axis)
+
+
+def mean_center(x, axis=0):
+    """Subtract the mean along ``axis`` (stats/mean_center.cuh mean_center);
+    returns (centered, means)."""
+    x = jnp.asarray(x)
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    return x - mu, jnp.squeeze(mu, axis=axis)
+
+
+def meanvar(x, axis=0, sample: bool = False):
+    """Fused mean+variance (stats/meanvar.cuh)."""
+    x = jnp.asarray(x)
+    mu = jnp.mean(x, axis=axis)
+    v = jnp.var(x, axis=axis, ddof=1 if sample else 0)
+    return mu, v
+
+
+def kl_divergence(p, q):
+    """Σ p·log(p/q) over all elements (stats/kl_divergence.cuh; terms with
+    p == 0 contribute 0, as in the reference's modKL op)."""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    t = jnp.where(p > 0, p * (jnp.log(jnp.maximum(p, 1e-38))
+                              - jnp.log(jnp.maximum(q, 1e-38))), 0.0)
+    return jnp.sum(t)
+
+
+def regression_metrics(y_true, y_pred):
+    """(mean_abs_error, mean_squared_error, median_abs_error) —
+    stats/regression_metrics.cuh regression_metrics."""
+    y_true = jnp.asarray(y_true, jnp.float32)
+    y_pred = jnp.asarray(y_pred, jnp.float32)
+    err = y_pred - y_true
+    return (jnp.mean(jnp.abs(err)), jnp.mean(err * err),
+            jnp.median(jnp.abs(err)))
+
+
+def information_criterion_batched(log_likelihood, n_params: int,
+                                  n_samples: int, criterion: str = "aic"):
+    """AIC/AICc/BIC from per-series log-likelihoods
+    (stats/information_criterion.cuh compute_batched_ics; criterion ∈
+    {aic, aicc, bic})."""
+    ll = jnp.asarray(log_likelihood, jnp.float32)
+    k = float(n_params)
+    n = float(n_samples)
+    base = -2.0 * ll
+    if criterion == "aic":
+        return base + 2.0 * k
+    if criterion == "aicc":
+        return base + 2.0 * k + 2.0 * k * (k + 1.0) / jnp.maximum(
+            n - k - 1.0, 1e-6)
+    if criterion == "bic":
+        return base + k * jnp.log(jnp.maximum(n, 1.0))
+    raise ValueError(f"unknown criterion: {criterion}")

@@ -104,3 +104,10 @@ def silhouette_score(x, labels, n_classes: int, metric="l2_expanded"):
     b = jnp.min(means, axis=1)
     s = jnp.where(own_size > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-38), 0.0)
     return jnp.mean(s)
+
+
+def contingency_matrix(a, b, n_classes_a: int, n_classes_b: int):
+    """Public contingency table (stats/contingency_matrix.cuh
+    contingencyMatrix): counts[i, j] = |{k : a[k]=i ∧ b[k]=j}|."""
+    return _contingency(jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32),
+                        n_classes_a, n_classes_b)

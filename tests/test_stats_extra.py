@@ -31,3 +31,67 @@ def test_trustworthiness_degrades_with_shuffle(rng):
     t_good = float(trustworthiness_score(x, x, n_neighbors=5))
     t_bad = float(trustworthiness_score(x, bad, n_neighbors=5))
     assert t_bad < t_good
+
+
+# ---------------------------------------------------------------------------
+# breadth additions: sum/mean_center/meanvar/kl/regression/IC/contingency
+
+def test_sum_mean_center_meanvar(rng):
+    from raft_tpu import stats
+
+    x = rng.standard_normal((20, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(stats.sum(x)), x.sum(0), rtol=1e-5)
+    centered, mu = stats.mean_center(x)
+    np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(centered), x - x.mean(0),
+                               rtol=1e-5, atol=1e-6)
+    m, v = stats.meanvar(x, sample=True)
+    np.testing.assert_allclose(np.asarray(v), x.var(0, ddof=1), rtol=1e-4)
+
+
+def test_kl_divergence_stat(rng):
+    from raft_tpu import stats
+
+    p = rng.random(32).astype(np.float32)
+    q = rng.random(32).astype(np.float32)
+    p /= p.sum(); q /= q.sum()
+    got = float(stats.kl_divergence(p, q))
+    ref = float((p * (np.log(p) - np.log(q))).sum())
+    assert abs(got - ref) < 1e-4
+    assert float(stats.kl_divergence(p, p)) < 1e-6
+
+
+def test_regression_metrics(rng):
+    from raft_tpu import stats
+
+    yt = rng.standard_normal(50).astype(np.float32)
+    yp = yt + rng.standard_normal(50).astype(np.float32) * 0.1
+    mae, mse, medae = stats.regression_metrics(yt, yp)
+    err = yp - yt
+    np.testing.assert_allclose(float(mae), np.abs(err).mean(), rtol=1e-4)
+    np.testing.assert_allclose(float(mse), (err ** 2).mean(), rtol=1e-4)
+    np.testing.assert_allclose(float(medae), np.median(np.abs(err)), rtol=1e-4)
+
+
+def test_information_criterion():
+    from raft_tpu import stats
+
+    ll = np.array([-100.0, -50.0], np.float32)
+    aic = np.asarray(stats.information_criterion_batched(ll, 3, 100, "aic"))
+    np.testing.assert_allclose(aic, -2 * ll + 6)
+    bic = np.asarray(stats.information_criterion_batched(ll, 3, 100, "bic"))
+    np.testing.assert_allclose(bic, -2 * ll + 3 * np.log(100), rtol=1e-6)
+    aicc = np.asarray(stats.information_criterion_batched(ll, 3, 100, "aicc"))
+    assert (aicc > aic).all()
+
+
+def test_contingency_matrix():
+    from raft_tpu import stats
+
+    a = np.array([0, 0, 1, 2, 2], np.int32)
+    b = np.array([1, 1, 0, 0, 1], np.int32)
+    c = np.asarray(stats.contingency_matrix(a, b, 3, 2))
+    ref = np.zeros((3, 2), np.int32)
+    for i, j in zip(a, b):
+        ref[i, j] += 1
+    np.testing.assert_array_equal(c, ref)
