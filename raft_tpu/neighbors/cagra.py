@@ -46,7 +46,7 @@ from raft_tpu.ops.distance import (
     gathered_distances,
     resolve_metric,
 )
-from raft_tpu.ops.select_k import merge_topk_dedup
+from raft_tpu.ops.select_k import merge_topk_dedup, merge_topk_dedup_flagged
 from raft_tpu.utils.shape import cdiv
 
 
@@ -333,57 +333,54 @@ def _search_jit(queries, dataset, graph, seed_ids, filter_words,
     # ---- init: random seed nodes (random_samplings, search_plan.cuh)
     init_ids = seed_ids  # [nq, S]
     init_d = dists_to(init_ids)
-    buf_size = itopk + width * degree
-    pad_n = buf_size - init_ids.shape[1]
-    buf_ids = jnp.pad(init_ids, ((0, 0), (0, pad_n)), constant_values=-1)
-    buf_d = jnp.pad(init_d, ((0, 0), (0, pad_n)), constant_values=bad)
-    # expanded-parents list = visited set (parents only, like the reference's
-    # parent bitmask; capacity = width per iteration)
-    exp_cap = max(width * max_iter, 1)
-    expanded = jnp.full((nq, exp_cap), -1, jnp.int32)
+    init_fl = jnp.zeros_like(init_ids, dtype=bool)
+    buf_ids, buf_d, buf_fl = merge_topk_dedup_flagged(
+        init_ids, init_d, init_fl, itopk)
 
-    buf_ids, buf_d = merge_topk_dedup(buf_ids, buf_d, itopk)
+    # The "expanded" flag rides the itopk buffer instead of a growing visited
+    # array (the reference's hashmap): the buffer is monotone under the
+    # merge, so a node that falls out of the top-itopk can never re-enter —
+    # buffer-resident flags are a complete visited set.
+    rows = jnp.arange(nq)[:, None]
 
     def body(it, state):
-        buf_ids, buf_d, expanded, done = state
+        buf_ids, buf_d, buf_fl, done = state
         # pickup_next_parents: best `width` unexpanded buffer entries
-        is_exp = jnp.any(
-            buf_ids[:, :, None] == expanded[:, None, :], axis=2)
-        cand_d = jnp.where(is_exp | (buf_ids < 0), bad, buf_d)
+        cand_d = jnp.where(buf_fl | (buf_ids < 0), bad, buf_d)
         p_d, p_sel = jax.lax.top_k(-cand_d, width)
         parents = jnp.take_along_axis(buf_ids, p_sel, axis=1)  # [nq, W]
-        has_parent = jnp.isfinite(-p_d[:, 0])
+        valid_p = jnp.isfinite(-p_d) & (parents >= 0) & ~done[:, None]
+        has_parent = valid_p[:, 0]
         newly_done = ~has_parent
-        parents = jnp.where((parents < 0) | newly_done[:, None] | done[:, None],
-                            -1, parents)
+        parents = jnp.where(valid_p, parents, -1)
 
-        # mark parents expanded
-        expanded = jax.lax.dynamic_update_slice(
-            expanded, parents, (0, it * width))
+        # mark picked parents expanded in the buffer
+        mark = jnp.zeros_like(buf_fl).at[rows, p_sel].max(valid_p)
+        buf_fl = buf_fl | mark
 
         # expand: gather graph rows of parents
         targets = graph[jnp.maximum(parents, 0)].reshape(-1, width * degree)
         targets = jnp.where(
             jnp.repeat(parents < 0, degree, axis=1), -1, targets)
-        # drop targets already expanded
-        t_exp = jnp.any(
-            targets[:, :, None] == expanded[:, None, :], axis=2)
-        targets = jnp.where(t_exp, -1, targets)
         t_d = dists_to(targets)
+        t_fl = jnp.zeros_like(targets, dtype=bool)
 
         new_ids = jnp.concatenate([buf_ids, targets], axis=1)
         new_d = jnp.concatenate([buf_d, t_d], axis=1)
-        nb_ids, nb_d = merge_topk_dedup(new_ids, new_d, itopk)
+        new_fl = jnp.concatenate([buf_fl, t_fl], axis=1)
+        nb_ids, nb_d, nb_fl = merge_topk_dedup_flagged(
+            new_ids, new_d, new_fl, itopk)
         # frozen queries keep their state
         keep = done[:, None]
         buf_ids = jnp.where(keep, buf_ids, nb_ids)
         buf_d = jnp.where(keep, buf_d, nb_d)
+        buf_fl = jnp.where(keep, buf_fl, nb_fl)
         done = done | newly_done
-        return buf_ids, buf_d, expanded, done
+        return buf_ids, buf_d, buf_fl, done
 
     done0 = jnp.zeros((nq,), bool)
-    buf_ids, buf_d, expanded, _ = jax.lax.fori_loop(
-        0, max_iter, body, (buf_ids, buf_d, expanded, done0))
+    buf_ids, buf_d, buf_fl, _ = jax.lax.fori_loop(
+        0, max_iter, body, (buf_ids, buf_d, buf_fl, done0))
 
     out_d, out_i = buf_d[:, :k], buf_ids[:, :k]
     if metric == DistanceType.InnerProduct:

@@ -131,3 +131,33 @@ def merge_topk_dedup(ids, dists, k: int, exclude_ids=None):
     top, sel = jax.lax.top_k(-ds_s, k)
     out_ids = jnp.take_along_axis(ids_s, sel, axis=1)
     return jnp.where(jnp.isfinite(-top), out_ids, -1), -top
+
+
+def merge_topk_dedup_flagged(ids, dists, flags, k: int):
+    """``merge_topk_dedup`` carrying a per-entry boolean flag: duplicate ids
+    collapse to one entry whose flag is the OR of the copies' flags (CAGRA's
+    itopk merge, where the flag means "already expanded as a parent" —
+    the buffer-resident analog of the reference's visited hashmap).
+
+    Returns (ids [b, k], dists [b, k], flags [b, k]) ascending by distance.
+    """
+    b, m = ids.shape
+    ds = jnp.where(ids < 0, jnp.inf, dists)
+    # sort by (id, flag-first) so each dup group is adjacent with a flagged
+    # copy leading when present; ids < 2^30 assumed (int32 key headroom)
+    key = ids * 2 + jnp.where(flags, 0, 1)
+    order = jnp.argsort(jnp.where(ids < 0, jnp.iinfo(jnp.int32).max, key),
+                        axis=1)
+    ids_s = jnp.take_along_axis(ids, order, axis=1)
+    ds_s = jnp.take_along_axis(ds, order, axis=1)
+    fl_s = jnp.take_along_axis(flags, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((b, 1), bool), ids_s[:, 1:] == ids_s[:, :-1]], axis=1)
+    # the group leader absorbs any copy's flag (same node, same distance)
+    grp_flag = fl_s  # leader is flagged-first by the sort key
+    ds_s = jnp.where(dup, jnp.inf, ds_s)
+    top, sel = jax.lax.top_k(-ds_s, k)
+    out_ids = jnp.take_along_axis(ids_s, sel, axis=1)
+    out_fl = jnp.take_along_axis(grp_flag, sel, axis=1)
+    valid = jnp.isfinite(-top)
+    return (jnp.where(valid, out_ids, -1), -top, out_fl & valid)
