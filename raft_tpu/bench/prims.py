@@ -1,0 +1,115 @@
+"""Micro-benchmarks for the primitive layer.
+
+Reference: ``cpp/bench/prims`` — google-benchmark suites with CUDA-event
+timing (bench/prims/common/benchmark.hpp:74-147) for distance, select_k,
+fused L2 NN, k-means, linalg and random prims.
+
+TPU-native design: wall-clock around ``block_until_ready`` after a compile
+warm-up (the XLA analog of CUDA-event timing), one jitted callable per
+case. Run as ``python -m raft_tpu.bench.prims [case ...]``; emits one JSON
+line per case: {"case", "shape", "ms", "gb_s" | "gflops"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time(fn: Callable, *args, iters: int = 10) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_pairwise(m=4096, n=4096, d=128, metric="sqeuclidean"):
+    from raft_tpu.ops.distance import pairwise_distance
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    f = jax.jit(lambda a, b: pairwise_distance(a, b, metric=metric))
+    dt = _time(f, x, y)
+    flops = 2.0 * m * n * d
+    return {"case": f"pairwise_{metric}", "shape": [m, n, d],
+            "ms": round(dt * 1e3, 3), "gflops": round(flops / dt / 1e9, 1)}
+
+
+def bench_fused_l2_nn(m=100_000, n=1024, d=128):
+    from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    dt = _time(fused_l2_nn_argmin, x, y)
+    flops = 2.0 * m * n * d
+    return {"case": "fused_l2_nn", "shape": [m, n, d],
+            "ms": round(dt * 1e3, 3), "gflops": round(flops / dt / 1e9, 1)}
+
+
+def bench_select_k(batch=1024, n=16384, k=64):
+    from raft_tpu.ops.select_k import select_k
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, n)), jnp.float32)
+    f = jax.jit(lambda a: select_k(a, k, select_min=True))
+    dt = _time(f, x)
+    gb = batch * n * 4 / 1e9
+    return {"case": "select_k", "shape": [batch, n, k],
+            "ms": round(dt * 1e3, 3), "gb_s": round(gb / dt, 1)}
+
+
+def bench_kmeans_iter(m=100_000, d=96, c=1024):
+    from raft_tpu.cluster.kmeans import _assign
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
+    cen = jnp.asarray(rng.standard_normal((c, d)), jnp.float32)
+    xn = jnp.sum(x * x, -1)
+    f = jax.jit(lambda a, an, b: _assign(a, an, b, 65536))
+    dt = _time(f, x, xn, cen)
+    flops = 2.0 * m * c * d
+    return {"case": "kmeans_assign", "shape": [m, d, c],
+            "ms": round(dt * 1e3, 3), "gflops": round(flops / dt / 1e9, 1)}
+
+
+def bench_rng(n=10_000_000):
+    from raft_tpu.ops import rng as rrng
+
+    st = rrng.RngState(0)
+    f = jax.jit(lambda k: jax.random.normal(k, (n,), jnp.float32))
+    key = jax.random.key(0)
+    dt = _time(f, key)
+    return {"case": "rng_normal", "shape": [n],
+            "ms": round(dt * 1e3, 3), "gb_s": round(n * 4 / dt / 1e9, 1)}
+
+
+CASES: Dict[str, Callable] = {
+    "pairwise": bench_pairwise,
+    "fused_l2_nn": bench_fused_l2_nn,
+    "select_k": bench_select_k,
+    "kmeans_assign": bench_kmeans_iter,
+    "rng": bench_rng,
+}
+
+
+def main(argv=None) -> int:
+    import sys
+
+    names = (argv or sys.argv[1:]) or list(CASES)
+    for name in names:
+        print(json.dumps(CASES[name]()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,9 +4,9 @@ ops (filter/reduce/slice/sort), sparse pairwise distances + kNN,
 cross-component NN, Lanczos solver, Borůvka MST, spectral partitioning."""
 
 from raft_tpu.sparse import (convert, distance, linalg, mst, neighbors, op,
-                             solver, spectral, types)
+                             selection, solver, spectral, types)
 from raft_tpu.sparse.types import COO, CSR, coo_from_arrays, csr_from_scipy_like
 
 __all__ = ["convert", "distance", "linalg", "mst", "neighbors", "op",
-           "solver", "spectral", "types",
+           "selection", "solver", "spectral", "types",
            "COO", "CSR", "coo_from_arrays", "csr_from_scipy_like"]

@@ -1,0 +1,40 @@
+"""Sparse selection — per-row top-k over CSR score matrices.
+
+Reference: ``raft::sparse::selection`` (sparse/selection/select_k.cuh) —
+select_k over the CSR output of sparse pairwise distances.
+
+TPU-native design: densify rows tile-by-tile (absent entries fill with the
+metric's worst value) and run the dense ``select_k``; TPU top-k wants dense
+lanes anyway, and sparse score rows are short."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.ops.select_k import select_k as dense_select_k
+from raft_tpu.sparse.types import CSR
+
+
+def select_k(csr: CSR, k: int, select_min: bool = True
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k values + column ids per CSR row (missing entries rank last).
+
+    Returns (values [n_rows, k], indices [n_rows, k]); rows with fewer than
+    k stored entries pad with (+inf/-inf, -1).
+    """
+    n_rows, n_cols = csr.shape
+    fill = jnp.inf if select_min else -jnp.inf
+    dense = jnp.full((n_rows, n_cols), fill, csr.data.dtype)
+    rows = csr.row_ids()
+    dense = dense.at[rows, csr.indices].set(csr.data)
+    kk = min(k, n_cols)
+    v, i = dense_select_k(dense, kk, select_min=select_min)
+    ok = jnp.isfinite(v)
+    i = jnp.where(ok, i, -1)
+    if kk < k:
+        v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=fill)
+        i = jnp.pad(i, ((0, 0), (0, k - kk)), constant_values=-1)
+    return v, i

@@ -3,5 +3,6 @@ helpers. Most of the reference's device utilities (warp primitives, vectorized
 loads, atomics) disappear into XLA; what remains is shape/layout math."""
 
 from raft_tpu.utils.shape import round_up_to, pad_rows, cdiv
+from raft_tpu.utils.compile_cache import enable_persistent_cache
 
-__all__ = ["round_up_to", "pad_rows", "cdiv"]
+__all__ = ["round_up_to", "pad_rows", "cdiv", "enable_persistent_cache"]

@@ -1,0 +1,32 @@
+"""Persistent compilation cache.
+
+Reference analog: the ``-ext``/``-inl`` explicit-instantiation split +
+``libraft`` precompiled library (SURVEY.md §1, util/raft_explicit.hpp) —
+RAFT pre-builds its expensive templates once so users don't pay nvcc time
+per TU. The XLA analog is the persistent compilation cache: traced programs
+compile once per (shape, dtype, flags) and later processes load the cached
+executable instead of re-running XLA.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            min_compile_time_secs: float = 1.0) -> str:
+    """Turn on XLA's on-disk compilation cache (idempotent). Returns the
+    cache directory. Call once at program start; all subsequent jit
+    compilations (ivf/cagra search kernels, pairwise engines, …) persist
+    across processes — the runtime analog of shipping ``libraft``."""
+    cache_dir = cache_dir or os.environ.get(
+        "RAFT_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu_xla"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_secs))
+    return cache_dir
