@@ -367,3 +367,48 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
     finally:
         if close:
             stream.close()
+
+
+# ------------------------------------------------------------------ helpers
+
+
+class helpers:
+    """List-data access utilities (reference: ivf_flat_helpers.cuh /
+    ivf_flat_codepacker.hpp — ``helpers::codepacker::{pack,unpack}``).
+    Our list storage is already a padded dense block, so pack/unpack are
+    plain placements rather than interleaved-group bit shuffles."""
+
+    @staticmethod
+    def unpack_list_data(index: "Index", label: int) -> np.ndarray:
+        """Valid vectors of list ``label`` → [size, dim] host array."""
+        size = int(np.asarray(index.list_sizes)[label])
+        return np.asarray(index.list_data)[label, :size]
+
+    @staticmethod
+    def unpack_list_ids(index: "Index", label: int) -> np.ndarray:
+        size = int(np.asarray(index.list_sizes)[label])
+        return np.asarray(index.list_indices)[label, :size]
+
+    @staticmethod
+    def pack_list_data(index: "Index", label: int, vectors,
+                       ids=None) -> "Index":
+        """Overwrite list ``label`` with ``vectors`` (and optional ids);
+        returns a new Index (functional analog of in-place pack)."""
+        vectors = np.asarray(vectors, np.asarray(index.list_data).dtype)
+        n_new = len(vectors)
+        pad = index.list_data.shape[1]
+        if n_new > pad:
+            raise ValueError(f"{n_new} vectors exceed list capacity {pad}")
+        data = np.asarray(index.list_data).copy()
+        idxs = np.asarray(index.list_indices).copy()
+        sizes = np.asarray(index.list_sizes).copy()
+        data[label, :n_new] = vectors
+        data[label, n_new:] = 0
+        if ids is not None:
+            idxs[label, :n_new] = np.asarray(ids, np.int32)
+        idxs[label, n_new:] = -1
+        old = int(sizes[label])
+        sizes[label] = n_new
+        n_rows = index.n_rows - old + n_new
+        return Index(index.params, index.centers, jnp.asarray(data),
+                     jnp.asarray(idxs), jnp.asarray(sizes), n_rows)

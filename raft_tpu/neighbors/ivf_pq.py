@@ -899,3 +899,64 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
     finally:
         if close:
             stream.close()
+
+
+# ------------------------------------------------------------------ helpers
+
+
+class helpers:
+    """Code access utilities (reference: ivf_pq_helpers.cuh —
+    ``helpers::codepacker::{pack,unpack}``, ``reconstruct_list_data``)."""
+
+    @staticmethod
+    def unpack_list_codes(index: "Index", label: int) -> np.ndarray:
+        """Unpacked per-vector PQ codes of list ``label`` → [size, pq_dim]
+        uint8 host array."""
+        size = int(np.asarray(index.list_sizes)[label])
+        packed = jnp.asarray(np.asarray(index.list_codes)[label, :size])
+        return np.asarray(_unpack_codes(packed, index.pq_dim,
+                                        index.pq_bits)).astype(np.uint8)
+
+    @staticmethod
+    def pack_list_codes(index: "Index", label: int, codes,
+                        ids=None) -> "Index":
+        """Overwrite list ``label`` with unpacked ``codes`` [n, pq_dim];
+        returns a new Index."""
+        codes = np.asarray(codes, np.uint8)
+        packed = _pack_codes_np(codes, index.pq_bits)
+        pad = index.list_codes.shape[1]
+        if len(packed) > pad:
+            raise ValueError(f"{len(packed)} codes exceed list capacity {pad}")
+        data = np.asarray(index.list_codes).copy()
+        idxs = np.asarray(index.list_indices).copy()
+        sizes = np.asarray(index.list_sizes).copy()
+        data[label, :len(packed)] = packed
+        data[label, len(packed):] = 0
+        if ids is not None:
+            idxs[label, :len(packed)] = np.asarray(ids, np.int32)
+        idxs[label, len(packed):] = -1
+        old = int(sizes[label])
+        sizes[label] = len(packed)
+        out = Index(index.params, index.pq_dim, index.centers, index.rotation,
+                    index.codebooks, jnp.asarray(data), jnp.asarray(idxs),
+                    jnp.asarray(sizes), index.n_rows - old + len(packed))
+        return out
+
+    @staticmethod
+    def reconstruct_list_data(index: "Index", label: int) -> np.ndarray:
+        """Approximate original vectors of list ``label``
+        (reference: helpers::reconstruct_list_data): center + rotationᵀ ·
+        decoded residual."""
+        codes = helpers.unpack_list_codes(index, label)  # [size, pq_dim]
+        book = index.pq_book_size
+        cbs = np.asarray(index.codebooks)
+        if index.params.codebook_kind == CodebookGen.PER_CLUSTER:
+            dec = cbs[label][codes.reshape(-1)]  # [size*s, l]
+        else:
+            flat = cbs.reshape(index.pq_dim * book, index.pq_len)
+            offs = codes + np.arange(index.pq_dim)[None, :] * book
+            dec = flat[offs.reshape(-1)]
+        dec = dec.reshape(len(codes), index.rot_dim)
+        center = np.asarray(index.centers)[label]
+        rot = np.asarray(index.rotation)  # [rot_dim, dim]
+        return center[None, :] + dec @ rot

@@ -122,3 +122,17 @@ def test_small_workspace_tiles(data, gt):
     d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=32),
                            res=small)
     assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.999
+
+
+def test_helpers_pack_unpack(data):
+    db, _ = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    vecs = ivf_flat.helpers.unpack_list_data(index, 2)
+    ids = ivf_flat.helpers.unpack_list_ids(index, 2)
+    assert len(vecs) == len(ids) == int(np.asarray(index.list_sizes)[2])
+    np.testing.assert_allclose(vecs, db[ids], rtol=1e-6)
+    # overwrite list 2 with its first 3 vectors
+    idx2 = ivf_flat.helpers.pack_list_data(index, 2, vecs[:3], ids[:3])
+    assert int(np.asarray(idx2.list_sizes)[2]) == 3
+    np.testing.assert_allclose(ivf_flat.helpers.unpack_list_data(idx2, 2),
+                               vecs[:3], rtol=1e-6)
