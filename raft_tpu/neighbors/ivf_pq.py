@@ -564,7 +564,9 @@ def extend(index: Index, new_vectors, new_indices=None,
 def _search_cache_core(queries, centers, rotation, list_decoded,
                        decoded_norms, list_indices, list_sizes, filter_words,
                        metric: DistanceType, k: int, n_probes: int,
-                       q_tile: int, has_filter: bool):
+                       q_tile: int, has_filter: bool,
+                       use_pallas: bool = False,
+                       pallas_interpret: bool = False):
     """ADC scan over the decoded-residual cache: identical distances to the
     LUT formulation (||q_res − dec||² expands to ||q_res||² − 2 q_res·dec +
     ||dec||²), evaluated as one batched matvec per probe on the MXU."""
@@ -601,11 +603,32 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
             _, probes = select_k(cn[None, :] - 2.0 * dots_c, n_probes,
                                  select_min=True)
 
-        g_dec = list_decoded[probes]  # [t, P, pad, rot] bf16
-        g_n = decoded_norms[probes]  # [t, P, pad]
         g_idx = list_indices[probes]
         g_valid = valid_slot[probes]
-        if metric == DistanceType.InnerProduct:
+        if use_pallas:
+            # fused probe-gather + scan kernel: each probed list slab is
+            # DMA'd straight into VMEM (scalar-prefetch block index); the
+            # [t, P, pad, rot] gather intermediate never exists in HBM
+            from raft_tpu.ops import pallas_kernels as pk
+
+            if metric == DistanceType.InnerProduct:
+                qv = jnp.broadcast_to(
+                    q_rot[:, None, :],
+                    (qt.shape[0], n_probes, q_rot.shape[1]))
+                part = pk.ivf_scan(probes, qv, list_decoded, decoded_norms,
+                                   interpret=pallas_interpret)
+                g_n = decoded_norms[probes]
+                base = jnp.take_along_axis(dots_c, probes, axis=1)
+                d = base[:, :, None] + 0.5 * (g_n - part)
+            else:
+                qr_res = q_rot[:, None, :] - centers_rot[probes]
+                part = pk.ivf_scan(probes, qr_res, list_decoded,
+                                   decoded_norms,
+                                   interpret=pallas_interpret)
+                qn = jnp.sum(qr_res * qr_res, -1)
+                d = qn[:, :, None] + part
+        elif metric == DistanceType.InnerProduct:
+            g_dec = list_decoded[probes]  # [t, P, pad, rot] bf16
             # score = q·center + q_rot·dec
             dots = jnp.einsum("td,tpld->tpl", q_rot,
                               g_dec.astype(jnp.float32),
@@ -613,6 +636,8 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
             base = jnp.take_along_axis(dots_c, probes, axis=1)
             d = base[:, :, None] + dots
         else:
+            g_dec = list_decoded[probes]  # [t, P, pad, rot] bf16
+            g_n = decoded_norms[probes]  # [t, P, pad]
             qr_res = q_rot[:, None, :] - centers_rot[probes]  # [t, P, rot]
             dots = jnp.einsum("tpd,tpld->tpl", qr_res,
                               g_dec.astype(jnp.float32),
@@ -655,7 +680,8 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
 
 _search_cache_jit = jax.jit(
     _search_cache_core,
-    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter"),
+    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter",
+                     "use_pallas", "pallas_interpret"),
 )
 
 
@@ -814,12 +840,15 @@ def search(
                              1, 1024))
         if q_tile >= 8:
             q_tile -= q_tile % 8
+        from raft_tpu.ops import pallas_kernels as pk
+
         return _search_cache_jit(
             queries, index.centers, index.rotation, index.list_decoded,
             index.decoded_norms, index.list_indices, index.list_sizes,
             filter.words if filter is not None else jnp.zeros((0,),
                                                               jnp.uint32),
             index.metric, int(k), n_probes, q_tile, filter is not None,
+            pk.pallas_enabled(), False,
         )
     # workspace: LUT [t,P,s,book] fp32 + gathered codes [t,P,pad,bytes]
     per_q = n_probes * (index.pq_dim * index.pq_book_size * 4

@@ -128,3 +128,66 @@ def fused_l2_argmin(x, y, x_norms=None, y_norms=None, tm: int = 256,
     tn = max(128, tn - tn % 128)
     return _fused_l2_argmin_pallas(x, y, x_norms, y_norms, tm, tn,
                                    bool(interpret))
+
+
+# --------------------------------------------------------------- ivf scan
+
+
+def _ivf_scan_kernel(probes_ref, qvec_ref, dec_ref, norms_ref, out_ref):
+    """One (query, probe) step: out[pad] = norms[pad] − 2·dec[pad,rot]·q[rot].
+
+    ``dec_ref``/``norms_ref`` blocks are DMA'd from the probed list's slab —
+    the block index comes from the prefetched ``probes`` scalars, so the
+    gather never materializes in HBM (the fusion the reference gets from its
+    interleaved_scan kernel)."""
+    dots = jax.lax.dot_general(
+        dec_ref[0].astype(jnp.float32),  # bf16 in HBM; f32 math in VMEM
+        qvec_ref[0, 0].reshape(-1, 1).astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [pad, 1]
+    out_ref[0, 0, :] = norms_ref[0] - 2.0 * dots[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ivf_scan_pallas(probes, qres, list_decoded, decoded_norms,
+                     interpret: bool):
+    nq, n_probes = probes.shape
+    n_lists, list_pad, rot = list_decoded.shape
+    qres_c = qres.astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_probes),
+        in_specs=[
+            pl.BlockSpec((1, 1, rot), lambda i, j, probes: (i, j, 0)),
+            pl.BlockSpec((1, list_pad, rot),
+                         lambda i, j, probes: (probes[i, j], 0, 0)),
+            pl.BlockSpec((1, list_pad),
+                         lambda i, j, probes: (probes[i, j], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, list_pad),
+                               lambda i, j, probes: (i, j, 0)),
+    )
+    return pl.pallas_call(
+        _ivf_scan_kernel,
+        out_shape=jax.ShapeDtypeStruct((nq, n_probes, list_pad), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(probes.astype(jnp.int32), qres_c, list_decoded, decoded_norms)
+
+
+def ivf_scan(probes, qres, list_decoded, decoded_norms,
+             interpret: bool = False):
+    """Fused probe-gather + ADC/flat scan.
+
+    probes [nq, P] int32, qres [nq, P, rot] (per-probe query residual, or
+    the query itself replicated for flat scans), list_decoded
+    [L, pad, rot], decoded_norms [L, pad] → partial distances
+    [nq, P, pad] = ||list row||² − 2·q·row (caller adds ||q_res||² and
+    masks invalid slots). The scan reads each probed list slab exactly once
+    over ICI-free HBM DMA — no [nq, P, pad, rot] gather intermediate.
+    """
+    return _ivf_scan_pallas(probes, qres, list_decoded, decoded_norms,
+                            bool(interpret))

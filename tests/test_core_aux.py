@@ -196,3 +196,19 @@ def test_spatial_namespace(rng):
     pts = np.radians([[51.5, -0.13], [48.86, 2.35]]).astype(np.float32)
     h = np.asarray(spatial.haversine_distance(pts, pts))
     assert h.shape == (2, 2) and h[0, 1] > 0
+
+
+def test_pallas_ivf_scan_interpret(rng):
+    from raft_tpu.ops import pallas_kernels as pk
+
+    L, pad, rot, nq, P = 6, 16, 8, 5, 3
+    dec = rng.standard_normal((L, pad, rot)).astype(np.float32)
+    norms = (dec ** 2).sum(-1).astype(np.float32)
+    probes = rng.integers(0, L, (nq, P)).astype(np.int32)
+    qres = rng.standard_normal((nq, P, rot)).astype(np.float32)
+    out = np.asarray(pk.ivf_scan(probes, qres, dec, norms, interpret=True))
+    ref = np.stack([
+        np.stack([norms[probes[i, j]]
+                  - 2.0 * dec[probes[i, j]] @ qres[i, j]
+                  for j in range(P)]) for i in range(nq)])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
