@@ -79,6 +79,15 @@ class Index:
         self.list_indices = list_indices  # [n_lists, list_pad] int32, -1 pad
         self.list_sizes = list_sizes  # [n_lists] int32
         self.n_rows = int(n_rows)
+        # lazy per-row squared norms for the Pallas fused scan (the
+        # reference's center_norms analog at list granularity)
+        self._row_norms = None
+
+    def ensure_row_norms(self):
+        if self._row_norms is None:
+            self._row_norms = jnp.sum(
+                self.list_data.astype(jnp.float32) ** 2, -1)
+        return self._row_norms
 
     @property
     def metric(self) -> DistanceType:
@@ -205,9 +214,15 @@ def _coarse_scores(queries, centers, metric: DistanceType):
 
 def _search_core(queries, centers, list_data, list_indices, list_sizes,
                  filter_words, metric: DistanceType, k: int, n_probes: int,
-                 q_tile: int, has_filter: bool):
+                 q_tile: int, has_filter: bool, row_norms=None,
+                 use_pallas: bool = False, pallas_interpret: bool = False):
     """Traceable search body — jitted below; also shard_mapped by
-    raft_tpu.parallel.sharded for multi-device list-sharded search."""
+    raft_tpu.parallel.sharded for multi-device list-sharded search.
+
+    ``use_pallas`` routes the probe scan through the fused scalar-prefetch
+    kernel (ops.pallas_kernels.ivf_scan): probed list slabs are DMA'd
+    straight to VMEM instead of materializing the [t, P, pad, dim] gather
+    in HBM; requires ``row_norms`` [L, pad]."""
     nq, dim = queries.shape
     n_lists, list_pad, _ = list_data.shape
     minimize = metric != DistanceType.InnerProduct
@@ -223,31 +238,52 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
         scores, coarse_min = _coarse_scores(qt, centers, metric)
         _, probes = select_k(scores, n_probes, select_min=coarse_min)  # [t, P]
 
-        # ---- gather probed lists and scan
-        g_data = list_data[probes]  # [t, P, pad, dim]
         g_idx = list_indices[probes]  # [t, P, pad]
         g_valid = valid_slot[probes]  # [t, P, pad]
         qf = qt.astype(jnp.float32)
-        gf = g_data.astype(jnp.float32)
-        dots = jnp.einsum(
-            "td,tpld->tpl", qf, gf,
-            precision=(jax.lax.Precision.HIGHEST
-                       if g_data.dtype == jnp.float32 else None),
-            preferred_element_type=jnp.float32,
-        )
-        if metric == DistanceType.InnerProduct:
-            d = dots
-        elif metric == DistanceType.CosineExpanded:
-            vn = jnp.sqrt(jnp.maximum(jnp.sum(gf * gf, -1), 1e-20))
-            qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
-            d = 1.0 - dots / (vn * qn[:, None, None])
+        if use_pallas:
+            from raft_tpu.ops import pallas_kernels as pk
+
+            qv = jnp.broadcast_to(qf[:, None, :],
+                                  (qt.shape[0], n_probes, dim))
+            part = pk.ivf_scan(probes, qv, list_data, row_norms,
+                               interpret=pallas_interpret)  # ||v||²−2q·v
+            vn2 = row_norms[probes]
+            dots = 0.5 * (vn2 - part)
+            if metric == DistanceType.InnerProduct:
+                d = dots
+            elif metric == DistanceType.CosineExpanded:
+                vn = jnp.sqrt(jnp.maximum(vn2, 1e-20))
+                qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
+                d = 1.0 - dots / (vn * qn[:, None, None])
+            else:
+                qn2 = row_norms_sq(qf)
+                d = jnp.maximum(qn2[:, None, None] + part, 0.0)
+                if metric == DistanceType.L2SqrtExpanded:
+                    d = jnp.sqrt(d)
         else:
-            vn2 = jnp.sum(gf * gf, -1)
-            qn2 = row_norms_sq(qf)
-            d = qn2[:, None, None] + vn2 - 2.0 * dots
-            d = jnp.maximum(d, 0.0)
-            if metric == DistanceType.L2SqrtExpanded:
-                d = jnp.sqrt(d)
+            # ---- gather probed lists and scan
+            g_data = list_data[probes]  # [t, P, pad, dim]
+            gf = g_data.astype(jnp.float32)
+            dots = jnp.einsum(
+                "td,tpld->tpl", qf, gf,
+                precision=(jax.lax.Precision.HIGHEST
+                           if g_data.dtype == jnp.float32 else None),
+                preferred_element_type=jnp.float32,
+            )
+            if metric == DistanceType.InnerProduct:
+                d = dots
+            elif metric == DistanceType.CosineExpanded:
+                vn = jnp.sqrt(jnp.maximum(jnp.sum(gf * gf, -1), 1e-20))
+                qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
+                d = 1.0 - dots / (vn * qn[:, None, None])
+            else:
+                vn2 = jnp.sum(gf * gf, -1)
+                qn2 = row_norms_sq(qf)
+                d = qn2[:, None, None] + vn2 - 2.0 * dots
+                d = jnp.maximum(d, 0.0)
+                if metric == DistanceType.L2SqrtExpanded:
+                    d = jnp.sqrt(d)
         bad_fill = jnp.inf if minimize else -jnp.inf
         ok = g_valid
         if has_filter:
@@ -283,7 +319,8 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
 
 _search_jit = jax.jit(
     _search_core,
-    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter"),
+    static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter",
+                     "use_pallas", "pallas_interpret"),
 )
 
 
@@ -314,11 +351,15 @@ def search(
     q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 1024))
     if q_tile >= 8:
         q_tile -= q_tile % 8
+    from raft_tpu.ops import pallas_kernels as pk
+
+    use_pallas = pk.pallas_enabled()
     return _search_jit(
         queries, index.centers, index.list_data, index.list_indices,
         index.list_sizes,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, int(k), n_probes, q_tile, filter is not None,
+        index.ensure_row_norms() if use_pallas else None, use_pallas, False,
     )
 
 
