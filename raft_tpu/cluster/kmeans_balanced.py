@@ -271,6 +271,23 @@ def build_clusters(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("fine_max", "n_iters", "metric"))
+def _fine_stage_jit(keys, x, member_idx, weights, n_actives, fine_max: int,
+                    n_iters: int, metric: DistanceType):
+    """Batched fine-stage builds: lax.map of the single-level balanced
+    build over mesocluster member lists (gathered device-side)."""
+
+    def body(args):
+        key, idx, w, n_active = args
+        sub = x[idx]  # [meso_max, dim] gather
+        centers, _, _ = _build_clusters_jit(
+            key, sub, w, n_active, fine_max, n_iters, metric,
+            True, True)
+        return centers
+
+    return jax.lax.map(body, (keys, member_idx, weights, n_actives))
+
+
 def _arrange_fine_clusters(n_clusters: int, n_meso: int, n_rows: int,
                            meso_sizes: np.ndarray) -> np.ndarray:
     """Fine-cluster count per mesocluster, proportional to its size
@@ -337,25 +354,26 @@ def fit(
     meso_max = int(min(meso_sizes.max(), max(cdiv(2 * n_rows, max(n_meso, 1)), 1)))
     fine_max = int(fine_nums.max())
 
-    # --- fine stage: one padded, weighted, active-masked build per mesocluster
-    x_np = np.asarray(x)
-    centers_out = np.zeros((n_clusters, dim), np.float32)
+    # --- fine stage: all mesoclusters in ONE device program (lax.map over
+    # padded member-index rows) — the per-meso builds are identical padded
+    # shapes, so batching them removes n_meso host↔device round-trips
+    member_idx = np.zeros((n_meso, meso_max), np.int32)
+    wts = np.zeros((n_meso, meso_max), np.float32)
+    for i in range(n_meso):
+        members = np.nonzero(meso_labels_np == i)[0][:meso_max]
+        member_idx[i, : len(members)] = members
+        wts[i, : len(members)] = 1.0
     fine_keys = jax.random.split(k_fine, n_meso)
+    c_all = _fine_stage_jit(
+        fine_keys, x.astype(jnp.float32), jnp.asarray(member_idx),
+        jnp.asarray(wts), jnp.asarray(fine_nums.astype(np.int32)),
+        fine_max, params.n_iters, params.metric,
+    )  # [n_meso, fine_max, dim]
+    c_all = np.asarray(c_all)
+    centers_out = np.zeros((n_clusters, dim), np.float32)
     done = 0
     for i in range(n_meso):
-        if fine_nums[i] == 0:
-            continue
-        members = np.nonzero(meso_labels_np == i)[0][:meso_max]
-        sub = np.zeros((meso_max, dim), x_np.dtype)
-        sub[: len(members)] = x_np[members]
-        wts = np.zeros((meso_max,), np.float32)
-        wts[: len(members)] = 1.0
-        # padded shapes + n_active are static/traced → one compile for all
-        c_pad, _, _ = build_clusters(
-            fine_keys[i], jnp.asarray(sub), fine_max, params,
-            weights=jnp.asarray(wts), n_active=jnp.int32(fine_nums[i]), res=res,
-        )
-        centers_out[done : done + fine_nums[i]] = np.asarray(c_pad)[: fine_nums[i]]
+        centers_out[done : done + fine_nums[i]] = c_all[i, : fine_nums[i]]
         done += int(fine_nums[i])
 
     # --- final fine-tuning over all clusters (reference: max(n_iters/10, 2)
