@@ -120,6 +120,55 @@ class Comms:
         return jax.tree.map(lambda f: jnp.where(is_root, f, jnp.zeros_like(f)),
                             g)
 
+    def allgatherv(self, x, counts: Sequence[int], axis: int = 0):
+        """ncclAllGatherv-equivalent (core/comms.hpp allgatherv): shards
+        contribute ``counts[rank]`` valid rows each (the rest of the static
+        shard is padding). Returns the concatenation of every rank's valid
+        rows, padded to sum(counts) with trailing zeros removed by the
+        caller if needed. ``counts`` must be host-known (static shapes)."""
+        counts = [int(c) for c in counts]
+        cap = x.shape[axis]
+        if max(counts) > cap:
+            raise ValueError(f"counts {counts} exceed shard capacity {cap}")
+        g = jax.lax.all_gather(x, self.axis)  # [size, ...]
+        parts = [jax.lax.index_in_dim(g, r, axis=0, keepdims=False)
+                 for r in range(self.size)]
+        parts = [jax.lax.slice_in_dim(p, 0, counts[r], axis=axis)
+                 for r, p in enumerate(parts)]
+        return jnp.concatenate(parts, axis=axis)
+
+    def gatherv(self, x, counts: Sequence[int], root: int = 0,
+                axis: int = 0):
+        """ncclGatherv analog: allgatherv, non-root ranks zeroed (the typed
+        comms_t contract defines only the root's value)."""
+        full = self.allgatherv(x, counts, axis=axis)
+        is_root = jax.lax.axis_index(self.axis) == root
+        return jax.tree.map(
+            lambda f: jnp.where(is_root, f, jnp.zeros_like(f)), full)
+
+    def device_send_recv(self, x, dest_of_rank: Sequence[int]):
+        """device_sendrecv analog (core/comms.hpp device p2p): rank r's value
+        is delivered to ``dest_of_rank[r]``; every rank receives from the
+        rank that names it. The table must be a permutation (XLA ppermute
+        contract — matching pairwise send/recv like the reference's
+        group_start/end blocks)."""
+        dests = [int(d) for d in dest_of_rank]
+        if sorted(dests) != list(range(self.size)):
+            raise ValueError(f"dest table {dests} is not a permutation")
+        return jax.lax.ppermute(x, self.axis,
+                                perm=[(r, d) for r, d in enumerate(dests)])
+
+    def device_multicast_sendrecv(self, x, root: int, dests: Sequence[int]):
+        """device_multicast_sendrecv analog: ``root``'s value is delivered to
+        every rank in ``dests``; other ranks keep their own value (multicast
+        over ICI is an allgather+select the compiler prunes)."""
+        g = jax.lax.all_gather(x, self.axis)  # [size, ...]
+        me = jax.lax.axis_index(self.axis)
+        in_dests = jnp.zeros((self.size,), bool
+                             ).at[jnp.asarray(list(dests))].set(True)[me]
+        return jax.tree.map(
+            lambda gg: jnp.where(in_dests, gg[root], gg[me]), g)
+
     def ppermute(self, x, perm: Sequence[tuple[int, int]]):
         """device_sendrecv analog (core/comms.hpp device p2p): point-to-point
         pairs (src, dst) as one fused ICI permute."""

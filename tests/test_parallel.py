@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.parallel import comms as comms_mod
@@ -125,3 +126,50 @@ def test_sharded_ivf_pq(comms):
     recall = float(neighborhood_recall(i, np.asarray(gt)))
     # full-probe PQ scan: recall limited only by quantization
     assert recall >= 0.7, f"sharded ivf_pq recall {recall}"
+
+
+def test_allgatherv_gatherv(comms):
+    counts = [(r % 3) + 1 for r in range(comms.size)]
+    cap = max(counts)
+    x = np.zeros((comms.size, cap, 2), np.float32)
+    for r in range(comms.size):
+        x[r, :counts[r]] = r + 1
+    xs = comms.shard(jnp.asarray(x), P(comms.axis))
+
+    def body(v):
+        return comms.allgatherv(v[0], counts)
+
+    out = np.asarray(jax.jit(comms.run(body, P(comms.axis), P()))(xs))
+    want = np.concatenate([np.full((counts[r], 2), r + 1, np.float32)
+                           for r in range(comms.size)])
+    np.testing.assert_allclose(out, want)
+
+
+def test_device_send_recv_and_multicast(comms):
+    n = comms.size
+    x = jnp.arange(n, dtype=jnp.float32)[:, None]
+    xs = comms.shard(x, P(comms.axis))
+
+    # reversal permutation
+    table = list(reversed(range(n)))
+
+    def body(v):
+        return comms.device_send_recv(v, table)
+
+    out = np.asarray(jax.jit(comms.run(body, P(comms.axis),
+                                       P(comms.axis)))(xs))
+    want = np.zeros(n)
+    for r, d in enumerate(table):
+        want[d] = r
+    np.testing.assert_allclose(out.ravel(), want)
+
+    # multicast root 0 → ranks {1, 2}
+    def body2(v):
+        return comms.device_multicast_sendrecv(v[0], 0, [1, 2])
+
+    out2 = np.asarray(jax.jit(comms.run(body2, P(comms.axis),
+                                        P(comms.axis)))(xs))
+    want2 = np.arange(n, dtype=np.float32)
+    want2[1] = 0
+    want2[2] = 0
+    np.testing.assert_allclose(out2.ravel(), want2)
