@@ -3,10 +3,26 @@ must keep importing (the analog of the reference's test_doctests.py, which
 exercises every public module's docstring surface)."""
 
 import importlib
+import pkgutil
 
 import pytest
 
 
+def _discover_modules():
+    """All importable raft_tpu modules, found on disk (no drift as modules
+    are added)."""
+    import raft_tpu
+
+    names = ["raft_tpu"]
+    for info in pkgutil.walk_packages(raft_tpu.__path__, "raft_tpu."):
+        # the ctypes-loaded C library is not a Python module
+        if "libraft_tpu_native" in info.name:
+            continue
+        names.append(info.name)
+    return sorted(names)
+
+
+# explicit floor: if discovery somehow regresses, these must still be seen
 MODULES = [
     "raft_tpu",
     "raft_tpu.core",
@@ -75,9 +91,13 @@ MODULES = [
 ]
 
 
-@pytest.mark.parametrize("mod", MODULES)
+@pytest.mark.parametrize("mod", sorted(set(MODULES) | set(_discover_modules())))
 def test_module_imports(mod):
     importlib.import_module(mod)
+
+
+def test_discovery_covers_floor():
+    assert set(MODULES) <= set(_discover_modules())
 
 
 def test_pylibraft_parity_names():
