@@ -1,53 +1,87 @@
-"""Micro-bench of the ANN indexes on the current default backend."""
-import time, sys, json
+"""ANN micro-bench on the current backend.
+
+Usage: python tools/bench_ann.py [ivf_flat|ivf_pq|cagra|bf|all] [n_rows]
+Set RAFT_TPU_PALLAS=1 to route IVF scans through the Pallas fused kernel.
+Clustered (make_blobs) data so recall reflects the IVF regime.
+"""
+import json, sys, time
 import numpy as np, jax
 
+
 def timeit(f, iters=3):
-    f()  # warmup/compile
+    r = f(); jax.block_until_ready(r)
     t0 = time.perf_counter()
     for _ in range(iters):
         r = f()
     jax.block_until_ready(r)
     return (time.perf_counter() - t0) / iters, r
 
-def main(which):
+
+def main(which="all", n=100_000):
     from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, cagra
+    from raft_tpu.ops import rng as rrng
     from raft_tpu.stats import neighborhood_recall
-    rng = np.random.default_rng(0)
-    n, dim, nq, k = 100_000, 96, 10_000, 10
-    db = rng.standard_normal((n, dim)).astype(np.float32)
-    q = rng.standard_normal((nq, dim)).astype(np.float32)
+
+    dim, nq, k = 96, 10_000, 10
+    x, _ = rrng.make_blobs(jax.random.key(0), n, dim, n_clusters=1000,
+                           cluster_std=0.3)
+    db = np.asarray(x, np.float32)
+    rng = np.random.default_rng(1)
+    q = db[rng.integers(0, n, nq)] + 0.05 * rng.standard_normal(
+        (nq, dim)).astype(np.float32)
+
     bf = brute_force.build(db, metric="sqeuclidean")
-    gt_d, gt_i = brute_force.search(bf, q, k)
+    dt, (gt_d, gt_i) = timeit(lambda: brute_force.search(bf, q, k))
     gt_i = np.asarray(gt_i)
+    if which in ("bf", "all"):
+        print(json.dumps({"algo": "brute_force", "qps": round(nq/dt, 1)}),
+              flush=True)
 
     if which in ("ivf_flat", "all"):
         t0 = time.perf_counter()
         idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
         jax.block_until_ready(idx.list_data)
         bt = time.perf_counter() - t0
-        for np_ in (32, 64, 128):
-            dt, (d, i) = timeit(lambda: ivf_flat.search(idx, q, k, ivf_flat.SearchParams(n_probes=np_)))
+        for np_ in (16, 32, 64):
+            dt, (d, i) = timeit(lambda: ivf_flat.search(
+                idx, q, k, ivf_flat.SearchParams(n_probes=np_)))
             rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "ivf_flat", "build_s": round(bt,2), "n_probes": np_, "qps": round(nq/dt,1), "recall": round(rec,4)}))
+            print(json.dumps({"algo": "ivf_flat", "build_s": round(bt, 2),
+                              "n_probes": np_, "qps": round(nq/dt, 1),
+                              "recall": round(rec, 4)}), flush=True)
+
     if which in ("ivf_pq", "all"):
         t0 = time.perf_counter()
-        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, pq_dim=48, pq_bits=8))
+        idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, pq_dim=48,
+                                                  pq_bits=8))
         jax.block_until_ready(idx.list_codes)
         bt = time.perf_counter() - t0
-        for np_ in (32, 64, 128):
-            dt, (d, i) = timeit(lambda: ivf_pq.search(idx, q, k, ivf_pq.SearchParams(n_probes=np_)))
+        ivf_pq.ensure_scan_cache(idx)
+        jax.block_until_ready(idx.list_decoded)
+        for np_ in (16, 32, 64):
+            dt, (d, i) = timeit(lambda: ivf_pq.search(
+                idx, q, k, ivf_pq.SearchParams(n_probes=np_)))
             rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "ivf_pq", "build_s": round(bt,2), "n_probes": np_, "qps": round(nq/dt,1), "recall": round(rec,4)}))
+            print(json.dumps({"algo": "ivf_pq", "build_s": round(bt, 2),
+                              "n_probes": np_, "qps": round(nq/dt, 1),
+                              "recall": round(rec, 4)}), flush=True)
+
     if which in ("cagra", "all"):
         t0 = time.perf_counter()
-        idx = cagra.build(db, cagra.IndexParams(graph_degree=32, intermediate_graph_degree=64))
+        idx = cagra.build(db, cagra.IndexParams(
+            graph_degree=32, intermediate_graph_degree=64))
         jax.block_until_ready(idx.graph)
         bt = time.perf_counter() - t0
         for itopk in (32, 64):
-            dt, (d, i) = timeit(lambda: cagra.search(idx, q, k, cagra.SearchParams(itopk_size=itopk)))
+            dt, (d, i) = timeit(lambda: cagra.search(
+                idx, q, k, cagra.SearchParams(itopk_size=itopk)))
             rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "cagra", "build_s": round(bt,2), "itopk": itopk, "qps": round(nq/dt,1), "recall": round(rec,4)}))
+            print(json.dumps({"algo": "cagra", "build_s": round(bt, 2),
+                              "itopk": itopk, "qps": round(nq/dt, 1),
+                              "recall": round(rec, 4)}), flush=True)
+
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "all")
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    main(which, n)
