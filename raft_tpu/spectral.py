@@ -1,0 +1,13 @@
+"""Top-level ``raft_tpu.spectral`` — alias of :mod:`raft_tpu.sparse.spectral`
+(reference: ``raft::spectral`` lives beside, not inside, sparse; both import
+paths work here)."""
+
+from raft_tpu.sparse.spectral import (  # noqa: F401
+    analyze_partition,
+    fit_embedding,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = ["analyze_partition", "fit_embedding", "modularity_maximization",
+           "partition"]
