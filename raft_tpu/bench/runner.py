@@ -262,6 +262,21 @@ def generate_groundtruth(dataset: np.ndarray, queries: np.ndarray, k: int,
     return np.asarray(idx)
 
 
+def split_groundtruth(gt_path: str, out_neighbors: str,
+                      out_distances: str) -> None:
+    """Split a combined groundtruth fbin (neighbors+distances interleaved as
+    produced by big-ann tooling) into the .ibin/.fbin pair the runner reads
+    (the split_groundtruth CLI, python/raft-ann-bench split_groundtruth):
+    first half of each row = neighbor ids, second half = distances."""
+    n, d = native.read_bin_header(gt_path)
+    combined = native.read_bin(gt_path, dtype=np.float32)
+    k = d // 2
+    neigh = combined[:, :k].astype(np.int32)
+    dist = combined[:, k:].astype(np.float32)
+    native.write_bin(out_neighbors, neigh)
+    native.write_bin(out_distances, dist)
+
+
 def run_benchmark(
     config: Dict[str, Any],
     k: int = 10,
