@@ -130,3 +130,12 @@ def weighted_mean(matrix, weights, along_rows: bool = True):
     if along_rows:
         return (m * w[None, :]).sum(1) / jnp.maximum(w.sum(), 1e-20)
     return (m * w[:, None]).sum(0) / jnp.maximum(w.sum(), 1e-20)
+
+
+def sample_rows(key, matrix, n_samples: int, replace: bool = False):
+    """Random row subset (reference: matrix/sample_rows.cuh sample_rows —
+    uniform row sampling via the handle's RNG)."""
+    m = jnp.asarray(matrix)
+    idx = jax.random.choice(key, m.shape[0], (int(n_samples),),
+                            replace=replace)
+    return jnp.take(m, idx, axis=0)
