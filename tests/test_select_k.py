@@ -48,3 +48,27 @@ def test_select_k_1d(rng):
 def test_k_too_large():
     with pytest.raises(ValueError):
         select_k(np.zeros((2, 4), np.float32), 5)
+
+
+def test_two_phase_wide_rows(rng):
+    """SELECT_LARGE_TEST analog: wide rows force the two-phase path under
+    AUTO and must agree with numpy."""
+    from raft_tpu.ops.select_k import SelectAlgo, select_k
+
+    x = rng.standard_normal((4, 1 << 17)).astype(np.float32)
+    for algo in (SelectAlgo.AUTO, SelectAlgo.TWO_PHASE):
+        v, i = select_k(x, 32, select_min=True, algo=algo)
+        ref = np.sort(x, axis=1)[:, :32]
+        np.testing.assert_allclose(np.sort(np.asarray(v), 1), ref, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.take_along_axis(x, np.asarray(i), 1), np.asarray(v), rtol=1e-6)
+
+
+def test_two_phase_matches_direct_largest(rng):
+    from raft_tpu.ops.select_k import SelectAlgo, select_k
+
+    x = rng.standard_normal((3, 70_000)).astype(np.float32)
+    v1, _ = select_k(x, 7, select_min=False, algo=SelectAlgo.DIRECT)
+    v2, _ = select_k(x, 7, select_min=False, algo=SelectAlgo.TWO_PHASE)
+    np.testing.assert_allclose(np.sort(np.asarray(v1), 1),
+                               np.sort(np.asarray(v2), 1), rtol=1e-6)
