@@ -149,6 +149,125 @@ def kmeans_fit(
     return centers, labels[:n]
 
 
+# ----------------------------------------------------- sharded cagra
+
+
+class ShardedCagra:
+    """A CAGRA index partitioned over a mesh axis: each device owns the
+    graph + dataset of its row shard; queries replicate; per-shard beam
+    searches merge over ICI (raft-dask-style MNMG deployment of a
+    graph index)."""
+
+    def __init__(self, comms: Comms, datasets, graphs, metric: DistanceType,
+                 n_rows: int, bounds):
+        self.comms = comms
+        self.datasets = datasets  # [S, shard_pad, dim]
+        self.graphs = graphs  # [S, shard_pad, degree] local ids
+        self.metric = metric
+        self.n_rows = n_rows
+        self.bounds = bounds  # [S + 1] row offsets per shard
+
+
+def build_cagra(
+    comms: Comms,
+    dataset,
+    params=None,
+    res: Optional[Resources] = None,
+) -> ShardedCagra:
+    """Per-shard CAGRA builds over row partitions (host-orchestrated)."""
+    from raft_tpu.neighbors import cagra
+
+    res = ensure_resources(res)
+    params = params or cagra.IndexParams()
+    dataset = np.asarray(dataset)
+    n, dim = dataset.shape
+    size = comms.size
+    bounds = np.linspace(0, n, size + 1).astype(np.int64)
+    subs = []
+    for r in range(size):
+        lo, hi = bounds[r], bounds[r + 1]
+        idx = cagra.build(dataset[lo:hi], params, res=res)
+        subs.append((np.asarray(idx.dataset), np.asarray(idx.graph)))
+    pad = max(s[0].shape[0] for s in subs)
+    degree = subs[0][1].shape[1]
+    ds = np.zeros((size, pad, dim), np.float32)
+    gr = np.zeros((size, pad, degree), np.int32)
+    for r, (d_, g_) in enumerate(subs):
+        ds[r, : len(d_)] = d_
+        gr[r, : len(g_)] = g_
+        # padding rows point at node 0 and are never seeded (their
+        # distances are real but they are unreachable unless linked)
+    ax = comms.axis
+    return ShardedCagra(
+        comms,
+        comms.shard(jnp.asarray(ds), P(ax, None, None)),
+        comms.shard(jnp.asarray(gr), P(ax, None, None)),
+        params.metric, n, bounds)
+
+
+def search_cagra(
+    index: ShardedCagra,
+    queries,
+    k: int,
+    params=None,
+    res: Optional[Resources] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SPMD CAGRA search: per-device beam search over its shard's graph,
+    local ids mapped to global row ids, then one all_gather + top-k merge
+    over ICI."""
+    from raft_tpu.neighbors import cagra
+
+    ensure_resources(res)
+    params = params or cagra.SearchParams()
+    comms = index.comms
+    queries = jnp.asarray(queries)
+    nq = queries.shape[0]
+    minimize = index.metric != DistanceType.InnerProduct
+    size = comms.size
+    shard_rows = jnp.asarray(
+        np.diff(index.bounds).astype(np.int32))  # valid rows per shard
+    base = jnp.asarray(index.bounds[:-1].astype(np.int32))
+    itopk = max(int(params.itopk_size), k)
+    width = max(int(params.search_width), 1)
+    max_iter = int(params.max_iterations)
+    if max_iter <= 0:
+        max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    degree = index.graphs.shape[2]
+    n_seeds = min(max(itopk, int(params.num_random_samplings) * 16),
+                  index.datasets.shape[1], itopk + width * degree)
+    key = jax.random.fold_in(
+        jax.random.key(params.rand_xor_mask & 0x7FFFFFFF), nq)
+    empty = jnp.zeros((0,), jnp.uint32)
+
+    def local(q_rep, ds, gr, n_valid, b):
+        # per-shard seeds within the shard's valid rows
+        rank = comms.rank()
+        seeds = jax.random.randint(
+            jax.random.fold_in(key, rank), (q_rep.shape[0], n_seeds), 0,
+            jnp.maximum(n_valid[0], 1), jnp.int32)
+        v, i = cagra._search_jit(
+            q_rep, ds[0], gr[0], seeds, empty, index.metric, int(k),
+            itopk, width, max_iter, False)
+        # local → global ids; mask out padding rows
+        pad_hit = (i < 0) | (i >= n_valid[0])
+        gid = jnp.where(pad_hit, -1, i + b[0])
+        v = jnp.where(pad_hit, jnp.inf if minimize else -jnp.inf, v)
+        v_all = comms.allgather(v, axis=1)
+        g_all = comms.allgather(gid, axis=1)
+        vm, sel = select_k(v_all, int(k), select_min=minimize)
+        return vm, jnp.take_along_axis(g_all, sel, axis=1)
+
+    ax = comms.axis
+    fn = comms.run(
+        local,
+        (P(None, None), P(ax, None, None), P(ax, None, None), P(ax), P(ax)),
+        (P(None, None), P(None, None)))
+    q = comms.shard(queries, P(None, None))
+    return jax.jit(fn)(q, index.datasets, index.graphs,
+                       comms.shard(shard_rows, P(ax)),
+                       comms.shard(base, P(ax)))
+
+
 # --------------------------------------------------- sharded ivf_flat search
 
 

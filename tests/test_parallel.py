@@ -173,3 +173,24 @@ def test_device_send_recv_and_multicast(comms):
     want2[1] = 0
     want2[2] = 0
     np.testing.assert_allclose(out2.ravel(), want2)
+
+
+def test_sharded_cagra(comms):
+    from raft_tpu.neighbors import cagra
+
+    rng = np.random.default_rng(5)
+    # clustered so the graph walk converges quickly
+    centers = rng.standard_normal((20, 16)) * 6.0
+    db = (centers[rng.integers(0, 20, 2000)]
+          + rng.standard_normal((2000, 16))).astype(np.float32)
+    q = db[:40] + 0.01 * rng.standard_normal((40, 16)).astype(np.float32)
+    _, gt = brute_force.knn(q, db, k=5, metric="sqeuclidean")
+    idx = sharded.build_cagra(
+        comms, db, cagra.IndexParams(graph_degree=16,
+                                     intermediate_graph_degree=32))
+    d, i = sharded.search_cagra(idx, q, 5, cagra.SearchParams(itopk_size=32))
+    i = np.asarray(i)
+    assert i.shape == (40, 5)
+    assert (i < 2000).all() and (i >= -1).all()
+    recall = float(neighborhood_recall(i, np.asarray(gt)))
+    assert recall >= 0.8, f"sharded cagra recall {recall}"
