@@ -95,3 +95,23 @@ def test_bitset_filter(rng):
     ref = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
     ref = np.where(mask[None, :], ref, np.inf)
     np.testing.assert_array_equal(i[:, 0], ref.argmin(1))
+
+
+@pytest.mark.parametrize("dt", ["int8", "uint8", "bfloat16"])
+def test_narrow_dtypes(dt, rng):
+    import jax.numpy as jnp
+
+    if dt == "bfloat16":
+        db = jnp.asarray(rng.standard_normal((500, 16)), jnp.bfloat16)
+        q = jnp.asarray(rng.standard_normal((50, 16)), jnp.bfloat16)
+        ref_db = np.asarray(db, np.float32)
+        ref_q = np.asarray(q, np.float32)
+    else:
+        lo = -120 if dt == "int8" else 0
+        db = rng.integers(lo, 120, (500, 16)).astype(dt)
+        q = rng.integers(lo, 120, (50, 16)).astype(dt)
+        ref_db = db.astype(np.float32)
+        ref_q = q.astype(np.float32)
+    _, i = brute_force.knn(q, db, 5, metric="sqeuclidean")
+    ref = ((ref_q[:, None, :] - ref_db[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))

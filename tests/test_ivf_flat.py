@@ -153,3 +153,19 @@ def test_pallas_scan_path_matches_xla(data):
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
                                rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("dt", [np.int8, np.uint8])
+def test_int8_dataset(dt, rng):
+    """int8/uint8 datasets (reference: ivf_flat's dp4a paths support
+    int8/uint8 natively — ivf_flat_interleaved_scan-inl.cuh:99-251); storage
+    stays narrow (4x less scan bandwidth), math is f32."""
+    lo = -120 if dt == np.int8 else 0
+    db = rng.integers(lo, 120, (2000, 32)).astype(dt)
+    q = rng.integers(lo, 120, (100, 32)).astype(dt)
+    idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    assert idx.list_data.dtype == dt
+    _, i = ivf_flat.search(idx, q, 5, ivf_flat.SearchParams(n_probes=16))
+    ref = ((q.astype(np.float32)[:, None, :]
+            - db.astype(np.float32)[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
