@@ -27,14 +27,22 @@ def main():
     q = rng.standard_normal((n_q, dim)).astype(np.float32)
 
     index = brute_force.build(db, metric="sqeuclidean")
-    # warmup (compile)
-    d, i = brute_force.search(index, q[:n_q], k)
-    jax.block_until_ready((d, i))
+
+    # exact fp32 pass = ground truth + the fallback timing target
+    d_e, i_e = brute_force.search(index, q, k)
+    jax.block_until_ready((d_e, i_e))
+
+    # bf16 MXU fast-scan + exact fp32 re-rank; keep it only if recall holds
+    d_f, i_f = brute_force.search(index, q, k, scan_dtype="bfloat16")
+    jax.block_until_ready((d_f, i_f))
+    recall = float(neighborhood_recall(np.asarray(i_f), np.asarray(i_e)))
+    use_fast = recall >= 0.999
+    scan_dtype = "bfloat16" if use_fast else None
 
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        d, i = brute_force.search(index, q, k)
+        d, i = brute_force.search(index, q, k, scan_dtype=scan_dtype)
         jax.block_until_ready((d, i))
     dt = (time.perf_counter() - t0) / iters
     qps = n_q / dt
@@ -46,6 +54,8 @@ def main():
                 "value": round(qps, 1),
                 "unit": "QPS",
                 "vs_baseline": 1.0,
+                "recall": round(recall, 5) if use_fast else 1.0,
+                "scan": "bf16+fp32refine" if use_fast else "fp32",
             }
         )
     )

@@ -30,6 +30,8 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
     cosine_expanded,
+    gathered_distances,
+    inner_product,
     is_min_close,
     l2_expanded,
     resolve_metric,
@@ -86,13 +88,24 @@ def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
     return q_tile, db_tile
 
 
+#: metrics eligible for the bf16 fast-scan (their scan is one MXU matmul and
+#: their exact distance is recoverable from gathered candidates at refine)
+_FAST_SCAN_METRICS = (
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.CosineExpanded,
+    DistanceType.InnerProduct,
+)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("metric", "metric_arg", "k", "q_tile", "db_tile",
-                     "budget", "has_filter"),
+                     "budget", "has_filter", "fast_scan", "refine_mult"),
 )
 def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
-             q_tile, db_tile, budget, has_filter: bool = False):
+             q_tile, db_tile, budget, has_filter: bool = False,
+             fast_scan: bool = False, refine_mult: int = 4):
     nq, dim = queries.shape
     ndb = dataset.shape[0]
     minimize = is_min_close(metric)
@@ -110,18 +123,57 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
     qp = jnp.pad(queries, ((0, q_pad), (0, 0)))
     # Pad DB once; padded rows get +inf (or -inf for max-close) distances.
     dbp = jnp.pad(dataset, ((0, db_pad), (0, 0)))
-    dbn = jnp.pad(db_norms, (0, db_pad)) if use_cached_norms else None
+    need_norms = use_cached_norms or (
+        fast_scan and metric != DistanceType.InnerProduct)
+    if use_cached_norms:
+        dbn = jnp.pad(db_norms, (0, db_pad))
+    elif need_norms:
+        dbn = row_norms_sq(dbp)
+    else:
+        dbn = None
     pad_bad = jnp.arange(n_db_tiles * db_tile) >= ndb
     bad_fill = jnp.inf if minimize else -jnp.inf
+    # Fast scan over-selects candidates; exact fp32 re-rank recovers them.
+    k_scan = min(refine_mult * k, db_tile) if fast_scan else min(k, db_tile)
+    # Refine pool must still hold >= k candidates when db_tile < k; the
+    # merged pool has n_db_tiles*k_scan >= k entries, so this never exceeds it.
+    k_refine = max(k_scan, k)
+
+    def _filter_pass(ids):
+        """Packed-bitset test for row ids (shared by scan + refine)."""
+        words = filter_words[jnp.minimum(ids // 32, filter_words.shape[0] - 1)]
+        return ((words >> (ids % 32).astype(jnp.uint32)) & 1).astype(bool)
 
     def q_body(qt):
         # Query-tile norms hoisted out of the db-tile loop (analog of the
         # reference's rowNorm precompute, detail/knn_brute_force.cuh:97-136).
-        qt_norms = row_norms_sq(qt) if use_cached_norms else None
+        qt_norms = row_norms_sq(qt) if need_norms else None
+        qt_bf = qt.astype(jnp.bfloat16) if fast_scan else None
 
         def db_body(t):
             db_t = jax.lax.dynamic_slice_in_dim(dbp, t * db_tile, db_tile, 0)
-            if use_cached_norms:
+            if fast_scan:
+                # Single-pass bf16 MXU matmul (the TPU analog of the
+                # reference's TF32/CUTLASS fast path, dispatch_sm80.cuh):
+                # bf16 inputs take _dot's fast-precision path while the
+                # precomputed norms stay fp32, so only the cross term is
+                # approximate. Ranking-only score: sqrt skipped for
+                # L2SqrtExpanded (monotone); exact distances come from the
+                # refine stage.
+                db_bf = db_t.astype(jnp.bfloat16)
+                if metric == DistanceType.InnerProduct:
+                    d = inner_product(qt_bf, db_bf)
+                elif metric == DistanceType.CosineExpanded:
+                    dbn_t = jax.lax.dynamic_slice_in_dim(
+                        dbn, t * db_tile, db_tile, 0)
+                    d = cosine_expanded(qt_bf, db_bf, x_norms=qt_norms,
+                                        y_norms=dbn_t)
+                else:
+                    dbn_t = jax.lax.dynamic_slice_in_dim(
+                        dbn, t * db_tile, db_tile, 0)
+                    d = l2_expanded(qt_bf, db_bf, sqrt=False,
+                                    x_norms=qt_norms, y_norms=dbn_t)
+            elif use_cached_norms:
                 dbn_t = jax.lax.dynamic_slice_in_dim(dbn, t * db_tile, db_tile, 0)
                 if metric == DistanceType.CosineExpanded:
                     d = cosine_expanded(qt, db_t, x_norms=qt_norms, y_norms=dbn_t)
@@ -136,14 +188,9 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
             if has_filter:
                 # bitset prefilter in the tile epilogue (reference:
                 # bitset_filter, sample_filter_types.hpp:55-82)
-                ids = t * db_tile + jnp.arange(db_tile)
-                words = filter_words[jnp.minimum(ids // 32,
-                                                 filter_words.shape[0] - 1)]
-                bits = ((words >> (ids % 32).astype(jnp.uint32)) & 1
-                        ).astype(bool)
-                bad = bad | ~bits
+                bad = bad | ~_filter_pass(t * db_tile + jnp.arange(db_tile))
             d = jnp.where(bad[None, :], bad_fill, d)
-            v, i = select_k(d, min(k, db_tile), select_min=minimize)
+            v, i = select_k(d, k_scan, select_min=minimize)
             return v, i + t * db_tile
 
         tile_v, tile_i = jax.lax.map(db_body, jnp.arange(n_db_tiles))
@@ -152,6 +199,21 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
         kk = tile_v.shape[-1]
         all_v = jnp.moveaxis(tile_v, 0, 1).reshape(q_tile, n_db_tiles * kk)
         all_i = jnp.moveaxis(tile_i, 0, 1).reshape(q_tile, n_db_tiles * kk)
+        if fast_scan:
+            # Exact fp32 re-rank of the scanned candidates (reference analog:
+            # neighbors::refine over a coarse candidate list).
+            _, sel = select_k(all_v, min(k_refine, all_v.shape[-1]),
+                              select_min=minimize)
+            cand_i = jnp.take_along_axis(all_i, sel, axis=1)
+            cand_vecs = jnp.take(dbp, cand_i, axis=0)  # [q_tile, k_ref, dim]
+            exact = gathered_distances(qt, cand_vecs, metric)
+            # Re-mask padded/filtered rows (their gathered distance is real).
+            bad_rows = jnp.take(pad_bad, cand_i)
+            if has_filter:
+                bad_rows = bad_rows | ~_filter_pass(cand_i)
+            exact = jnp.where(bad_rows, bad_fill, exact)
+            v, sel2 = select_k(exact, k, select_min=minimize)
+            return v, jnp.take_along_axis(cand_i, sel2, axis=1)
         v, sel = select_k(all_v, k, select_min=minimize)
         return v, jnp.take_along_axis(all_i, sel, axis=1)
 
@@ -165,33 +227,66 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
 
 
 def search(index: Index, queries, k: int, filter=None,
-           res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
+           res: Optional[Resources] = None, scan_dtype=None,
+           refine_ratio: float = 4.0) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN search → (distances [nq, k], indices [nq, k]).
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
     database row ids; cleared bits are excluded (reference: the
-    bitset_filter overloads of brute_force::search)."""
+    bitset_filter overloads of brute_force::search).
+
+    ``scan_dtype="bfloat16"`` (fp32 data, expanded-L2/cosine/inner-product
+    metrics only) runs the distance matmul as a single bf16 MXU pass and
+    exactly re-ranks the top ``refine_ratio·k`` candidates in fp32 — the TPU
+    analog of the reference's TF32/CUTLASS Ampere path (detail/
+    pairwise_matrix/dispatch_sm80.cuh). Returned distances are exact fp32;
+    ranking is exact except for candidates the bf16 screen misses
+    (recall ≥ 0.999 at refine_ratio=4 in practice)."""
     res = ensure_resources(res)
     queries = jnp.asarray(queries, index.dataset.dtype)
     if queries.shape[1] != index.dim:
         raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
     k = int(min(k, index.size))
+    fast_scan = scan_dtype is not None
+    if fast_scan:
+        if jnp.dtype(scan_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"scan_dtype={scan_dtype!r}: only bfloat16 is supported")
+        if index.dataset.dtype != jnp.float32:
+            raise ValueError(
+                "scan_dtype requires an fp32 dataset (narrow dtypes already "
+                "take the fast MXU path)")
+        if index.metric not in _FAST_SCAN_METRICS:
+            raise ValueError(
+                f"scan_dtype unsupported for metric {index.metric.name}; "
+                "eligible: L2Expanded/L2SqrtExpanded/CosineExpanded/"
+                "InnerProduct")
+    refine_mult = max(1, int(round(float(refine_ratio))))
     q_tile, db_tile = _choose_tiles(
         queries.shape[0], index.size, index.dim, k, res.workspace_limit_bytes
     )
+    if fast_scan:
+        # Budget the refine gather too: [q_tile, k_refine, dim] fp32
+        # candidates must fit the workspace like the scan tile does.
+        k_refine = max(min(refine_mult * k, db_tile), k)
+        per_row = k_refine * index.dim * 4
+        q_cap = max(8, res.workspace_limit_bytes // (4 * max(per_row, 1)))
+        q_tile = min(q_tile, q_cap - q_cap % 8 or 8)
     return _knn_jit(
         queries, index.dataset, index.norms,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, index.metric_arg,
         k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
+        fast_scan, refine_mult if fast_scan else 1,
     )
 
 
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
-        res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
+        res: Optional[Resources] = None, scan_dtype=None,
+        refine_ratio: float = 4.0) -> Tuple[jax.Array, jax.Array]:
     """One-shot exact kNN (reference: brute_force::knn)."""
     return search(build(dataset, metric, metric_arg, res), queries, k,
-                  res=res)
+                  res=res, scan_dtype=scan_dtype, refine_ratio=refine_ratio)
 
 
 _SERIAL_VERSION = 1

@@ -20,6 +20,12 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# A TPU-tunnel plugin's sitecustomize may have set jax_platforms="axon,cpu"
+# at interpreter startup (before this file ran), which overrides the env var
+# above; backend init would then dial the tunnel and can hang forever.
+# Force the config itself back to cpu-only for the test process.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -115,3 +115,43 @@ def test_narrow_dtypes(dt, rng):
     _, i = brute_force.knn(q, db, 5, metric="sqeuclidean")
     ref = ((ref_q[:, None, :] - ref_db[None, :, :]) ** 2).sum(-1)
     np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
+
+
+@pytest.mark.parametrize("metric", ["sqeuclidean", "euclidean", "cosine",
+                                    "inner_product"])
+def test_fast_scan_bf16_refined(metric, rng):
+    """bf16 single-pass scan + exact fp32 re-rank: near-perfect recall and
+    exact distances on the returned candidates."""
+    from raft_tpu.stats import neighborhood_recall
+
+    db = rng.standard_normal((3000, 64)).astype(np.float32)
+    q = rng.standard_normal((100, 64)).astype(np.float32)
+    idx = brute_force.build(db, metric=metric)
+    d_f, i_f = brute_force.search(idx, q, 10, scan_dtype="bfloat16")
+    d_e, i_e = brute_force.search(idx, q, 10)
+    rec = float(neighborhood_recall(np.asarray(i_f), np.asarray(i_e)))
+    assert rec >= 0.99
+    # wherever the fast path picked the true neighbor, its distance is exact
+    same = np.asarray(i_f) == np.asarray(i_e)
+    np.testing.assert_allclose(np.asarray(d_f)[same], np.asarray(d_e)[same],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fast_scan_tiled_and_filtered(rng):
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.core.resources import Resources
+
+    db = rng.standard_normal((2500, 32)).astype(np.float32)
+    q = rng.standard_normal((64, 32)).astype(np.float32)
+    mask = rng.random(2500) < 0.6
+    bs = Bitset.from_mask(mask)
+    # tiny workspace forces multiple db tiles through the merge path
+    res = Resources(workspace_limit_bytes=2 << 20)
+    idx = brute_force.build(db, metric="sqeuclidean", res=res)
+    d, i = brute_force.search(idx, q, 8, filter=bs, res=res,
+                              scan_dtype="bfloat16")
+    i = np.asarray(i)
+    assert mask[i].all()
+    ref = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
+    ref = np.where(mask[None, :], ref, np.inf)
+    np.testing.assert_array_equal(i[:, 0], ref.argmin(1))
