@@ -53,6 +53,17 @@ class AnnAlgo:
         raise NotImplementedError
 
 
+def _scan_dtype(search_param):
+    """Map a config's scan_dtype string; raises on typos instead of silently
+    benchmarking the fp32 path under a bf16 label."""
+    v = search_param.get("scan_dtype")
+    if v is None:
+        return None
+    if v in ("bf16", "bfloat16", "half"):
+        return "bfloat16"
+    raise ValueError(f"unknown scan_dtype {v!r}; use bf16/bfloat16/half")
+
+
 class BruteForce(AnnAlgo):
     name = "raft_brute_force"
 
@@ -64,7 +75,10 @@ class BruteForce(AnnAlgo):
     def search(self, index, queries, k, search_param, res):
         from raft_tpu.neighbors import brute_force
 
-        return brute_force.search(index, queries, k, res=res)
+        return brute_force.search(
+            index, queries, k, res=res,
+            scan_dtype=_scan_dtype(search_param),
+            refine_ratio=float(search_param.get("refine_ratio", 4.0)))
 
     def save(self, index, path):
         from raft_tpu.neighbors import brute_force
@@ -95,7 +109,8 @@ class IvfFlat(AnnAlgo):
         from raft_tpu.neighbors import ivf_flat
 
         sp = ivf_flat.SearchParams(
-            n_probes=int(search_param.get("nprobe", 20)))
+            n_probes=int(search_param.get("nprobe", 20)),
+            scan_dtype=_scan_dtype(search_param))
         return ivf_flat.search(index, queries, k, sp, res=res)
 
     def save(self, index, path):

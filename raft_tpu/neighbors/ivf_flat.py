@@ -62,9 +62,16 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """reference: ivf_flat_types.hpp search_params."""
+    """reference: ivf_flat_types.hpp search_params.
+
+    ``scan_dtype``: None scans at the data dtype (fp32 data → fp32-accurate
+    MXU passes). ``"bfloat16"`` runs the fine scan's matmul as a single bf16
+    MXU pass with exact fp32 row norms — the TPU analog of the reference's
+    int8/dp4a fast scans (ivf_flat_interleaved_scan-inl.cuh:99-251); recall
+    impact is negligible next to probe misses."""
 
     n_probes: int = 20
+    scan_dtype: Optional[object] = None
 
 
 class Index:
@@ -215,7 +222,8 @@ def _coarse_scores(queries, centers, metric: DistanceType):
 def _search_core(queries, centers, list_data, list_indices, list_sizes,
                  filter_words, metric: DistanceType, k: int, n_probes: int,
                  q_tile: int, has_filter: bool, row_norms=None,
-                 use_pallas: bool = False, pallas_interpret: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False,
+                 fast_scan: bool = False):
     """Traceable search body — jitted below; also shard_mapped by
     raft_tpu.parallel.sharded for multi-device list-sharded search.
 
@@ -264,26 +272,40 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
         else:
             # ---- gather probed lists and scan
             g_data = list_data[probes]  # [t, P, pad, dim]
-            gf = g_data.astype(jnp.float32)
+            if fast_scan:
+                # bf16 MXU pass; norms stay exact fp32 (cached per-row)
+                q_s, g_s = qt.astype(jnp.bfloat16), g_data.astype(jnp.bfloat16)
+            else:
+                q_s, g_s = qf, g_data.astype(jnp.float32)
             dots = jnp.einsum(
-                "td,tpld->tpl", qf, gf,
+                "td,tpld->tpl", q_s, g_s,
+                # HIGHEST only for true fp32 data on the accurate path;
+                # int8/uint8/bf16 values are bf16-exact → single MXU pass
                 precision=(jax.lax.Precision.HIGHEST
-                           if g_data.dtype == jnp.float32 else None),
+                           if (not fast_scan
+                               and g_data.dtype == jnp.float32) else None),
                 preferred_element_type=jnp.float32,
             )
             if metric == DistanceType.InnerProduct:
                 d = dots
-            elif metric == DistanceType.CosineExpanded:
-                vn = jnp.sqrt(jnp.maximum(jnp.sum(gf * gf, -1), 1e-20))
-                qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
-                d = 1.0 - dots / (vn * qn[:, None, None])
             else:
-                vn2 = jnp.sum(gf * gf, -1)
-                qn2 = row_norms_sq(qf)
-                d = qn2[:, None, None] + vn2 - 2.0 * dots
-                d = jnp.maximum(d, 0.0)
-                if metric == DistanceType.L2SqrtExpanded:
-                    d = jnp.sqrt(d)
+                # exact per-row norms: cached [L, pad] gather when available,
+                # else recomputed from the gathered tile
+                if row_norms is not None:
+                    vn2 = row_norms[probes]
+                else:
+                    gf32 = g_data.astype(jnp.float32)
+                    vn2 = jnp.sum(gf32 * gf32, -1)
+                if metric == DistanceType.CosineExpanded:
+                    vn = jnp.sqrt(jnp.maximum(vn2, 1e-20))
+                    qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
+                    d = 1.0 - dots / (vn * qn[:, None, None])
+                else:
+                    qn2 = row_norms_sq(qf)
+                    d = qn2[:, None, None] + vn2 - 2.0 * dots
+                    d = jnp.maximum(d, 0.0)
+                    if metric == DistanceType.L2SqrtExpanded:
+                        d = jnp.sqrt(d)
         bad_fill = jnp.inf if minimize else -jnp.inf
         ok = g_valid
         if has_filter:
@@ -320,7 +342,7 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
 _search_jit = jax.jit(
     _search_core,
     static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter",
-                     "use_pallas", "pallas_interpret"),
+                     "use_pallas", "pallas_interpret", "fast_scan"),
 )
 
 
@@ -354,12 +376,26 @@ def search(
     from raft_tpu.ops import pallas_kernels as pk
 
     use_pallas = pk.pallas_enabled()
+    fast_scan = params.scan_dtype is not None
+    if fast_scan:
+        if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"scan_dtype={params.scan_dtype!r}: only bfloat16 is supported")
+        if index.list_data.dtype != jnp.float32:
+            raise ValueError("scan_dtype requires fp32 list data")
+    # Cached exact norms are required by the Pallas path and the bf16 fast
+    # scan; the plain XLA path keeps computing norms per probed tile instead
+    # (materializing [L, pad] fp32 norms for a large narrow-dtype index is a
+    # needless device-memory spike there).
+    need_norms = use_pallas or (
+        fast_scan and index.metric != DistanceType.InnerProduct)
     return _search_jit(
         queries, index.centers, index.list_data, index.list_indices,
         index.list_sizes,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, int(k), n_probes, q_tile, filter is not None,
-        index.ensure_row_norms() if use_pallas else None, use_pallas, False,
+        index.ensure_row_norms() if need_norms else None, use_pallas, False,
+        fast_scan,
     )
 
 

@@ -503,6 +503,24 @@ def build(
     return index
 
 
+def encode_batch(index: Index, vectors, labels,
+                 res: Optional[Resources] = None) -> np.ndarray:
+    """Residual-encode + bit-pack one batch of vectors against their coarse
+    labels → packed code bytes [n, pq_dim*pq_bits/8] (the per-batch body of
+    process_and_fill_codes, detail/ivf_pq_build.cuh:1185-1351). Shared by
+    ``extend`` and the streamed ``neighbors.ooc`` builder."""
+    res = ensure_resources(res)
+    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
+    row_tile = int(np.clip(
+        res.workspace_limit_bytes //
+        max(index.pq_dim * index.pq_book_size * 4 * 4, 1), 8, 4096))
+    row_tile -= row_tile % 8
+    codes = _encode_jit(jnp.asarray(vectors, jnp.float32),
+                        jnp.asarray(labels), index.centers, index.rotation,
+                        index.codebooks, per_cluster, max(row_tile, 8))
+    return _pack_codes_np(np.asarray(codes).astype(np.uint8), index.pq_bits)
+
+
 def extend(index: Index, new_vectors, new_indices=None,
            res: Optional[Resources] = None) -> Index:
     """Encode + add vectors (reference: ivf_pq::extend, ivf_pq-inl.cuh:355 →
@@ -512,15 +530,7 @@ def extend(index: Index, new_vectors, new_indices=None,
     km = KMeansBalancedParams(metric=index.metric)
     labels = kmeans_balanced.predict(index.centers, new_vectors, km, res=res)
 
-    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
-    row_tile = int(np.clip(
-        res.workspace_limit_bytes //
-        max(index.pq_dim * index.pq_book_size * 4 * 4, 1), 8, 4096))
-    row_tile -= row_tile % 8 or 0
-    codes = _encode_jit(new_vectors, labels, index.centers, index.rotation,
-                        index.codebooks, per_cluster, max(row_tile, 8))
-    code_bytes = _pack_codes_np(np.asarray(codes).astype(np.uint8),
-                                index.pq_bits)
+    code_bytes = encode_batch(index, new_vectors, labels, res)
 
     labels_np = np.asarray(labels)
     if new_indices is None:

@@ -1,0 +1,196 @@
+"""Out-of-core (streamed) index builds from on-disk fbin datasets.
+
+Reference analog: the reference's larger-than-device-memory story —
+host-memory datasets with batched device staging (bench
+``dataset_memory_type``, ann_types.hpp:68-118), subsampled training
+(ivf_pq_types.hpp:59 ``kmeans_trainset_fraction``), and the wiki-all 88M×768
+dataset "intentionally larger than GPU memory"
+(docs/source/wiki_all_dataset.md:3). RAFT streams build batches through
+``extend``; here the whole pipeline is two passes over the file:
+
+1. **Train** on a strided row sample (never materializes the full dataset).
+2. **Pass A** streams batches through the coarse quantizer to get labels and
+   exact list sizes; **Pass B** allocates the final padded list storage once
+   and scatters each batch into place (encode-on-the-fly for PQ) — avoiding
+   the O(N²) repack that repeated ``extend`` calls would cost.
+
+The file format is the raft-ann-bench fbin/ibin layout (bench
+common/dataset.hpp) read through the native IO layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu import native
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.utils.shape import round_up_to
+
+
+def sample_rows_from_file(path: str, n_sample: int, seed: int = 0,
+                          dtype=None, batch_rows: int = 1 << 18
+                          ) -> np.ndarray:
+    """Uniform-ish strided row sample without loading the file: reads
+    contiguous chunks and keeps an evenly spaced subset of each (the
+    trainset subsample of detail/ivf_pq_build.cuh:1759, host-streamed)."""
+    n, dim = native.read_bin_header(path)
+    n_sample = min(int(n_sample), n)
+    out = []
+    taken = 0
+    rng = np.random.default_rng(seed)
+    for start in range(0, n, batch_rows):
+        rows = min(batch_rows, n - start)
+        want = int(round(n_sample * (start + rows) / n)) - taken
+        if want <= 0:
+            continue
+        batch = native.read_bin(path, start, rows, dtype=dtype)
+        if want >= rows:
+            sel = batch
+        else:
+            pick = rng.choice(rows, size=want, replace=False)
+            pick.sort()
+            sel = batch[pick]
+        out.append(np.ascontiguousarray(sel))
+        taken += len(sel)
+    return np.concatenate(out, axis=0)
+
+
+def _labels_pass(path: str, centers, metric, batch_rows: int, dtype,
+                 res: Resources) -> np.ndarray:
+    """Pass A: stream batches through the coarse quantizer → labels [n]."""
+    n, _ = native.read_bin_header(path)
+    km = KMeansBalancedParams(metric=metric)
+    labels = np.empty(n, np.int32)
+    for start in range(0, n, batch_rows):
+        rows = min(batch_rows, n - start)
+        batch = native.read_bin(path, start, rows, dtype=dtype)
+        lb = kmeans_balanced.predict(centers, jnp.asarray(batch), km, res=res)
+        labels[start:start + rows] = np.asarray(lb, np.int32)
+    return labels
+
+
+def _scatter_positions(lb: np.ndarray, offsets: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Slot position for every batch row given running per-list offsets;
+    returns (positions, new bincount). Vectorized grouped cumcount."""
+    order = np.argsort(lb, kind="stable")
+    sorted_lb = lb[order]
+    cc = np.arange(len(lb), dtype=np.int64)
+    if len(lb):
+        starts = np.r_[0, np.flatnonzero(np.diff(sorted_lb)) + 1]
+        group_len = np.diff(np.r_[starts, len(lb)])
+        cc -= np.repeat(cc[starts], group_len)
+    pos = np.empty(len(lb), np.int64)
+    pos[order] = offsets[sorted_lb] + cc
+    return pos, np.bincount(lb, minlength=len(offsets))
+
+
+def build_ivf_flat_from_file(path: str, params=None,
+                             res: Optional[Resources] = None,
+                             batch_rows: int = 1 << 18, dtype=None,
+                             max_train_rows: Optional[int] = None):
+    """Streamed IVF-Flat build from an fbin file → ivf_flat.Index.
+
+    The dataset is read twice (labels pass + fill pass) in ``batch_rows``
+    chunks; peak host memory is the final padded list storage + one batch.
+    """
+    from raft_tpu.neighbors import ivf_flat
+
+    params = params or ivf_flat.IndexParams()
+    res = ensure_resources(res)
+    n, dim = native.read_bin_header(path)
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+
+    n_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
+    if max_train_rows is not None:
+        n_train = min(n_train, int(max_train_rows))
+    trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
+                                     batch_rows=batch_rows)
+    km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
+                              metric=params.metric)
+    centers = kmeans_balanced.fit(res.next_key(),
+                                  jnp.asarray(trainset, jnp.float32),
+                                  params.n_lists, km, res=res)
+    del trainset
+
+    labels = _labels_pass(path, centers, params.metric, batch_rows, dtype,
+                          res)
+    sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
+    pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
+
+    first = native.read_bin(path, 0, 1, dtype=dtype)
+    data = np.zeros((params.n_lists, pad, dim), first.dtype)
+    idxs = np.full((params.n_lists, pad), -1, np.int32)
+    offsets = np.zeros(params.n_lists, np.int64)
+    for start in range(0, n, batch_rows):
+        rows = min(batch_rows, n - start)
+        batch = native.read_bin(path, start, rows, dtype=dtype)
+        lb = labels[start:start + rows]
+        pos, cnt = _scatter_positions(lb, offsets)
+        data[lb, pos] = batch
+        idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)
+        offsets += cnt
+
+    return ivf_flat.Index(params, centers, jnp.asarray(data),
+                          jnp.asarray(idxs), jnp.asarray(sizes), n)
+
+
+def build_ivf_pq_from_file(path: str, params=None,
+                           res: Optional[Resources] = None,
+                           batch_rows: int = 1 << 18, dtype=None,
+                           max_train_rows: Optional[int] = None):
+    """Streamed IVF-PQ build from an fbin file → ivf_pq.Index.
+
+    Training (coarse centers, rotation, codebooks) runs on a row sample via
+    the in-memory ``ivf_pq.build``; the full dataset is then encoded batch
+    by batch into the final packed-code storage (the streaming analog of
+    process_and_fill_codes, detail/ivf_pq_build.cuh:1185-1351).
+    """
+    from raft_tpu.neighbors import ivf_pq
+
+    params = params or ivf_pq.IndexParams()
+    res = ensure_resources(res)
+    n, dim = native.read_bin_header(path)
+    if params.n_lists > n:
+        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+
+    n_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
+    if max_train_rows is not None:
+        n_train = min(n_train, int(max_train_rows))
+    trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
+                                     batch_rows=batch_rows)
+    train_params = dataclasses.replace(params, kmeans_trainset_fraction=1.0,
+                                       add_data_on_build=False)
+    index = ivf_pq.build(np.asarray(trainset, np.float32), train_params,
+                         res=res)
+    del trainset
+
+    labels = _labels_pass(path, index.centers, params.metric, batch_rows,
+                          dtype, res)
+    sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
+    pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
+    packed_width = index.pq_dim * index.pq_bits // 8
+
+    codes = np.zeros((params.n_lists, pad, packed_width), np.uint8)
+    idxs = np.full((params.n_lists, pad), -1, np.int32)
+    offsets = np.zeros(params.n_lists, np.int64)
+    for start in range(0, n, batch_rows):
+        rows = min(batch_rows, n - start)
+        batch = native.read_bin(path, start, rows, dtype=dtype)
+        lb = labels[start:start + rows]
+        packed = ivf_pq.encode_batch(index, batch, lb, res)
+        pos, cnt = _scatter_positions(lb, offsets)
+        codes[lb, pos] = packed
+        idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)
+        offsets += cnt
+
+    return ivf_pq.Index(params, index.pq_dim, index.centers, index.rotation,
+                        index.codebooks, jnp.asarray(codes),
+                        jnp.asarray(idxs), jnp.asarray(sizes), n)

@@ -498,11 +498,19 @@ def search_ivf_flat(
     if q_tile >= 8:
         q_tile -= q_tile % 8
     empty_filter = jnp.zeros((0,), jnp.uint32)
+    fast_scan = getattr(params, "scan_dtype", None) is not None
+    if fast_scan:
+        if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"scan_dtype={params.scan_dtype!r}: only bfloat16 is "
+                "supported")
+        if index.list_data.dtype != jnp.float32:
+            raise ValueError("scan_dtype requires fp32 list data")
 
     def local(q_rep, c, ld, li, ls):
         v, i = ivf_flat._search_core(
             q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
-            int(k), n_probes, q_tile, False)
+            int(k), n_probes, q_tile, False, fast_scan=fast_scan)
         v_all = comms.allgather(v, axis=1)
         i_all = comms.allgather(i, axis=1)
         v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)

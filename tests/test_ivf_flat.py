@@ -169,3 +169,17 @@ def test_int8_dataset(dt, rng):
     ref = ((q.astype(np.float32)[:, None, :]
             - db.astype(np.float32)[None, :, :]) ** 2).sum(-1)
     np.testing.assert_array_equal(np.asarray(i)[:, 0], ref.argmin(1))
+
+
+def test_bf16_fast_scan(data, gt):
+    """bf16 fine scan with exact fp32 norms matches the fp32 scan's recall
+    at full probing (all lists probed → only scan precision differs)."""
+    db, q = data
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32),
+                           res=Resources(seed=5))
+    sp = ivf_flat.SearchParams(n_probes=32, scan_dtype="bfloat16")
+    _, i = ivf_flat.search(index, q, 10, sp)
+    assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.99
+    with pytest.raises(ValueError, match="bfloat16"):
+        ivf_flat.search(index, q, 10,
+                        ivf_flat.SearchParams(n_probes=4, scan_dtype="float16"))

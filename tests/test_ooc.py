@@ -1,0 +1,77 @@
+"""Out-of-core streamed builds (reference analog: host-memory datasets +
+batched staging, wiki_all larger-than-memory workflow)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu import Resources, native
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, ooc
+from raft_tpu.stats import neighborhood_recall
+
+
+@pytest.fixture(scope="module")
+def fbin(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((6000, 32)).astype(np.float32)
+    q = rng.standard_normal((100, 32)).astype(np.float32)
+    path = str(tmp_path_factory.mktemp("ooc") / "base.fbin")
+    native.write_bin(path, db)
+    return path, db, q
+
+
+def test_sample_rows(fbin):
+    path, db, _ = fbin
+    s = ooc.sample_rows_from_file(path, 500, batch_rows=1000)
+    assert s.shape == (500, 32)
+    # every sampled row is an actual dataset row
+    assert np.isin(s[:, 0], db[:, 0]).all()
+
+
+def test_streamed_ivf_flat_matches_recall(fbin):
+    path, db, q = fbin
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    params = ivf_flat.IndexParams(n_lists=16)
+    index = ooc.build_ivf_flat_from_file(path, params, res=Resources(seed=2),
+                                         batch_rows=1000)
+    assert index.size == len(db)
+    assert int(np.asarray(index.list_sizes).sum()) == len(db)
+    _, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=16))
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert rec >= 0.999  # all lists probed → exact
+
+
+def test_streamed_ivf_flat_ids_roundtrip(fbin):
+    path, db, _ = fbin
+    params = ivf_flat.IndexParams(n_lists=8)
+    index = ooc.build_ivf_flat_from_file(path, params, res=Resources(seed=2),
+                                         batch_rows=700)
+    # every stored id's vector matches the dataset row
+    data = np.asarray(index.list_data)
+    idxs = np.asarray(index.list_indices)
+    sizes = np.asarray(index.list_sizes)
+    for l in range(8):
+        s = int(sizes[l])
+        np.testing.assert_array_equal(data[l, :s], db[idxs[l, :s]])
+
+
+def test_streamed_ivf_pq_recall(fbin):
+    path, db, q = fbin
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=16)
+    index = ooc.build_ivf_pq_from_file(path, params, res=Resources(seed=2),
+                                       batch_rows=1000)
+    assert index.size == len(db)
+    sp = ivf_pq.SearchParams(n_probes=16)
+    _, i = ivf_pq.search(index, q, 10, sp)
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert rec >= 0.7  # PQ quantization floor at full probing
+
+    # streamed equals in-memory built from the same trainset contract:
+    # encode path identical → recall within a few points
+    mem = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=16, pq_dim=16),
+                       res=Resources(seed=2))
+    _, im = ivf_pq.search(mem, q, 10, sp)
+    rec_mem = float(neighborhood_recall(np.asarray(im), np.asarray(gt)))
+    assert abs(rec - rec_mem) < 0.1

@@ -108,6 +108,11 @@ def test_sharded_ivf_flat(comms):
                                    ivf_flat.SearchParams(n_probes=8))
     recall = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
     assert recall >= 0.999, f"sharded ivf_flat recall {recall}"
+    # sharded search honors the bf16 fast scan too
+    d, i = sharded.search_ivf_flat(
+        idx, q, 10, ivf_flat.SearchParams(n_probes=8, scan_dtype="bfloat16"))
+    recall = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert recall >= 0.99, f"sharded bf16 ivf_flat recall {recall}"
 
 
 def test_sharded_ivf_pq(comms):
