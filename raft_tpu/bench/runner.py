@@ -150,12 +150,22 @@ class IvfPq(AnnAlgo):
 
         dtypes = {"float": jnp.float32, "fp32": jnp.float32,
                   "half": jnp.bfloat16, "fp16": jnp.bfloat16,
-                  "fp8": jnp.bfloat16, "bf16": jnp.bfloat16}
+                  "fp8": jnp.float8_e4m3fn, "bf16": jnp.bfloat16}
+        lut = dtypes[search_param.get("smemLutDtype", "float")]
+        scan_mode = search_param.get("scan_mode", "auto")
+        if lut == jnp.float8_e4m3fn and scan_mode != "lut":
+            # fp8 LUTs only exist on the LUT engine; the cache engine would
+            # silently benchmark fp32-cache numbers under an fp8 label
+            scan_mode = "lut"
         sp = ivf_pq.SearchParams(
             n_probes=int(search_param.get("nprobe", 20)),
-            lut_dtype=dtypes[search_param.get("smemLutDtype", "float")],
-            internal_distance_dtype=dtypes[
+            lut_dtype=lut,
+            internal_distance_dtype={
+                "float": jnp.float32, "fp32": jnp.float32,
+                "half": jnp.bfloat16, "fp16": jnp.bfloat16,
+                "bf16": jnp.bfloat16}[
                 search_param.get("internalDistanceDtype", "float")],
+            scan_mode=scan_mode,
         )
         rr = float(search_param.get("refine_ratio", 1.0))
         if rr > 1.0:
@@ -209,6 +219,7 @@ class Cagra(AnnAlgo):
             itopk_size=int(search_param.get("itopk", 64)),
             search_width=int(search_param.get("search_width", 1)),
             max_iterations=int(search_param.get("max_iterations", 0)),
+            scan_dtype=_scan_dtype(search_param),
         )
         return cagra.search(index, queries, k, sp, res=res)
 

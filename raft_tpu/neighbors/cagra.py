@@ -87,6 +87,12 @@ class SearchParams:
     max_iterations: int = 0  # 0 → auto heuristic (search_plan.cuh:31-123)
     num_random_samplings: int = 1
     rand_xor_mask: int = 0x128394
+    #: None = fp32-accurate scan. "bfloat16" gathers beam candidates from a
+    #: cached bf16 dataset copy (half the HBM gather bytes, single MXU pass)
+    #: and exactly re-ranks the final buffer in fp32 — the TPU analog of the
+    #: reference's half-precision compute_distance teams
+    #: (detail/cagra/compute_distance.hpp).
+    scan_dtype: Optional[object] = None
 
 
 class Index:
@@ -96,6 +102,12 @@ class Index:
         self.params = params
         self.dataset = dataset  # [n, dim]
         self.graph = graph  # [n, graph_degree] int32
+        self._dataset_bf16 = None  # lazy bf16 copy for scan_dtype searches
+
+    def ensure_scan_dataset(self):
+        if self._dataset_bf16 is None:
+            self._dataset_bf16 = self.dataset.astype(jnp.bfloat16)
+        return self._dataset_bf16
 
     @property
     def metric(self) -> DistanceType:
@@ -299,24 +311,28 @@ def _build_knn_graph_ivf_pq(dataset, k_inter: int, params: IndexParams,
 @functools.partial(
     jax.jit,
     static_argnames=("metric", "k", "itopk", "width", "max_iter",
-                     "has_filter"),
+                     "has_filter", "fast_scan"),
 )
-def _search_jit(queries, dataset, graph, seed_ids, filter_words,
+def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
                 metric: DistanceType, k: int, itopk: int, width: int,
-                max_iter: int, has_filter: bool = False):
+                max_iter: int, has_filter: bool = False,
+                fast_scan: bool = False):
     nq, dim = queries.shape
     n, degree = graph.shape
     minimize = metric != DistanceType.InnerProduct
     bad = jnp.inf
 
     qf = queries.astype(jnp.float32)
+    # fast scan: bf16 query + bf16 gathered rows → gathered_distances picks
+    # the single-pass MXU einsum (its HIGHEST request is fp32-data-only)
+    q_scan = qf.astype(jnp.bfloat16) if fast_scan else qf
     # distances are minimized internally; IP negates, L2Sqrt defers the sqrt
     inner_metric = (DistanceType.L2Expanded
                     if metric == DistanceType.L2SqrtExpanded else metric)
 
     def dists_to(ids):  # ids [nq, C] → [nq, C] (minimized quantity)
-        vecs = dataset[jnp.maximum(ids, 0)]
-        d = gathered_distances(qf, vecs, inner_metric)
+        vecs = scan_data[jnp.maximum(ids, 0)]
+        d = gathered_distances(q_scan, vecs, inner_metric)
         if metric == DistanceType.InnerProduct:
             d = -d
         if has_filter:
@@ -382,7 +398,18 @@ def _search_jit(queries, dataset, graph, seed_ids, filter_words,
     buf_ids, buf_d, buf_fl, _ = jax.lax.fori_loop(
         0, max_iter, body, (buf_ids, buf_d, buf_fl, done0))
 
-    out_d, out_i = buf_d[:, :k], buf_ids[:, :k]
+    if fast_scan:
+        # exact fp32 re-rank of the whole itopk buffer (nq×itopk×dim — tiny
+        # next to the beam walk) so returned order/distances are exact
+        vecs = dataset[jnp.maximum(buf_ids, 0)]
+        ex = gathered_distances(qf, vecs, inner_metric)
+        if metric == DistanceType.InnerProduct:
+            ex = -ex
+        ex = jnp.where(buf_ids < 0, bad, ex)
+        ex, sel = jax.lax.top_k(-ex, k)
+        out_d, out_i = -ex, jnp.take_along_axis(buf_ids, sel, axis=1)
+    else:
+        out_d, out_i = buf_d[:, :k], buf_ids[:, :k]
     if metric == DistanceType.InnerProduct:
         out_d = -out_d
     elif metric == DistanceType.L2SqrtExpanded:
@@ -427,10 +454,20 @@ def search(
                              queries.shape[0])
     seed_ids = jax.random.randint(
         key, (queries.shape[0], n_seeds), 0, index.size, jnp.int32)
+    fast_scan = params.scan_dtype is not None
+    if fast_scan:
+        if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"scan_dtype={params.scan_dtype!r}: only bfloat16 is "
+                "supported")
+        if index.dataset.dtype != jnp.float32:
+            raise ValueError("scan_dtype requires an fp32 dataset")
+    scan_data = index.ensure_scan_dataset() if fast_scan else index.dataset
     return _search_jit(
-        queries, index.dataset, index.graph, seed_ids,
+        queries, index.dataset, scan_data, index.graph, seed_ids,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
-        index.metric, int(k), itopk, width, max_iter, filter is not None)
+        index.metric, int(k), itopk, width, max_iter, filter is not None,
+        fast_scan)
 
 
 _SERIAL_VERSION = 1

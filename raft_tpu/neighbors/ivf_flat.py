@@ -375,8 +375,10 @@ def search(
         q_tile -= q_tile % 8
     from raft_tpu.ops import pallas_kernels as pk
 
-    use_pallas = pk.pallas_enabled()
     fast_scan = params.scan_dtype is not None
+    # an explicit bf16 request wins over the env-gated Pallas fp32 scan —
+    # never silently benchmark fp32 under a bf16 label
+    use_pallas = pk.pallas_enabled() and not fast_scan
     if fast_scan:
         if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
             raise ValueError(

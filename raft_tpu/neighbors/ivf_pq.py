@@ -91,9 +91,11 @@ class IndexParams:
 
 @dataclasses.dataclass
 class SearchParams:
-    """reference: ivf_pq_types.hpp:110-146 search_params. ``lut_dtype`` /
-    ``internal_distance_dtype`` accept jnp.float32 or jnp.bfloat16 (the
-    reference's fp16/fp8 LUT compression maps to bf16 on TPU)."""
+    """reference: ivf_pq_types.hpp:110-146 search_params. ``lut_dtype``
+    accepts jnp.float32, jnp.bfloat16, or jnp.float8_e4m3fn/e5m2 (fp8 LUTs
+    are stored max-abs-scaled per subspace, the fp_8bit analog —
+    detail/ivf_pq_fp_8bit.cuh); ``internal_distance_dtype`` accepts
+    jnp.float32 or jnp.bfloat16."""
 
     n_probes: int = 20
     lut_dtype: object = jnp.float32
@@ -770,7 +772,15 @@ def _search_jit(queries, centers, rotation, codebooks, list_codes,
             qn = jnp.sum(qr_res * qr_res, -1)  # [t, P]
             lut = cbn - 2.0 * dots
             base = qn
-        lut = lut.astype(lut_dtype)
+        if str(lut_dtype) in ("float8_e4m3fn", "float8_e5m2"):
+            # fp8 LUT with per-subspace max-abs scaling (the reference's
+            # fp_8bit offset/scale normalization, detail/ivf_pq_fp_8bit.cuh)
+            lut_scale = jnp.maximum(
+                jnp.max(jnp.abs(lut), axis=-1), 1e-30)  # [t, P, s]
+            lut = (lut / lut_scale[..., None]).astype(lut_dtype)
+        else:
+            lut_scale = None
+            lut = lut.astype(lut_dtype)
 
         # ---- gather probed lists and scan codes
         g_codes = list_codes[probes]  # [t, P, pad, n_bytes] u8
@@ -780,11 +790,16 @@ def _search_jit(queries, centers, rotation, codebooks, list_codes,
         # flat-LUT gather: score contribution LUT[t,P,s,code]
         flat_lut = lut.reshape(qt.shape[0], n_probes, pq_dim * book)
         gidx = codes + (jnp.arange(pq_dim) * book)[None, None, None, :]
+        gather_dtype = dist_dtype if lut_scale is None else flat_lut.dtype
         contrib = jnp.take_along_axis(
-            flat_lut[:, :, None, :].astype(dist_dtype),
+            flat_lut[:, :, None, :].astype(gather_dtype),
             gidx.reshape(qt.shape[0], n_probes, list_pad * pq_dim)[:, :, None, :],
             axis=-1,
         ).reshape(qt.shape[0], n_probes, list_pad, pq_dim)
+        if lut_scale is not None:
+            # de-scale fp8 contributions per subspace before accumulating
+            contrib = contrib.astype(dist_dtype) * lut_scale[
+                :, :, None, :].astype(dist_dtype)
         d = jnp.sum(contrib.astype(dist_dtype), axis=-1).astype(jnp.float32)
         d = d + base[:, :, None]
 

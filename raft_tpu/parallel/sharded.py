@@ -166,6 +166,12 @@ class ShardedCagra:
         self.metric = metric
         self.n_rows = n_rows
         self.bounds = bounds  # [S + 1] row offsets per shard
+        self._datasets_bf16 = None  # lazy bf16 copies for scan_dtype
+
+    def ensure_scan_datasets(self):
+        if self._datasets_bf16 is None:
+            self._datasets_bf16 = self.datasets.astype(jnp.bfloat16)
+        return self._datasets_bf16
 
 
 def build_cagra(
@@ -238,16 +244,24 @@ def search_cagra(
     key = jax.random.fold_in(
         jax.random.key(params.rand_xor_mask & 0x7FFFFFFF), nq)
     empty = jnp.zeros((0,), jnp.uint32)
+    fast_scan = getattr(params, "scan_dtype", None) is not None
+    if fast_scan:
+        if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
+            raise ValueError(
+                f"scan_dtype={params.scan_dtype!r}: only bfloat16 is "
+                "supported")
+        if index.datasets.dtype != jnp.float32:
+            raise ValueError("scan_dtype requires an fp32 dataset")
 
-    def local(q_rep, ds, gr, n_valid, b):
+    def local(q_rep, ds, sds, gr, n_valid, b):
         # per-shard seeds within the shard's valid rows
         rank = comms.rank()
         seeds = jax.random.randint(
             jax.random.fold_in(key, rank), (q_rep.shape[0], n_seeds), 0,
             jnp.maximum(n_valid[0], 1), jnp.int32)
         v, i = cagra._search_jit(
-            q_rep, ds[0], gr[0], seeds, empty, index.metric, int(k),
-            itopk, width, max_iter, False)
+            q_rep, ds[0], sds[0], gr[0], seeds, empty, index.metric, int(k),
+            itopk, width, max_iter, False, fast_scan)
         # local → global ids; mask out padding rows
         pad_hit = (i < 0) | (i >= n_valid[0])
         gid = jnp.where(pad_hit, -1, i + b[0])
@@ -260,10 +274,13 @@ def search_cagra(
     ax = comms.axis
     fn = comms.run(
         local,
-        (P(None, None), P(ax, None, None), P(ax, None, None), P(ax), P(ax)),
+        (P(None, None), P(ax, None, None), P(ax, None, None),
+         P(ax, None, None), P(ax), P(ax)),
         (P(None, None), P(None, None)))
     q = comms.shard(queries, P(None, None))
-    return jax.jit(fn)(q, index.datasets, index.graphs,
+    # bf16 scan copies are cached on the index (one cast, reused per search)
+    scan_ds = index.ensure_scan_datasets() if fast_scan else index.datasets
+    return jax.jit(fn)(q, index.datasets, scan_ds, index.graphs,
                        comms.shard(shard_rows, P(ax)),
                        comms.shard(base, P(ax)))
 

@@ -142,3 +142,19 @@ def test_cagra_filtered_search(rng):
     valid = i >= 0
     assert valid.any()
     assert mask[i[valid]].all()
+
+
+def test_search_bf16_fast_scan(built, data, gt):
+    """bf16 beam-walk gathers + exact fp32 buffer re-rank: recall close to
+    the fp32 walk; returned distances exact for the returned ids."""
+    db, q = data
+    sp = cagra.SearchParams(itopk_size=64, search_width=2,
+                            scan_dtype="bfloat16")
+    d, i = cagra.search(built, q, 10, sp)
+    recall = float(neighborhood_recall(np.asarray(i), gt))
+    assert recall >= 0.88, f"bf16 recall {recall}"
+    d, i = np.asarray(d), np.asarray(i)
+    true = ((q[:, None, :] - db[i]) ** 2).sum(-1)
+    np.testing.assert_allclose(d, true, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="bfloat16"):
+        cagra.search(built, q, 10, cagra.SearchParams(scan_dtype="float16"))

@@ -255,3 +255,23 @@ def test_low_bit_end_to_end(data, gt, pq_bits):
     _, refined = refine_mod.refine(db, q, np.asarray(cand), 10)
     rec_ref = float(neighborhood_recall(np.asarray(refined), gt))
     assert rec_ref >= rec + 0.1, f"refine didn't recover: {rec}→{rec_ref}"
+
+
+def test_fp8_lut(data, gt):
+    """fp8 LUT (max-abs scaled per subspace, fp_8bit analog) holds recall
+    within a few points of the fp32 LUT on the forced-LUT path."""
+    from raft_tpu import Resources
+
+    db, q = data
+    params = ivf_pq.IndexParams(n_lists=32, pq_dim=16)
+    index = ivf_pq.build(db, params, res=Resources(seed=11))
+    recalls = {}
+    for lut in (jnp.float32, jnp.float8_e4m3fn):
+        sp = ivf_pq.SearchParams(n_probes=32, lut_dtype=lut,
+                                 scan_mode="lut")
+        _, i = ivf_pq.search(index, q, 10, sp)
+        recalls[str(lut)] = float(
+            neighborhood_recall(np.asarray(i), gt))
+    assert recalls["<class 'jax.numpy.float8_e4m3fn'>"] >= \
+        recalls["<class 'jax.numpy.float32'>"] - 0.05
+    assert recalls["<class 'jax.numpy.float8_e4m3fn'>"] >= 0.7
