@@ -29,6 +29,7 @@ _SO = os.path.join(_HERE, "libraft_tpu_native.so")
 _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
+_has_prefetch = False
 
 
 def ensure_built(force: bool = False) -> bool:
@@ -45,7 +46,8 @@ def ensure_built(force: bool = False) -> bool:
         return False
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             _SRC, "-o", _SO],
             check=True, capture_output=True, timeout=120)
         return True
     except Exception:
@@ -91,6 +93,20 @@ def _get_lib():
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         lib.pack_lists.restype = ctypes.c_int
+        global _has_prefetch
+        try:
+            # newer symbols: a stale .so built before they existed must not
+            # take down the whole native layer — degrade to the sync reader
+            lib.prefetch_open.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64]
+            lib.prefetch_open.restype = ctypes.c_void_p
+            lib.prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.prefetch_next.restype = ctypes.c_int64
+            lib.prefetch_close.argtypes = [ctypes.c_void_p]
+            lib.prefetch_close.restype = None
+            _has_prefetch = True
+        except AttributeError:
+            _has_prefetch = False
         _lib = lib
         return _lib
 
@@ -174,6 +190,37 @@ def iter_bin_batches(path: str, batch_rows: int, dtype=None):
     total, _ = read_bin_header(path)
     for s in range(0, total, batch_rows):
         yield s, read_bin(path, s, min(batch_rows, total - s), dtype)
+
+
+def iter_bin_batches_prefetch(path: str, batch_rows: int, dtype=None):
+    """Like :func:`iter_bin_batches` but IO-overlapped: a native reader
+    thread preads batch i+1 while the consumer processes batch i (the
+    reference bench harness's mmap+thread-pool staging role). Falls back to
+    the synchronous iterator when the native library is unavailable."""
+    lib = _get_lib()
+    dt = _dtype_for(path, dtype)
+    if lib is None or not _has_prefetch:
+        yield from iter_bin_batches(path, batch_rows, dt)
+        return
+    total, dim = read_bin_header(path)
+    handle = lib.prefetch_open(path.encode(), batch_rows, dt.itemsize)
+    if not handle:
+        yield from iter_bin_batches(path, batch_rows, dt)
+        return
+    try:
+        start = 0
+        while True:
+            buf = np.empty((batch_rows, dim), dt)
+            rows = lib.prefetch_next(
+                handle, buf.ctypes.data_as(ctypes.c_void_p))
+            if rows == 0:
+                break
+            if rows < 0:
+                raise IOError(f"prefetch_next({path}) failed rc={rows}")
+            yield start, buf[:rows]
+            start += rows
+    finally:
+        lib.prefetch_close(handle)
 
 
 # -------------------------------------------------------------- hnsw export

@@ -27,6 +27,42 @@
 #include <vector>
 #include <algorithm>
 #include <numeric>
+#include <thread>
+#include <mutex>
+#include <condition_variable>
+
+namespace {
+
+// Double-buffered background batch reader: a worker thread preads batch
+// i+1 while the consumer processes batch i — the role of the reference
+// bench harness's mmap'd dataset + thread pool (bench/ann/src/common/
+// dataset.hpp, thread_pool.hpp) for streaming larger-than-memory builds.
+struct Prefetcher {
+  int fd = -1;
+  int64_t n_rows = 0, dim = 0, elem = 0, batch_rows = 0, n_batches = 0;
+  std::thread worker;
+  std::mutex m;
+  std::condition_variable cv;
+  std::vector<std::vector<char>> bufs;
+  std::vector<int64_t> buf_rows;  // rows in slot, -1 = empty
+  int64_t consumed = 0;
+  bool stop = false;
+  int err = 0;
+};
+
+// pread until `want` bytes land (short reads are routine: 2 GiB syscall
+// cap, EINTR, network filesystems). Returns false on EOF/error.
+bool pread_fully(int fd, char* out, int64_t want, int64_t off) {
+  int64_t done = 0;
+  while (done < want) {
+    ssize_t got = pread(fd, out + done, want - done, off + done);
+    if (got <= 0) return false;
+    done += got;
+  }
+  return true;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -62,14 +98,9 @@ int bin_read_rows(const char* path, int64_t row_start, int64_t n_rows,
   const int64_t row_bytes = dim * elem_size;
   const int64_t off = 8 + row_start * row_bytes;
   const int64_t want = n_rows * row_bytes;
-  int64_t done = 0;
-  while (done < want) {
-    ssize_t got = pread(fd, (char*)out + done, want - done, off + done);
-    if (got <= 0) {
-      close(fd);
-      return -3;
-    }
-    done += got;
+  if (!pread_fully(fd, (char*)out, want, off)) {
+    close(fd);
+    return -3;
   }
   close(fd);
   return 0;
@@ -236,6 +267,105 @@ int pack_lists(const char* rows, const int32_t* labels, const int32_t* ids,
     for (int64_t p = cursor[l]; p < list_pad; ++p)
       out_ids[l * list_pad + p] = -1;
   return 0;
+}
+
+// ------------------------------------------------------- batch prefetcher
+
+void* prefetch_open(const char* path, int64_t batch_rows,
+                    int64_t elem_size) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  int32_t hdr[2];
+  if (pread(fd, hdr, sizeof(hdr), 0) != (ssize_t)sizeof(hdr)) {
+    close(fd);
+    return nullptr;
+  }
+  // validate the header before sizing buffers: a corrupt file must fail
+  // with a catchable Python error, not a C++ exception crossing the C ABI
+  if (hdr[0] < 0 || hdr[1] <= 0 || batch_rows <= 0 || elem_size <= 0 ||
+      (int64_t)hdr[1] * elem_size > (int64_t)1 << 40) {
+    close(fd);
+    return nullptr;
+  }
+  auto* p = new Prefetcher();
+  p->fd = fd;
+  p->n_rows = hdr[0];
+  p->dim = hdr[1];
+  p->elem = elem_size;
+  p->batch_rows = batch_rows;
+  p->n_batches = (p->n_rows + batch_rows - 1) / batch_rows;
+  const int depth = 2;
+  try {
+    p->bufs.resize(depth);
+    p->buf_rows.assign(depth, -1);
+    for (auto& b : p->bufs)
+      b.resize((size_t)batch_rows * p->dim * elem_size);
+  } catch (...) {  // bad_alloc on absurd batch sizes
+    close(fd);
+    delete p;
+    return nullptr;
+  }
+  p->worker = std::thread([p, depth]() {
+    for (int64_t bi = 0; bi < p->n_batches; ++bi) {
+      int slot = (int)(bi % depth);
+      {
+        std::unique_lock<std::mutex> lk(p->m);
+        p->cv.wait(lk, [&] { return p->stop || p->buf_rows[slot] < 0; });
+        if (p->stop) return;
+      }
+      int64_t start = bi * p->batch_rows;
+      int64_t rows = std::min(p->batch_rows, p->n_rows - start);
+      int64_t bytes = rows * p->dim * p->elem;
+      int64_t off = 8 + start * p->dim * p->elem;
+      bool ok = pread_fully(p->fd, p->bufs[slot].data(), bytes, off);
+      std::lock_guard<std::mutex> lk(p->m);
+      if (!ok) {
+        p->err = -3;
+        p->buf_rows[slot] = 0;
+      } else {
+        p->buf_rows[slot] = rows;
+      }
+      p->cv.notify_all();
+      if (p->err) return;
+    }
+  });
+  return p;
+}
+
+// Copies the next batch into out (caller-allocated, batch_rows*dim*elem).
+// Returns rows copied, 0 at EOF, <0 on read error.
+int64_t prefetch_next(void* handle, void* out) {
+  auto* p = (Prefetcher*)handle;
+  const int depth = (int)p->bufs.size();
+  if (p->consumed >= p->n_batches) return 0;
+  int slot = (int)(p->consumed % depth);
+  int64_t rows;
+  {
+    std::unique_lock<std::mutex> lk(p->m);
+    p->cv.wait(lk, [&] { return p->buf_rows[slot] >= 0 || p->err; });
+    if (p->err) return p->err;
+    rows = p->buf_rows[slot];
+  }
+  std::memcpy(out, p->bufs[slot].data(), (size_t)rows * p->dim * p->elem);
+  {
+    std::lock_guard<std::mutex> lk(p->m);
+    p->buf_rows[slot] = -1;
+    p->consumed++;
+    p->cv.notify_all();
+  }
+  return rows;
+}
+
+void prefetch_close(void* handle) {
+  auto* p = (Prefetcher*)handle;
+  {
+    std::lock_guard<std::mutex> lk(p->m);
+    p->stop = true;
+    p->cv.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  close(p->fd);
+  delete p;
 }
 
 }  // extern "C"

@@ -67,11 +67,10 @@ def _labels_pass(path: str, centers, metric, batch_rows: int, dtype,
     n, _ = native.read_bin_header(path)
     km = KMeansBalancedParams(metric=metric)
     labels = np.empty(n, np.int32)
-    for start in range(0, n, batch_rows):
-        rows = min(batch_rows, n - start)
-        batch = native.read_bin(path, start, rows, dtype=dtype)
+    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
+                                                         dtype):
         lb = kmeans_balanced.predict(centers, jnp.asarray(batch), km, res=res)
-        labels[start:start + rows] = np.asarray(lb, np.int32)
+        labels[start:start + len(batch)] = np.asarray(lb, np.int32)
     return labels
 
 
@@ -129,9 +128,9 @@ def build_ivf_flat_from_file(path: str, params=None,
     data = np.zeros((params.n_lists, pad, dim), first.dtype)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
-    for start in range(0, n, batch_rows):
-        rows = min(batch_rows, n - start)
-        batch = native.read_bin(path, start, rows, dtype=dtype)
+    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
+                                                         dtype):
+        rows = len(batch)
         lb = labels[start:start + rows]
         pos, cnt = _scatter_positions(lb, offsets)
         data[lb, pos] = batch
@@ -181,9 +180,9 @@ def build_ivf_pq_from_file(path: str, params=None,
     codes = np.zeros((params.n_lists, pad, packed_width), np.uint8)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
-    for start in range(0, n, batch_rows):
-        rows = min(batch_rows, n - start)
-        batch = native.read_bin(path, start, rows, dtype=dtype)
+    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
+                                                         dtype):
+        rows = len(batch)
         lb = labels[start:start + rows]
         packed = ivf_pq.encode_batch(index, batch, lb, res)
         pos, cnt = _scatter_positions(lb, offsets)

@@ -212,3 +212,16 @@ def test_pallas_ivf_scan_interpret(rng):
                   - 2.0 * dec[probes[i, j]] @ qres[i, j]
                   for j in range(P)]) for i in range(nq)])
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_device_ndarray_torch_interop():
+    """pylibraft's cai_wrapper role: foreign-framework tensors (torch CPU)
+    convert through device_ndarray/to_host without copying semantics
+    surprises."""
+    torch = pytest.importorskip("torch")
+    from raft_tpu import common
+
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    a = common.device_ndarray(t)
+    assert a.shape == (3, 4)
+    np.testing.assert_array_equal(common.to_host(a), t.numpy())

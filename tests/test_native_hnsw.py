@@ -102,3 +102,20 @@ def test_hnswlib_python_fallback_writer(tmp_path, rng):
     native._hnswlib_write_py(p2, db, graph)
     with open(p1, "rb") as f1, open(p2, "rb") as f2:
         assert f1.read() == f2.read(), "C++ and python writers must agree"
+
+
+def test_prefetch_iterator_matches_sync(tmp_path):
+    """Native double-buffered reader yields identical batches to the
+    synchronous iterator, including the ragged tail."""
+    from raft_tpu import native
+
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((1037, 12)).astype(np.float32)
+    path = str(tmp_path / "pf.fbin")
+    native.write_bin(path, data)
+    sync = list(native.iter_bin_batches(path, 128))
+    pre = list(native.iter_bin_batches_prefetch(path, 128))
+    assert len(sync) == len(pre)
+    for (s0, b0), (s1, b1) in zip(sync, pre):
+        assert s0 == s1
+        np.testing.assert_array_equal(b0, b1)
