@@ -97,9 +97,10 @@ def _get_lib():
         try:
             # newer symbols: a stale .so built before they existed must not
             # take down the whole native layer — degrade to the sync reader
-            lib.prefetch_open.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64]
-            lib.prefetch_open.restype = ctypes.c_void_p
+            lib.prefetch_open_v2.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64]
+            lib.prefetch_open_v2.restype = ctypes.c_void_p
             lib.prefetch_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.prefetch_next.restype = ctypes.c_int64
             lib.prefetch_close.argtypes = [ctypes.c_void_p]
@@ -192,23 +193,32 @@ def iter_bin_batches(path: str, batch_rows: int, dtype=None):
         yield s, read_bin(path, s, min(batch_rows, total - s), dtype)
 
 
-def iter_bin_batches_prefetch(path: str, batch_rows: int, dtype=None):
+def iter_bin_batches_prefetch(path: str, batch_rows: int, dtype=None,
+                              row_range=None):
     """Like :func:`iter_bin_batches` but IO-overlapped: a native reader
     thread preads batch i+1 while the consumer processes batch i (the
     reference bench harness's mmap+thread-pool staging role). Falls back to
-    the synchronous iterator when the native library is unavailable."""
+    the synchronous iterator when the native library is unavailable.
+    ``row_range=(lo, hi)`` streams only that row span (shard builds);
+    yielded offsets are file-absolute."""
     lib = _get_lib()
     dt = _dtype_for(path, dtype)
-    if lib is None or not _has_prefetch:
-        yield from iter_bin_batches(path, batch_rows, dt)
-        return
     total, dim = read_bin_header(path)
-    handle = lib.prefetch_open(path.encode(), batch_rows, dt.itemsize)
+    lo, hi = (0, total) if row_range is None else row_range
+    lo = int(lo)
+    hi = int(max(lo, min(hi, total)))  # empty range behaves like the sync path
+    if lib is None or not _has_prefetch:
+        for s in range(lo, hi, batch_rows):
+            yield s, read_bin(path, s, min(batch_rows, hi - s), dt)
+        return
+    handle = lib.prefetch_open_v2(path.encode(), batch_rows, dt.itemsize,
+                                  lo, hi - lo)
     if not handle:
-        yield from iter_bin_batches(path, batch_rows, dt)
+        for s in range(lo, hi, batch_rows):
+            yield s, read_bin(path, s, min(batch_rows, hi - s), dt)
         return
     try:
-        start = 0
+        start = lo
         while True:
             buf = np.empty((batch_rows, dim), dt)
             rows = lib.prefetch_next(

@@ -40,6 +40,7 @@ namespace {
 struct Prefetcher {
   int fd = -1;
   int64_t n_rows = 0, dim = 0, elem = 0, batch_rows = 0, n_batches = 0;
+  int64_t row0 = 0;
   std::thread worker;
   std::mutex m;
   std::condition_variable cv;
@@ -271,8 +272,12 @@ int pack_lists(const char* rows, const int32_t* labels, const int32_t* ids,
 
 // ------------------------------------------------------- batch prefetcher
 
-void* prefetch_open(const char* path, int64_t batch_rows,
-                    int64_t elem_size) {
+// row_start/row_limit bound the streamed range (row_limit<0 = to EOF).
+// _v2 suffix: the signature was widened from the first release; a distinct
+// symbol keeps a stale old-ABI .so from silently ignoring the range args.
+void* prefetch_open_v2(const char* path, int64_t batch_rows,
+                       int64_t elem_size, int64_t row_start,
+                       int64_t row_limit) {
   int fd = open(path, O_RDONLY);
   if (fd < 0) return nullptr;
   int32_t hdr[2];
@@ -287,9 +292,17 @@ void* prefetch_open(const char* path, int64_t batch_rows,
     close(fd);
     return nullptr;
   }
+  int64_t total = hdr[0];
+  if (row_start < 0 || row_start > total) {
+    close(fd);
+    return nullptr;
+  }
+  int64_t avail = total - row_start;
+  int64_t n = (row_limit < 0 || row_limit > avail) ? avail : row_limit;
   auto* p = new Prefetcher();
   p->fd = fd;
-  p->n_rows = hdr[0];
+  p->n_rows = n;
+  p->row0 = row_start;
   p->dim = hdr[1];
   p->elem = elem_size;
   p->batch_rows = batch_rows;
@@ -316,7 +329,7 @@ void* prefetch_open(const char* path, int64_t batch_rows,
       int64_t start = bi * p->batch_rows;
       int64_t rows = std::min(p->batch_rows, p->n_rows - start);
       int64_t bytes = rows * p->dim * p->elem;
-      int64_t off = 8 + start * p->dim * p->elem;
+      int64_t off = 8 + (p->row0 + start) * p->dim * p->elem;
       bool ok = pread_fully(p->fd, p->bufs[slot].data(), bytes, off);
       std::lock_guard<std::mutex> lk(p->m);
       if (!ok) {

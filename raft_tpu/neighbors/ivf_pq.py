@@ -856,7 +856,19 @@ def search(
     list_pad = index.list_codes.shape[1]
     if params.scan_mode not in ("auto", "cache", "lut"):
         raise ValueError(f"unknown scan_mode: {params.scan_mode}")
-    if params.scan_mode in ("auto", "cache"):
+    scan_mode = params.scan_mode
+    if scan_mode == "auto":
+        # The decoded cache holds rot_dim values/row (e.g. 2·rot bytes at
+        # bf16) — at DEEP-100M scale that outgrows HBM while the packed
+        # codes still fit. Fall back to the memory-lean LUT engine when the
+        # cache estimate exceeds the device workspace's notion of headroom
+        # (4× workspace ≈ the non-scratch HBM share).
+        cache_bytes = (index.n_lists * list_pad * index.rot_dim
+                       * jnp.dtype(params.scan_cache_dtype).itemsize
+                       + index.n_lists * list_pad * 4)
+        if cache_bytes > 4 * res.workspace_limit_bytes:
+            scan_mode = "lut"
+    if scan_mode in ("auto", "cache"):
         ensure_scan_cache(index, params.scan_cache_dtype)
         rot_dim = index.rot_dim
         # workspace: gathered decoded cache [t,P,pad,rot] bf16 + dists

@@ -34,19 +34,23 @@ from raft_tpu.utils.shape import round_up_to
 
 
 def sample_rows_from_file(path: str, n_sample: int, seed: int = 0,
-                          dtype=None, batch_rows: int = 1 << 18
-                          ) -> np.ndarray:
+                          dtype=None, batch_rows: int = 1 << 18,
+                          row_range=None) -> np.ndarray:
     """Uniform-ish strided row sample without loading the file: reads
     contiguous chunks and keeps an evenly spaced subset of each (the
-    trainset subsample of detail/ivf_pq_build.cuh:1759, host-streamed)."""
-    n, dim = native.read_bin_header(path)
+    trainset subsample of detail/ivf_pq_build.cuh:1759, host-streamed).
+    ``row_range=(lo, hi)`` samples only that span (per-shard builds)."""
+    total, dim = native.read_bin_header(path)
+    lo, hi = (0, total) if row_range is None else row_range
+    lo, hi = int(lo), int(min(hi, total))
+    n = hi - lo
     n_sample = min(int(n_sample), n)
     out = []
     taken = 0
     rng = np.random.default_rng(seed)
-    for start in range(0, n, batch_rows):
-        rows = min(batch_rows, n - start)
-        want = int(round(n_sample * (start + rows) / n)) - taken
+    for start in range(lo, hi, batch_rows):
+        rows = min(batch_rows, hi - start)
+        want = int(round(n_sample * (start + rows - lo) / n)) - taken
         if want <= 0:
             continue
         batch = native.read_bin(path, start, rows, dtype=dtype)
@@ -62,15 +66,18 @@ def sample_rows_from_file(path: str, n_sample: int, seed: int = 0,
 
 
 def _labels_pass(path: str, centers, metric, batch_rows: int, dtype,
-                 res: Resources) -> np.ndarray:
-    """Pass A: stream batches through the coarse quantizer → labels [n]."""
-    n, _ = native.read_bin_header(path)
+                 res: Resources, row_range=None) -> np.ndarray:
+    """Pass A: stream batches through the coarse quantizer → labels
+    [hi - lo] (offset-local when a row_range is given)."""
+    total, _ = native.read_bin_header(path)
+    lo, hi = (0, total) if row_range is None else row_range
     km = KMeansBalancedParams(metric=metric)
-    labels = np.empty(n, np.int32)
-    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
-                                                         dtype):
+    labels = np.empty(int(hi) - int(lo), np.int32)
+    for start, batch in native.iter_bin_batches_prefetch(
+            path, batch_rows, dtype, row_range=row_range):
+        s = start - int(lo)
         lb = kmeans_balanced.predict(centers, jnp.asarray(batch), km, res=res)
-        labels[start:start + len(batch)] = np.asarray(lb, np.int32)
+        labels[s:s + len(batch)] = np.asarray(lb, np.int32)
     return labels
 
 
@@ -93,17 +100,23 @@ def _scatter_positions(lb: np.ndarray, offsets: np.ndarray
 def build_ivf_flat_from_file(path: str, params=None,
                              res: Optional[Resources] = None,
                              batch_rows: int = 1 << 18, dtype=None,
-                             max_train_rows: Optional[int] = None):
+                             max_train_rows: Optional[int] = None,
+                             row_range=None):
     """Streamed IVF-Flat build from an fbin file → ivf_flat.Index.
 
     The dataset is read twice (labels pass + fill pass) in ``batch_rows``
     chunks; peak host memory is the final padded list storage + one batch.
+    ``row_range=(lo, hi)`` builds over that span only, with file-absolute
+    row ids (per-shard MNMG builds).
     """
     from raft_tpu.neighbors import ivf_flat
 
     params = params or ivf_flat.IndexParams()
     res = ensure_resources(res)
-    n, dim = native.read_bin_header(path)
+    total, dim = native.read_bin_header(path)
+    lo, hi = (0, total) if row_range is None else row_range
+    lo, hi = int(lo), int(min(hi, total))
+    n = hi - lo
     if params.n_lists > n:
         raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
 
@@ -111,7 +124,8 @@ def build_ivf_flat_from_file(path: str, params=None,
     if max_train_rows is not None:
         n_train = min(n_train, int(max_train_rows))
     trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
-                                     batch_rows=batch_rows)
+                                     batch_rows=batch_rows,
+                                     row_range=(lo, hi))
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric=params.metric)
     centers = kmeans_balanced.fit(res.next_key(),
@@ -120,7 +134,7 @@ def build_ivf_flat_from_file(path: str, params=None,
     del trainset
 
     labels = _labels_pass(path, centers, params.metric, batch_rows, dtype,
-                          res)
+                          res, row_range=(lo, hi))
     sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
     pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
 
@@ -128,10 +142,10 @@ def build_ivf_flat_from_file(path: str, params=None,
     data = np.zeros((params.n_lists, pad, dim), first.dtype)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
-    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
-                                                         dtype):
+    for start, batch in native.iter_bin_batches_prefetch(
+            path, batch_rows, dtype, row_range=(lo, hi)):
         rows = len(batch)
-        lb = labels[start:start + rows]
+        lb = labels[start - lo:start - lo + rows]
         pos, cnt = _scatter_positions(lb, offsets)
         data[lb, pos] = batch
         idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)
@@ -144,7 +158,8 @@ def build_ivf_flat_from_file(path: str, params=None,
 def build_ivf_pq_from_file(path: str, params=None,
                            res: Optional[Resources] = None,
                            batch_rows: int = 1 << 18, dtype=None,
-                           max_train_rows: Optional[int] = None):
+                           max_train_rows: Optional[int] = None,
+                           row_range=None):
     """Streamed IVF-PQ build from an fbin file → ivf_pq.Index.
 
     Training (coarse centers, rotation, codebooks) runs on a row sample via
@@ -156,7 +171,10 @@ def build_ivf_pq_from_file(path: str, params=None,
 
     params = params or ivf_pq.IndexParams()
     res = ensure_resources(res)
-    n, dim = native.read_bin_header(path)
+    total, dim = native.read_bin_header(path)
+    lo, hi = (0, total) if row_range is None else row_range
+    lo, hi = int(lo), int(min(hi, total))
+    n = hi - lo
     if params.n_lists > n:
         raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
 
@@ -164,7 +182,8 @@ def build_ivf_pq_from_file(path: str, params=None,
     if max_train_rows is not None:
         n_train = min(n_train, int(max_train_rows))
     trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
-                                     batch_rows=batch_rows)
+                                     batch_rows=batch_rows,
+                                     row_range=(lo, hi))
     train_params = dataclasses.replace(params, kmeans_trainset_fraction=1.0,
                                        add_data_on_build=False)
     index = ivf_pq.build(np.asarray(trainset, np.float32), train_params,
@@ -172,7 +191,7 @@ def build_ivf_pq_from_file(path: str, params=None,
     del trainset
 
     labels = _labels_pass(path, index.centers, params.metric, batch_rows,
-                          dtype, res)
+                          dtype, res, row_range=(lo, hi))
     sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
     pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
     packed_width = index.pq_dim * index.pq_bits // 8
@@ -180,10 +199,10 @@ def build_ivf_pq_from_file(path: str, params=None,
     codes = np.zeros((params.n_lists, pad, packed_width), np.uint8)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
-    for start, batch in native.iter_bin_batches_prefetch(path, batch_rows,
-                                                         dtype):
+    for start, batch in native.iter_bin_batches_prefetch(
+            path, batch_rows, dtype, row_range=(lo, hi)):
         rows = len(batch)
-        lb = labels[start:start + rows]
+        lb = labels[start - lo:start - lo + rows]
         packed = ivf_pq.encode_batch(index, batch, lb, res)
         pos, cnt = _scatter_positions(lb, offsets)
         codes[lb, pos] = packed

@@ -275,3 +275,22 @@ def test_fp8_lut(data, gt):
     assert recalls["<class 'jax.numpy.float8_e4m3fn'>"] >= \
         recalls["<class 'jax.numpy.float32'>"] - 0.05
     assert recalls["<class 'jax.numpy.float8_e4m3fn'>"] >= 0.7
+
+
+def test_auto_scan_mode_respects_memory(data):
+    """scan_mode='auto' falls back to the LUT engine when the decoded cache
+    would not fit the device's memory headroom (DEEP-100M shape analog)."""
+    from raft_tpu import Resources
+
+    db, q = data
+    params = ivf_pq.IndexParams(n_lists=16, pq_dim=16)
+    index = ivf_pq.build(db, params, res=Resources(seed=4))
+    # tiny workspace → cache estimate exceeds 4× headroom → LUT engine,
+    # which leaves the decoded cache unbuilt
+    res = Resources(seed=4, workspace_limit_bytes=1 << 16)
+    _, i = ivf_pq.search(index, q, 10, ivf_pq.SearchParams(n_probes=16),
+                         res=res)
+    assert index.list_decoded is None
+    # generous workspace → cache engine builds its decoded slabs
+    _, i = ivf_pq.search(index, q, 10, ivf_pq.SearchParams(n_probes=16))
+    assert index.list_decoded is not None

@@ -7,6 +7,7 @@ import importlib
 import inspect
 import os
 import pkgutil
+import re
 import sys
 
 import jax
@@ -57,6 +58,7 @@ def main() -> None:
                     sig = str(inspect.signature(obj))
                 except (ValueError, TypeError):
                     sig = "(...)"
+                sig = re.sub(r" at 0x[0-9a-f]+", "", sig)
                 if len(sig) > 80:
                     sig = sig[:77] + "..."
                 pub.append(f"- `{attr}{sig}`")
