@@ -1,0 +1,89 @@
+"""Multi-chip (MNMG) tour: comms facade, sharded k-means, sharded indexes.
+
+The raft-dask deployment story on a TPU mesh (SURVEY.md §2.8): one SPMD
+program per search, candidates merged over ICI. Runs anywhere via a virtual
+device mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        PYTHONPATH=. python examples/sharded_mnmg.py
+"""
+
+import os
+import tempfile
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from raft_tpu import Resources, native
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.parallel import comms as comms_mod
+from raft_tpu.parallel import sharded
+from raft_tpu.stats import neighborhood_recall
+
+
+def main() -> None:
+    devices = jax.devices()
+    print(f"mesh: {len(devices)} × {devices[0].platform}")
+
+    # ---- bootstrap the comms fabric (raft-dask Comms.init analog)
+    comms = comms_mod.init_comms(devices, axis="data")
+    assert comms_mod.test_collective_allreduce(comms)
+    print(f"comms: size={comms.size}, collectives OK")
+
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((16_000, 64)).astype(np.float32)
+    queries = rng.standard_normal((100, 64)).astype(np.float32)
+    _, gt = brute_force.knn(queries, db, k=10, metric="sqeuclidean")
+    gt = np.asarray(gt)
+
+    def report(name, idx_arr):
+        r = float(neighborhood_recall(np.asarray(idx_arr), gt))
+        print(f"{name}: recall@10 = {r:.4f}")
+
+    # ---- sharded exact kNN: local scan + ICI top-k merge
+    d, i = sharded.knn(comms, queries, db, k=10, metric="sqeuclidean")
+    report("sharded exact kNN", i)
+
+    # ---- data-parallel balanced k-means (IVF coarse trainer)
+    centers, labels = sharded.kmeans_fit(comms, db, n_clusters=64, n_iters=5)
+    print(f"sharded k-means: centers {centers.shape}")
+
+    # ---- sharded IVF-Flat / IVF-PQ / CAGRA
+    fl = sharded.build_ivf_flat(comms, db, ivf_flat.IndexParams(n_lists=64))
+    _, i = sharded.search_ivf_flat(fl, queries, 10,
+                                   ivf_flat.SearchParams(n_probes=64))
+    report("sharded IVF-Flat", i)
+
+    pq = sharded.build_ivf_pq(comms, db,
+                              ivf_pq.IndexParams(n_lists=64, pq_dim=32))
+    _, i = sharded.search_ivf_pq(pq, queries, 10,
+                                 ivf_pq.SearchParams(n_probes=64))
+    report("sharded IVF-PQ", i)
+
+    cg = sharded.build_cagra(comms, db,
+                             cagra.IndexParams(graph_degree=16,
+                                               intermediate_graph_degree=32))
+    _, i = sharded.search_cagra(
+        cg, queries, 10,
+        cagra.SearchParams(itopk_size=64, search_width=2,
+                           scan_dtype="bfloat16"))
+    report("sharded CAGRA (bf16 scan)", i)
+
+    # ---- out-of-core MNMG build from an fbin file (DEEP-100M shape)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "base.fbin")
+        native.write_bin(path, db)
+        pq2 = sharded.build_ivf_pq_from_file(
+            comms, path, ivf_pq.IndexParams(n_lists=64, pq_dim=32),
+            res=Resources(seed=0), batch_rows=8192)
+        _, i = sharded.search_ivf_pq(pq2, queries, 10,
+                                     ivf_pq.SearchParams(n_probes=64))
+        report("sharded IVF-PQ (streamed build)", i)
+
+
+if __name__ == "__main__":
+    main()

@@ -335,19 +335,72 @@ def build_ivf_flat(
         # rewrite ids to global row ids
         gl_idx = np.asarray(idx.list_indices)
         gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
-        subs.append((np.asarray(idx.centers), np.asarray(idx.list_data),
-                     gl_idx, np.asarray(idx.list_sizes)))
-    pad = max(s[1].shape[1] for s in subs)
-    dim = dataset.shape[1]
+        subs.append((idx, gl_idx))
+    return _assemble_sharded_ivf_flat(comms, subs, params, n)
+
+
+def build_ivf_flat_from_file(
+    comms: Comms,
+    path: str,
+    params=None,
+    res: Optional[Resources] = None,
+    batch_rows: int = 1 << 18,
+    dtype=None,
+    max_train_rows: Optional[int] = None,
+) -> ShardedIvfFlat:
+    """Streamed MNMG IVF-Flat build: each shard builds out-of-core from its
+    row span of the fbin file (ids file-absolute), then shard state is
+    placed across the mesh for SPMD search."""
+    from raft_tpu.neighbors import ivf_flat, ooc
+
+    params = params or ivf_flat.IndexParams()
+    return _build_sharded_from_file(
+        comms, path, params, ooc.build_ivf_flat_from_file,
+        _assemble_sharded_ivf_flat, res, batch_rows, dtype, max_train_rows)
+
+
+def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
+                             res, batch_rows, dtype, max_train_rows):
+    """Shared streamed-MNMG skeleton: row-span bounds, per-shard ooc build
+    (file-absolute ids), mesh placement via ``assembler``."""
+    from raft_tpu import native
+
+    res = ensure_resources(res)
+    n, _ = native.read_bin_header(path)
+    size = comms.size
+    bounds = np.linspace(0, n, size + 1).astype(np.int64)
+    min_shard = int(np.diff(bounds).min())
+    if params.n_lists > min_shard:
+        raise ValueError(
+            f"n_lists={params.n_lists} exceeds the smallest shard's "
+            f"{min_shard} rows ({n} rows over {size} devices); every shard "
+            f"builds its own index, so n_lists must be ≤ rows-per-shard")
+    subs = []
+    for r in range(size):
+        lo, hi = int(bounds[r]), int(bounds[r + 1])
+        idx = ooc_builder(
+            path, params, res=res, batch_rows=batch_rows, dtype=dtype,
+            max_train_rows=max_train_rows, row_range=(lo, hi))
+        subs.append((idx, np.asarray(idx.list_indices)))  # ids absolute
+    return assembler(comms, subs, params, n)
+
+
+def _assemble_sharded_ivf_flat(comms: Comms, subs, params, n: int
+                               ) -> ShardedIvfFlat:
+    """Stack per-shard (Index, global_ids) into mesh-placed [S, ...] state
+    (pads ragged list lengths)."""
+    size = comms.size
+    pad = max(idx.list_data.shape[1] for idx, _ in subs)
+    dim = subs[0][0].dim
     L = params.n_lists
-    c = np.stack([s[0] for s in subs])
-    ld = np.zeros((size, L, pad, dim), subs[0][1].dtype)
+    c = np.stack([np.asarray(idx.centers) for idx, _ in subs])
+    ld = np.zeros((size, L, pad, dim), subs[0][0].list_data.dtype)
     li = np.full((size, L, pad), -1, np.int32)
-    ls = np.stack([s[3] for s in subs])
-    for r, s in enumerate(subs):
-        p = s[1].shape[1]
-        ld[r, :, :p] = s[1]
-        li[r, :, :p] = s[2]
+    ls = np.stack([np.asarray(idx.list_sizes) for idx, _ in subs])
+    for r, (idx, gl_idx) in enumerate(subs):
+        p = idx.list_data.shape[1]
+        ld[r, :, :p] = np.asarray(idx.list_data)
+        li[r, :, :p] = gl_idx
     ax = comms.axis
     return ShardedIvfFlat(
         comms,
@@ -427,27 +480,12 @@ def build_ivf_pq_from_file(
     each shard's index is built out-of-core from its row span of the fbin
     file (neighbors.ooc two-pass pipeline, ids file-absolute), then shard
     state is placed across the mesh for SPMD search."""
-    from raft_tpu import native
     from raft_tpu.neighbors import ivf_pq, ooc
 
-    res = ensure_resources(res)
     params = params or ivf_pq.IndexParams()
-    n, _ = native.read_bin_header(path)
-    size = comms.size
-    bounds = np.linspace(0, n, size + 1).astype(np.int64)
-    min_shard = int(np.diff(bounds).min())
-    if params.n_lists > min_shard:
-        raise ValueError(
-            f"n_lists={params.n_lists} exceeds the smallest shard's "
-            f"{min_shard} rows ({n} rows over {size} devices)")
-    subs = []
-    for r in range(size):
-        lo, hi = int(bounds[r]), int(bounds[r + 1])
-        idx = ooc.build_ivf_pq_from_file(
-            path, params, res=res, batch_rows=batch_rows, dtype=dtype,
-            max_train_rows=max_train_rows, row_range=(lo, hi))
-        subs.append((idx, np.asarray(idx.list_indices)))  # ids absolute
-    return _assemble_sharded_ivf_pq(comms, subs, params, n)
+    return _build_sharded_from_file(
+        comms, path, params, ooc.build_ivf_pq_from_file,
+        _assemble_sharded_ivf_pq, res, batch_rows, dtype, max_train_rows)
 
 
 def _assemble_sharded_ivf_pq(comms: Comms, subs, params, n: int
@@ -464,8 +502,7 @@ def _assemble_sharded_ivf_pq(comms: Comms, subs, params, n: int
     rot = subs[0][0].rotation.shape[0]
     c = np.stack([np.asarray(idx.centers) for idx, _ in subs])
     ro = np.stack([np.asarray(idx.rotation) for idx, _ in subs])
-    ld = np.zeros((size, L, pad, rot),
-                  np.asarray(subs[0][0].list_decoded).dtype)
+    ld = np.zeros((size, L, pad, rot), subs[0][0].list_decoded.dtype)
     dn = np.zeros((size, L, pad), np.float32)
     li = np.full((size, L, pad), -1, np.int32)
     ls = np.stack([np.asarray(idx.list_sizes) for idx, _ in subs])

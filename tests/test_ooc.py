@@ -98,3 +98,20 @@ def test_sharded_ivf_pq_from_file(fbin):
     assert rec >= 0.6, f"sharded ooc ivf_pq recall {rec}"
     # ids must be valid file-absolute row ids
     assert ((i >= -1) & (i < len(db))).all()
+
+
+def test_sharded_ivf_flat_from_file(fbin):
+    import jax
+
+    from raft_tpu.parallel import comms as cm, sharded
+
+    path, db, q = fbin
+    comms = cm.init_comms(jax.devices(), axis="data")
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    idx = sharded.build_ivf_flat_from_file(
+        comms, path, ivf_flat.IndexParams(n_lists=8),
+        res=Resources(seed=2), batch_rows=1000)
+    d, i = sharded.search_ivf_flat(idx, q, 10,
+                                   ivf_flat.SearchParams(n_probes=8))
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert rec >= 0.999, f"sharded ooc ivf_flat recall {rec}"
