@@ -290,17 +290,22 @@ def generate_groundtruth(dataset: np.ndarray, queries: np.ndarray, k: int,
 
 def split_groundtruth(gt_path: str, out_neighbors: str,
                       out_distances: str) -> None:
-    """Split a combined groundtruth fbin (neighbors+distances interleaved as
-    produced by big-ann tooling) into the .ibin/.fbin pair the runner reads
-    (the split_groundtruth CLI, python/raft-ann-bench split_groundtruth):
-    first half of each row = neighbor ids, second half = distances."""
-    n, d = native.read_bin_header(gt_path)
-    combined = native.read_bin(gt_path, dtype=np.float32)
-    k = d // 2
-    neigh = combined[:, :k].astype(np.int32)
-    dist = combined[:, k:].astype(np.float32)
-    native.write_bin(out_neighbors, neigh)
-    native.write_bin(out_distances, dist)
+    """Split a big-ann combined groundtruth file into the .ibin/.fbin pair
+    the runner reads (the split_groundtruth CLI, python/raft-ann-bench
+    split_groundtruth/split_groundtruth.pl). Layout: int32 header (n, k),
+    then one block of n·k uint32 neighbor ids, then one block of n·k
+    float32 distances."""
+    n, k = native.read_bin_header(gt_path)
+    with open(gt_path, "rb") as f:
+        f.seek(8)
+        neigh = np.fromfile(f, np.uint32, n * k)
+        dist = np.fromfile(f, np.float32, n * k)
+    if neigh.size != n * k or dist.size != n * k:
+        raise IOError(
+            f"{gt_path}: expected {n}*{k} ids + distances "
+            "(big-ann block layout)")
+    native.write_bin(out_neighbors, neigh.reshape(n, k).astype(np.int32))
+    native.write_bin(out_distances, dist.reshape(n, k))
 
 
 def run_benchmark(

@@ -103,3 +103,48 @@ def test_pareto_frontier():
     front = export.pareto_frontier(rows)
     assert {(r["recall"], r["qps"]) for r in front} == {
         (0.95, 50), (0.9, 100), (0.8, 120)}
+
+
+def test_cli_get_dataset_and_groundtruth(tmp_path):
+    """CLI subcommands: hdf5→fbin conversion, groundtruth generate + split
+    (raft-ann-bench get_dataset / generate_groundtruth / split_groundtruth)."""
+    import h5py
+
+    from raft_tpu.bench.__main__ import main as cli
+    from raft_tpu import native
+
+    rng = np.random.default_rng(0)
+    train = rng.standard_normal((300, 16)).astype(np.float32)
+    test = rng.standard_normal((20, 16)).astype(np.float32)
+    h5 = tmp_path / "toy-euclidean.hdf5"
+    with h5py.File(h5, "w") as f:
+        f["train"] = train
+        f["test"] = test
+    assert cli(["get-dataset", "--hdf5", str(h5),
+                "--out", str(tmp_path)]) == 0
+    base = native.read_bin(str(tmp_path / "toy-euclidean" / "base.fbin"))
+    np.testing.assert_allclose(base, train, rtol=1e-6)
+
+    gt_path = tmp_path / "gt.ibin"
+    assert cli(["generate-groundtruth",
+                "--base", str(tmp_path / "toy-euclidean" / "base.fbin"),
+                "--queries", str(tmp_path / "toy-euclidean" / "query.fbin"),
+                "--out", str(gt_path), "--k", "5"]) == 0
+    gt = native.read_bin(str(gt_path), dtype=np.int32)
+    ref = np.argsort(((test[:, None] - train[None]) ** 2).sum(-1), 1)[:, :5]
+    np.testing.assert_array_equal(gt, ref)
+
+    # big-ann combined layout: header, uint32 id block, float32 dist block
+    comb_path = tmp_path / "comb.bin"
+    with open(comb_path, "wb") as f:
+        np.asarray(ref.shape, np.int32).tofile(f)
+        ref.astype(np.uint32).tofile(f)
+        np.ones_like(ref, np.float32).tofile(f)
+    assert cli(["split-groundtruth", "--gt", str(comb_path),
+                "--out-prefix", str(tmp_path / "sp")]) == 0
+    np.testing.assert_array_equal(
+        native.read_bin(str(tmp_path / "sp.neighbors.ibin"), dtype=np.int32),
+        ref)
+    np.testing.assert_array_equal(
+        native.read_bin(str(tmp_path / "sp.distances.fbin")),
+        np.ones_like(ref, np.float32))
