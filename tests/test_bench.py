@@ -134,6 +134,20 @@ def test_cli_get_dataset_and_groundtruth(tmp_path):
     ref = np.argsort(((test[:, None] - train[None]) ** 2).sum(-1), 1)[:, :5]
     np.testing.assert_array_equal(gt, ref)
 
+    # documented subcommand-less form maps to `run` (README/getting_started)
+    conf = {"dataset": {"name": "toy",
+                        "base_file": str(tmp_path / "toy-euclidean" /
+                                         "base.fbin"),
+                        "query_file": str(tmp_path / "toy-euclidean" /
+                                          "query.fbin"),
+                        "distance": "euclidean"},
+            "index": [{"name": "bf", "algo": "raft_brute_force",
+                       "build_param": {}, "search_params": [{}]}]}
+    conf_path = tmp_path / "conf.json"
+    conf_path.write_text(json.dumps(conf))
+    assert cli(["--conf", str(conf_path), "--k", "3",
+                "--out", str(tmp_path / "res.jsonl")]) == 0
+
     # big-ann combined layout: header, uint32 id block, float32 dist block
     comb_path = tmp_path / "comb.bin"
     with open(comb_path, "wb") as f:
