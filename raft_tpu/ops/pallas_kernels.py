@@ -191,3 +191,111 @@ def ivf_scan(probes, qres, list_decoded, decoded_norms,
     """
     return _ivf_scan_pallas(probes, qres, list_decoded, decoded_norms,
                             bool(interpret))
+
+
+# --------------------------------------------------------------- select_k
+
+
+def _extract_topk(work, ci, k: int):
+    """k rounds of (min, argmin, mask) — ascending top-k of ``work`` rows.
+    ``ci`` carries source indices ([TB, W] or None → lane ids are used).
+    For small k this is ~2k VPU passes over VMEM-resident data, versus the
+    ~log²(n) passes of a full bitonic sort (the warpsort-vs-radix trade the
+    reference's select_k makes, matrix/detail/select_warpsort.cuh)."""
+    vals, idxs = [], []
+    for _ in range(k):
+        m = jnp.min(work, axis=1)
+        a = jnp.argmin(work, axis=1)
+        vals.append(m)
+        if ci is None:
+            src = a.astype(jnp.int32)
+        else:
+            src = jnp.take_along_axis(ci, a[:, None], axis=1)[:, 0]
+        # +inf is the extraction sentinel: once a row is exhausted (fewer
+        # than k finite entries) argmin would re-pick masked slots — emit
+        # the -1 null index instead (merge_topk_dedup's pad convention)
+        idxs.append(jnp.where(jnp.isfinite(m), src, -1))
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
+                  == a[:, None])
+        work = jnp.where(onehot, jnp.inf, work)
+    return jnp.stack(vals, axis=1), jnp.stack(idxs, axis=1)
+
+
+def _topk_kernel(x_ref, val_ref, idx_ref, *, k: int, kp: int, tn: int):
+    j = pl.program_id(1)
+    tile = x_ref[...].astype(jnp.float32)  # [TB, TN]
+    base = j * tn
+    tv, ti = _extract_topk(tile, None, k)  # ascending
+    ti = ti + base
+    pad = jnp.full((tile.shape[0], kp - k), jnp.inf, jnp.float32)
+    ipad = jnp.full((tile.shape[0], kp - k), -1, jnp.int32)
+
+    @pl.when(j == 0)
+    def _():
+        val_ref[...] = jnp.concatenate([tv, pad], axis=1)
+        idx_ref[...] = jnp.concatenate([ti, ipad], axis=1)
+
+    @pl.when(j > 0)
+    def _():
+        cv = jnp.concatenate([val_ref[...], tv], axis=1)  # [TB, kp+k]
+        ci = jnp.concatenate([idx_ref[...], ti], axis=1)
+        mv, mi = _extract_topk(cv, ci, k)
+        val_ref[...] = jnp.concatenate([mv, pad], axis=1)
+        idx_ref[...] = jnp.concatenate([mi, ipad], axis=1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "tb", "tn", "interpret"))
+def _topk_pallas(values, k: int, tb: int, tn: int, interpret: bool):
+    b, n = values.shape
+    bp = round_up_to(b, tb)
+    np_ = round_up_to(n, tn)
+    kp = max(round_up_to(k, 128), 128)
+    x = jnp.pad(values.astype(jnp.float32), ((0, bp - b), (0, np_ - n)),
+                constant_values=jnp.inf)
+    grid = (bp // tb, np_ // tn)
+    val, idx = pl.pallas_call(
+        functools.partial(_topk_kernel, k=k, kp=kp, tn=tn),
+        out_shape=(jax.ShapeDtypeStruct((bp, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((bp, kp), jnp.int32)),
+        grid=grid,
+        in_specs=[pl.BlockSpec((tb, tn), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec((tb, kp), lambda i, j: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((tb, kp), lambda i, j: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(x)
+    return val[:b, :k], idx[:b, :k]
+
+
+def pallas_select_k(values, k: int, select_min: bool = True,
+                    tb: int = 128, tn: int = 2048,
+                    interpret: bool = False):
+    """Streaming Pallas top-k: per-tile k-extraction merged into a running
+    VMEM buffer — the row is read from HBM exactly once and no [b, n] sort
+    intermediate exists (the radix/warpsort role of matrix::select_k for
+    small k; best for k ≤ ~32).
+
+    Returns (values [b, k], indices [b, k]) ascending (descending for
+    ``select_min=False``). Ties may resolve to different (equally valid)
+    indices than lax.top_k.
+    """
+    values = jnp.asarray(values)
+    b, n = values.shape
+    if k > 1024:
+        raise ValueError(
+            f"pallas select_k is a small-k algorithm (k={k} > 1024); "
+            "use DIRECT/TWO_PHASE")
+    tb = max(8, min(tb, round_up_to(b, 8)))
+    tb -= tb % 8
+    tn = max(128, min(tn, round_up_to(n, 128)))
+    tn -= tn % 128
+    # each tile must be able to surface k distinct candidates
+    tn = max(tn, round_up_to(k, 128))
+    v = values if select_min else -values
+    out_v, out_i = _topk_pallas(v, int(k), tb, tn, bool(interpret))
+    out_v = out_v if select_min else -out_v
+    # match DIRECT/TWO_PHASE: values come back in the input dtype
+    return out_v.astype(values.dtype), out_i

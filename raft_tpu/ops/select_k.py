@@ -32,6 +32,7 @@ class SelectAlgo(enum.Enum):
     AUTO = "auto"
     DIRECT = "direct"  # single lax.top_k over the full row
     TWO_PHASE = "two_phase"  # per-tile top-k, then merge (wide rows)
+    PALLAS = "pallas"  # streaming k-extraction kernel (small k, wide rows)
 
 
 # Rows wider than this use the two-phase path under AUTO; beyond ~64k lanes a
@@ -74,6 +75,11 @@ def _select_k_jit(values, k, select_min, algo):
             if values.shape[-1] >= _TWO_PHASE_THRESHOLD and k * 4 <= values.shape[-1]
             else SelectAlgo.DIRECT
         )
+    if algo == SelectAlgo.PALLAS:
+        from raft_tpu.ops.pallas_kernels import pallas_enabled, pallas_select_k
+
+        return pallas_select_k(values, k, select_min,
+                               interpret=not pallas_enabled())
     if algo == SelectAlgo.DIRECT:
         return _direct(values, k, select_min)
     return _two_phase(values, k, select_min)

@@ -72,3 +72,39 @@ def test_two_phase_matches_direct_largest(rng):
     v2, _ = select_k(x, 7, select_min=False, algo=SelectAlgo.TWO_PHASE)
     np.testing.assert_allclose(np.sort(np.asarray(v1), 1),
                                np.sort(np.asarray(v2), 1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("shape,k", [((16, 1000), 5), ((64, 4096), 32),
+                                     ((8, 300), 10)])
+def test_pallas_algo_matches_direct(shape, k, rng):
+    """Streaming Pallas k-extraction agrees with lax.top_k (values exactly;
+    indices up to ties)."""
+    x = rng.standard_normal(shape).astype(np.float32)
+    for select_min in (True, False):
+        v_p, i_p = select_k(x, k, select_min=select_min,
+                            algo=SelectAlgo.PALLAS)
+        v_d, _ = select_k(x, k, select_min=select_min,
+                          algo=SelectAlgo.DIRECT)
+        np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_d),
+                                   rtol=1e-6)
+        picked = np.take_along_axis(x, np.asarray(i_p), axis=1)
+        np.testing.assert_allclose(picked, np.asarray(v_d), rtol=1e-6)
+
+
+def test_pallas_inf_rows_and_wide_k(rng):
+    """Rows with fewer than k finite entries emit -1 null indices (no
+    duplicate picks); k wider than the column tile still selects exactly."""
+    from raft_tpu.ops.pallas_kernels import pallas_select_k
+
+    x = np.full((8, 256), np.inf, np.float32)
+    x[:, 0] = 1.0
+    x[:, 100] = 2.0
+    v, i = pallas_select_k(x, 4, interpret=True)
+    np.testing.assert_array_equal(np.asarray(i)[0], [0, 100, -1, -1])
+
+    y = rng.standard_normal((8, 1024)).astype(np.float32)
+    v, i = pallas_select_k(y, 200, tn=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(v), np.sort(y, 1)[:, :200],
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="small-k"):
+        pallas_select_k(y, 1025, interpret=True)
