@@ -11,9 +11,10 @@ from raft_tpu.neighbors import (
     ivf_pq,
     nn_descent,
     ooc,
+    quantize,
     rbc,
     refine,
 )
 
 __all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "rbc", "refine"]
+           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "quantize", "rbc", "refine"]

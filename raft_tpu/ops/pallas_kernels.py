@@ -204,17 +204,20 @@ def _extract_topk(work, ci, k: int):
     reference's select_k makes, matrix/detail/select_warpsort.cuh)."""
     vals, idxs = [], []
     for _ in range(k):
-        m = jnp.min(work, axis=1)
         a = jnp.argmin(work, axis=1)
+        # one reduction + a cheap gather per round (not min + argmin twice)
+        m = jnp.take_along_axis(work, a[:, None], axis=1)[:, 0]
         vals.append(m)
         if ci is None:
             src = a.astype(jnp.int32)
         else:
             src = jnp.take_along_axis(ci, a[:, None], axis=1)[:, 0]
-        # +inf is the extraction sentinel: once a row is exhausted (fewer
-        # than k finite entries) argmin would re-pick masked slots — emit
-        # the -1 null index instead (merge_topk_dedup's pad convention)
-        idxs.append(jnp.where(jnp.isfinite(m), src, -1))
+        # +inf (exactly) is the extraction sentinel: once a row is
+        # exhausted (fewer than k non-sentinel entries) argmin would
+        # re-pick masked slots — emit the -1 null index instead
+        # (merge_topk_dedup's pad convention). A legitimate -inf minimum
+        # keeps its real index.
+        idxs.append(jnp.where(m != jnp.inf, src, -1))
         onehot = (jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
                   == a[:, None])
         work = jnp.where(onehot, jnp.inf, work)

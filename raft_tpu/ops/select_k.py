@@ -76,10 +76,12 @@ def _select_k_jit(values, k, select_min, algo):
             else SelectAlgo.DIRECT
         )
     if algo == SelectAlgo.PALLAS:
-        from raft_tpu.ops.pallas_kernels import pallas_enabled, pallas_select_k
+        from raft_tpu.ops.pallas_kernels import pallas_select_k
 
+        # an explicit algo request is the opt-in: hardware path on TPU,
+        # Mosaic interpreter elsewhere (CPU CI)
         return pallas_select_k(values, k, select_min,
-                               interpret=not pallas_enabled())
+                               interpret=jax.default_backend() != "tpu")
     if algo == SelectAlgo.DIRECT:
         return _direct(values, k, select_min)
     return _two_phase(values, k, select_min)
@@ -103,13 +105,19 @@ def select_k(
         v, i = select_k(values[None], k, select_min, None, algo)
         v, i = v[0], i[0]
         if indices is not None:
-            i = jnp.asarray(indices)[i]
+            # preserve -1 null markers (PALLAS exhausted-row convention)
+            i = jnp.where(i < 0, -1,
+                          jnp.asarray(indices)[jnp.maximum(i, 0)])
         return v, i
     if k > values.shape[-1]:
         raise ValueError(f"k={k} > row length {values.shape[-1]}")
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo)
     if indices is not None:
-        out_i = jnp.take_along_axis(jnp.asarray(indices), out_i, axis=1)
+        # preserve -1 null markers (PALLAS exhausted-row convention) —
+        # take_along_axis would wrap -1 to the last column's real id
+        relabeled = jnp.take_along_axis(jnp.asarray(indices),
+                                        jnp.maximum(out_i, 0), axis=1)
+        out_i = jnp.where(out_i < 0, -1, relabeled)
     return out_v, out_i
 
 

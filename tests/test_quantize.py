@@ -1,0 +1,54 @@
+"""Scalar quantization (legacy ann_quantized role): int8 codes keep
+brute-force recall high and round-trip within one grid step."""
+
+import numpy as np
+
+from raft_tpu.neighbors import brute_force, quantize
+from raft_tpu.stats import neighborhood_recall
+
+
+def test_roundtrip_within_grid_step():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2000, 32)).astype(np.float32)
+    sq = quantize.ScalarQuantizer.fit(x)
+    codes = sq.transform(x)
+    assert codes.dtype == np.int8
+    rec = sq.inverse_transform(codes)
+    np.testing.assert_allclose(rec, x, atol=np.max(sq.scale) * 0.51)
+
+
+def test_quantized_knn_recall():
+    # clustered data (iid gaussian has near-tie neighbor gaps that 8-bit
+    # noise flips — unrepresentative of the benchmark datasets)
+    rng = np.random.default_rng(1)
+    centers = rng.standard_normal((40, 64)) * 4.0
+    db = (centers[rng.integers(0, 40, 4000)]
+          + rng.standard_normal((4000, 64))).astype(np.float32)
+    q = (centers[rng.integers(0, 40, 100)]
+         + rng.standard_normal((100, 64))).astype(np.float32)
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    sq = quantize.ScalarQuantizer.fit(db, quantile=0.995)
+    dbq, qq = sq.transform(db), sq.transform(q)
+    d, i = brute_force.knn(qq, dbq, 10, metric="sqeuclidean")
+    # contract 1: the int8 search path is EXACT on the codes
+    ref = ((qq.astype(np.float32)[:, None]
+            - dbq.astype(np.float32)[None]) ** 2).sum(-1)
+    i_ref = np.argsort(ref, 1)[:, :10]
+    assert float(neighborhood_recall(np.asarray(i), i_ref)) == 1.0
+    # contract 2: 8-bit noise costs bounded recall vs fp32 ground truth
+    rec = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert rec >= 0.75, f"int8 recall {rec}"
+
+
+def test_outlier_trim_saturates():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1000, 8)).astype(np.float32)
+    x[0, 0] = 1e6  # single outlier must not stretch the grid
+    sq = quantize.ScalarQuantizer.fit(x, quantile=0.99)
+    codes = sq.transform(x)
+    assert codes[0, 0] == 127  # saturated
+    # grid still resolves the non-saturated bulk
+    rec = sq.inverse_transform(codes[1:])
+    inside = (codes[1:] > -128) & (codes[1:] < 127)
+    err = np.abs(rec - x[1:])
+    assert err[inside].max() <= np.max(sq.scale) * 0.6
