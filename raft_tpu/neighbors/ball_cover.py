@@ -213,3 +213,73 @@ def knn(
         index.list_indices[jnp.asarray(sub)], jnp.asarray(sub_valid),
         jnp.asarray(needed_sub), best_d, best_i, index.metric, int(k))
     return _finalize(out_d, out_i, int(k), index.metric)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "n_rows", "q_tile"))
+def _eps_nn_jit(queries, list_data, list_valid, list_indices, eps,
+                metric: DistanceType, n_rows: int, q_tile: int):
+    nq = queries.shape[0]
+    M, pad, dim = list_data.shape
+    n_q_tiles = cdiv(nq, q_tile)
+    qp = jnp.pad(queries, ((0, n_q_tiles * q_tile - nq), (0, 0)))
+    flat_ids = jnp.maximum(list_indices.reshape(-1), 0)  # [M*pad]
+
+    def q_body(qt):
+        gf = list_data.reshape(M * pad, dim)
+        d = _rooted_dist(qt, gf, metric).reshape(qt.shape[0], M, pad)
+        hit = (d <= eps) & list_valid[None]
+        flat_hit = hit.reshape(qt.shape[0], M * pad)
+        adj = jnp.zeros((qt.shape[0], n_rows), bool)
+        return adj.at[:, flat_ids].max(flat_hit)
+
+    if n_q_tiles == 1:
+        adj = q_body(qp)
+    else:
+        adj = jax.lax.map(
+            q_body, qp.reshape(n_q_tiles, q_tile, -1)
+        ).reshape(-1, n_rows)
+    adj = adj[:nq]
+    return adj, jnp.sum(adj, axis=1).astype(jnp.int32)
+
+
+def eps_nn(index: BallCoverIndex, queries, eps: float,
+           res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
+    """All neighbors within ``eps`` (reference: ball_cover::eps_nn,
+    ball_cover-inl.cuh:313-365). ``eps`` is in the rooted metric (true L2 /
+    haversine). Returns (adjacency [nq, n_rows] bool, vertex degrees [nq]
+    int32) — the epsilon_neighborhood output shape.
+
+    The RBC triangle-inequality bound prunes whole lists HOST-side (the
+    union over queries, like knn()'s pass 2), so the device scan shrinks —
+    with a small slack absorbing the expanded-L2 rounding error so a
+    boundary neighbor is never dropped; in-range membership itself is an
+    exact distance compare."""
+    res = ensure_resources(res)
+    queries = jnp.asarray(queries)
+    L, pad, dim = index.list_data.shape
+    # bound with error slack: lm_d − radius ≤ eps ⇒ list may contain hits
+    lm_d = np.asarray(_rooted_dist(queries, index.landmarks, index.metric))
+    slack = 1e-3 * np.abs(lm_d) + 1e-3 * np.asarray(index.radii)[None, :]         + 1e-5
+    needed = (lm_d - np.asarray(index.radii)[None, :] - slack) <= eps
+    needed_lists = np.nonzero(needed.any(axis=0))[0]
+    nq = queries.shape[0]
+    if len(needed_lists) == 0:
+        adj = jnp.zeros((nq, index.n_rows), bool)
+        return adj, jnp.zeros((nq,), jnp.int32)
+    # bucket the subset size to a power of two (bounds recompilation)
+    m_bucket = min(1 << int(np.ceil(np.log2(len(needed_lists)))), L)
+    sub = np.full((m_bucket,), int(needed_lists[0]), np.int64)
+    sub[: len(needed_lists)] = needed_lists
+    sub_sizes = np.asarray(index.list_sizes)[sub]
+    sub_valid = np.arange(pad)[None, :] < sub_sizes[:, None]
+    sub_valid[len(needed_lists):] = False  # padding lists contribute 0
+    per_q = m_bucket * pad * (dim + 8) * 4
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 512))
+    q_tile = min(q_tile, int(round_up_to(nq, 8)))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    return _eps_nn_jit(queries, index.list_data[jnp.asarray(sub)],
+                       jnp.asarray(sub_valid),
+                       index.list_indices[jnp.asarray(sub)],
+                       jnp.float32(eps), index.metric, index.n_rows,
+                       max(q_tile, 1))

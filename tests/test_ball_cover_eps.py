@@ -63,3 +63,16 @@ def test_eps_neighbors(data):
     want = d <= eps
     np.testing.assert_array_equal(np.asarray(adj), want)
     np.testing.assert_array_equal(np.asarray(deg), want.sum(1))
+
+
+def test_ball_cover_eps_nn(data):
+    """RBC eps_nn matches the dense epsilon_neighborhood adjacency
+    (reference: ball_cover::eps_nn, ball_cover-inl.cuh:313-365)."""
+    db, q = data
+    eps = 1.2
+    index = ball_cover.build(db, metric="euclidean")
+    adj, deg = ball_cover.eps_nn(index, q, eps)
+    adj = np.asarray(adj)
+    ref = np.sqrt(((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)) <= eps
+    np.testing.assert_array_equal(adj, ref)
+    np.testing.assert_array_equal(np.asarray(deg), ref.sum(1))
