@@ -320,3 +320,32 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
     finally:
         if close:
             stream.close()
+
+
+def make_batch_k_query(index: Index, queries, batch_size: int,
+                       res: Optional[Resources] = None):
+    """Iterate over each query's neighbor list in batches of ``batch_size``:
+    the first yield holds the nearest ``batch_size`` neighbors, the next the
+    following ``batch_size``, … (reference: brute_force::make_batch_k_query,
+    detail/knn_brute_force_batch_k_query.cuh).
+
+    The searched k grows geometrically and several batches are sliced from
+    each result, so draining n neighbors costs O(log(n/batch_size)) searches
+    (and compilations) rather than one per batch."""
+    res = ensure_resources(res)
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+
+    def _iter():
+        offset = 0
+        k = 0
+        d = i = None
+        while offset < index.size:
+            if offset + batch_size > k:  # widen: double, at least 4 batches
+                k = min(max(4 * batch_size, 2 * k), index.size)
+                d, i = search(index, queries, k, res=res)
+            end = min(offset + batch_size, index.size)
+            yield d[:, offset:end], i[:, offset:end]
+            offset = end
+
+    return _iter()

@@ -155,3 +155,23 @@ def test_fast_scan_tiled_and_filtered(rng):
     ref = ((q[:, None, :] - db[None, :, :]) ** 2).sum(-1)
     ref = np.where(mask[None, :], ref, np.inf)
     np.testing.assert_array_equal(i[:, 0], ref.argmin(1))
+
+
+def test_batch_k_query_iterator(rng):
+    """Batched neighbor iteration: concatenated batches equal one wide
+    search (reference: make_batch_k_query)."""
+    db = rng.standard_normal((500, 16)).astype(np.float32)
+    q = rng.standard_normal((20, 16)).astype(np.float32)
+    idx = brute_force.build(db, metric="sqeuclidean")
+    batches = []
+    it = brute_force.make_batch_k_query(idx, q, batch_size=7)
+    for _ in range(3):
+        d, i = next(it)
+        assert i.shape == (20, 7)
+        batches.append(np.asarray(i))
+    d_ref, i_ref = brute_force.search(idx, q, 21)
+    np.testing.assert_array_equal(np.concatenate(batches, 1),
+                                  np.asarray(i_ref))
+    # exhausting the iterator covers the whole dataset exactly once
+    total = 21 + sum(i.shape[1] for _, i in it)
+    assert total == 500
