@@ -64,6 +64,38 @@ def _scan_dtype(search_param):
     raise ValueError(f"unknown scan_dtype {v!r}; use bf16/bfloat16/half")
 
 
+def _lookup_dtype(search_param, key, table, default):
+    """Validated dtype lookup for bench search params: raises a named
+    ValueError listing the allowed spellings instead of a bare KeyError
+    (mirrors the reference's explicit lut/internal dtype validation,
+    ivf_pq_types.hpp:110-146)."""
+    v = search_param.get(key, default)
+    if v not in table:
+        raise ValueError(
+            f"unknown {key} {v!r}; allowed: {sorted(table)}")
+    return table[v]
+
+
+def _internal_distance_dtype(search_param):
+    import jax.numpy as jnp
+
+    return _lookup_dtype(
+        search_param, "internalDistanceDtype",
+        {"float": jnp.float32, "fp32": jnp.float32,
+         "half": jnp.bfloat16, "fp16": jnp.bfloat16,
+         "bf16": jnp.bfloat16}, "float")
+
+
+def _lut_dtype(search_param):
+    import jax.numpy as jnp
+
+    return _lookup_dtype(
+        search_param, "smemLutDtype",
+        {"float": jnp.float32, "fp32": jnp.float32,
+         "half": jnp.bfloat16, "fp16": jnp.bfloat16,
+         "bf16": jnp.bfloat16, "fp8": jnp.float8_e4m3fn}, "float")
+
+
 class BruteForce(AnnAlgo):
     name = "raft_brute_force"
 
@@ -148,10 +180,7 @@ class IvfPq(AnnAlgo):
 
         from raft_tpu.neighbors import ivf_pq, refine
 
-        dtypes = {"float": jnp.float32, "fp32": jnp.float32,
-                  "half": jnp.bfloat16, "fp16": jnp.bfloat16,
-                  "fp8": jnp.float8_e4m3fn, "bf16": jnp.bfloat16}
-        lut = dtypes[search_param.get("smemLutDtype", "float")]
+        lut = _lut_dtype(search_param)
         scan_mode = search_param.get("scan_mode", "auto")
         if lut == jnp.float8_e4m3fn and scan_mode != "lut":
             # fp8 LUTs only exist on the LUT engine; the cache engine would
@@ -160,11 +189,7 @@ class IvfPq(AnnAlgo):
         sp = ivf_pq.SearchParams(
             n_probes=int(search_param.get("nprobe", 20)),
             lut_dtype=lut,
-            internal_distance_dtype={
-                "float": jnp.float32, "fp32": jnp.float32,
-                "half": jnp.bfloat16, "fp16": jnp.bfloat16,
-                "bf16": jnp.bfloat16}[
-                search_param.get("internalDistanceDtype", "float")],
+            internal_distance_dtype=_internal_distance_dtype(search_param),
             scan_mode=scan_mode,
         )
         rr = float(search_param.get("refine_ratio", 1.0))

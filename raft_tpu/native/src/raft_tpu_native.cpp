@@ -356,8 +356,12 @@ int64_t prefetch_next(void* handle, void* out) {
   {
     std::unique_lock<std::mutex> lk(p->m);
     p->cv.wait(lk, [&] { return p->buf_rows[slot] >= 0 || p->err; });
-    if (p->err) return p->err;
     rows = p->buf_rows[slot];
+    // Drain any valid batch already staged in this slot even if the worker
+    // has since failed on a later batch; surface the error only when this
+    // slot itself carries it (the worker stores 0 rows on a failed read —
+    // real batches always have >= 1 row) or was never filled.
+    if (rows <= 0) return p->err ? p->err : 0;
   }
   std::memcpy(out, p->bufs[slot].data(), (size_t)rows * p->dim * p->elem);
   {

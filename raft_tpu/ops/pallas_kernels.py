@@ -196,55 +196,65 @@ def ivf_scan(probes, qres, list_decoded, decoded_norms,
 # --------------------------------------------------------------- select_k
 
 
-def _extract_topk(work, ci, k: int):
-    """k rounds of (min, argmin, mask) — ascending top-k of ``work`` rows.
-    ``ci`` carries source indices ([TB, W] or None → lane ids are used).
-    For small k this is ~2k VPU passes over VMEM-resident data, versus the
-    ~log²(n) passes of a full bitonic sort (the warpsort-vs-radix trade the
-    reference's select_k makes, matrix/detail/select_warpsort.cuh)."""
-    vals, idxs = [], []
-    for _ in range(k):
+def _extract_topk(work, ci, k: int, kp: int):
+    """k rounds of (min, argmin, mask) — ascending top-k of ``work`` rows,
+    returned padded to ``kp`` columns (+inf / -1 tail, merge_topk_dedup's
+    pad convention). ``ci`` carries source indices ([TB, W] or None → lane
+    ids are used). For small k this is ~2k VPU passes over VMEM-resident
+    data, versus the ~log²(n) passes of a full bitonic sort (the
+    warpsort-vs-radix trade the reference's select_k makes,
+    matrix/detail/select_warpsort.cuh). A ``lax.fori_loop`` keeps the
+    traced program O(1) in k (ADVICE r1: the unrolled form compiled
+    linearly in k)."""
+    tb = work.shape[0]
+    out_col = jax.lax.broadcasted_iota(jnp.int32, (tb, kp), 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
+
+    def body(r, carry):
+        work, vals, idxs = carry
         a = jnp.argmin(work, axis=1)
         # one reduction + a cheap gather per round (not min + argmin twice)
         m = jnp.take_along_axis(work, a[:, None], axis=1)[:, 0]
-        vals.append(m)
         if ci is None:
             src = a.astype(jnp.int32)
         else:
             src = jnp.take_along_axis(ci, a[:, None], axis=1)[:, 0]
         # +inf (exactly) is the extraction sentinel: once a row is
         # exhausted (fewer than k non-sentinel entries) argmin would
-        # re-pick masked slots — emit the -1 null index instead
-        # (merge_topk_dedup's pad convention). A legitimate -inf minimum
-        # keeps its real index.
-        idxs.append(jnp.where(m != jnp.inf, src, -1))
-        onehot = (jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
-                  == a[:, None])
-        work = jnp.where(onehot, jnp.inf, work)
-    return jnp.stack(vals, axis=1), jnp.stack(idxs, axis=1)
+        # re-pick masked slots — emit the -1 null index instead. A
+        # legitimate -inf minimum keeps its real index.
+        src = jnp.where(m != jnp.inf, src, -1)
+        sel = out_col == r
+        vals = jnp.where(sel, m[:, None], vals)
+        idxs = jnp.where(sel, src[:, None], idxs)
+        work = jnp.where(lane == a[:, None], jnp.inf, work)
+        return work, vals, idxs
+
+    vals0 = jnp.full((tb, kp), jnp.inf, jnp.float32)
+    idxs0 = jnp.full((tb, kp), -1, jnp.int32)
+    _, vals, idxs = jax.lax.fori_loop(0, k, body, (work, vals0, idxs0))
+    return vals, idxs
 
 
 def _topk_kernel(x_ref, val_ref, idx_ref, *, k: int, kp: int, tn: int):
     j = pl.program_id(1)
     tile = x_ref[...].astype(jnp.float32)  # [TB, TN]
     base = j * tn
-    tv, ti = _extract_topk(tile, None, k)  # ascending
-    ti = ti + base
-    pad = jnp.full((tile.shape[0], kp - k), jnp.inf, jnp.float32)
-    ipad = jnp.full((tile.shape[0], kp - k), -1, jnp.int32)
+    tv, ti = _extract_topk(tile, None, k, kp)  # ascending, [TB, kp]
+    ti = jnp.where(ti >= 0, ti + base, -1)
 
     @pl.when(j == 0)
     def _():
-        val_ref[...] = jnp.concatenate([tv, pad], axis=1)
-        idx_ref[...] = jnp.concatenate([ti, ipad], axis=1)
+        val_ref[...] = tv
+        idx_ref[...] = ti
 
     @pl.when(j > 0)
     def _():
-        cv = jnp.concatenate([val_ref[...], tv], axis=1)  # [TB, kp+k]
+        cv = jnp.concatenate([val_ref[...], tv], axis=1)  # [TB, 2·kp]
         ci = jnp.concatenate([idx_ref[...], ti], axis=1)
-        mv, mi = _extract_topk(cv, ci, k)
-        val_ref[...] = jnp.concatenate([mv, pad], axis=1)
-        idx_ref[...] = jnp.concatenate([mi, ipad], axis=1)
+        mv, mi = _extract_topk(cv, ci, k, kp)
+        val_ref[...] = mv
+        idx_ref[...] = mi
 
 
 @functools.partial(jax.jit,
