@@ -37,6 +37,15 @@ def rng():
     return np.random.default_rng(42)
 
 
+def pytest_collection_modifyitems(config, items):
+    """Everything not marked ``slow`` is the fast tier: ``pytest -m fast``
+    gives a green signal in a few minutes, ``-m slow`` runs the heavy
+    recall/scale suites (the reference's CI-vs-nightly split)."""
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
+
+
 @pytest.fixture()
 def res():
     from raft_tpu import Resources

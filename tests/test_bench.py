@@ -40,6 +40,7 @@ def _config(files, indexes):
     }
 
 
+@pytest.mark.slow
 def test_run_all_algos(dataset_files, tmp_path):
     config = _config(dataset_files, [
         {"name": "bf", "algo": "raft_brute_force", "build_param": {},

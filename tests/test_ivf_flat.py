@@ -41,6 +41,7 @@ def test_build_shapes(data):
 
 
 @pytest.mark.parametrize("n_probes,floor", [(4, 0.4), (8, 0.6), (32, 0.999)])
+@pytest.mark.slow
 def test_recall_increases_with_probes(data, gt, n_probes, floor):
     db, q = data
     index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=32))
@@ -49,6 +50,7 @@ def test_recall_increases_with_probes(data, gt, n_probes, floor):
     assert recall >= floor, f"recall {recall} < {floor} at n_probes={n_probes}"
 
 
+@pytest.mark.slow
 def test_full_probe_is_exact(data, gt):
     db, q = data
     index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))

@@ -68,6 +68,7 @@ def test_agglomerative_label_chain():
     assert labels[0] != labels[3]
 
 
+@pytest.mark.slow
 def test_hnswlib_export_roundtrip(tmp_path, rng):
     from raft_tpu.neighbors import brute_force, cagra, hnsw
     from raft_tpu.stats import neighborhood_recall

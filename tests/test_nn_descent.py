@@ -14,6 +14,7 @@ def data():
     return rng.standard_normal((2000, 32)).astype(np.float32)
 
 
+@pytest.mark.slow
 def test_graph_recall(data):
     params = nn_descent.IndexParams(
         graph_degree=32, intermediate_graph_degree=48, max_iterations=12)
@@ -26,6 +27,7 @@ def test_graph_recall(data):
     assert recall >= 0.9, f"graph recall {recall}"
 
 
+@pytest.mark.slow
 def test_no_self_loops(data):
     params = nn_descent.IndexParams(
         graph_degree=16, intermediate_graph_degree=32, max_iterations=8)

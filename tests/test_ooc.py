@@ -29,6 +29,7 @@ def test_sample_rows(fbin):
     assert np.isin(s[:, 0], db[:, 0]).all()
 
 
+@pytest.mark.slow
 def test_streamed_ivf_flat_matches_recall(fbin):
     path, db, q = fbin
     _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
@@ -42,6 +43,7 @@ def test_streamed_ivf_flat_matches_recall(fbin):
     assert rec >= 0.999  # all lists probed → exact
 
 
+@pytest.mark.slow
 def test_streamed_ivf_flat_ids_roundtrip(fbin):
     path, db, _ = fbin
     params = ivf_flat.IndexParams(n_lists=8)
@@ -56,6 +58,7 @@ def test_streamed_ivf_flat_ids_roundtrip(fbin):
         np.testing.assert_array_equal(data[l, :s], db[idxs[l, :s]])
 
 
+@pytest.mark.slow
 def test_streamed_ivf_pq_recall(fbin):
     path, db, q = fbin
     _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
@@ -77,6 +80,7 @@ def test_streamed_ivf_pq_recall(fbin):
     assert abs(rec - rec_mem) < 0.1
 
 
+@pytest.mark.slow
 def test_sharded_ivf_pq_from_file(fbin):
     """MNMG streamed build: per-shard ooc builds with file-absolute ids,
     SPMD search + ICI merge matches the recall of the in-memory sharded
@@ -100,6 +104,7 @@ def test_sharded_ivf_pq_from_file(fbin):
     assert ((i >= -1) & (i < len(db))).all()
 
 
+@pytest.mark.slow
 def test_sharded_ivf_flat_from_file(fbin):
     import jax
 

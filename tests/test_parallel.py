@@ -96,6 +96,7 @@ def test_sharded_kmeans(comms):
     assert purity / len(x) >= 0.9
 
 
+@pytest.mark.slow
 def test_sharded_ivf_flat(comms):
     from raft_tpu.neighbors import ivf_flat
 
@@ -115,6 +116,7 @@ def test_sharded_ivf_flat(comms):
     assert recall >= 0.99, f"sharded bf16 ivf_flat recall {recall}"
 
 
+@pytest.mark.slow
 def test_sharded_ivf_pq(comms):
     from raft_tpu.neighbors import ivf_pq
 
@@ -180,6 +182,7 @@ def test_device_send_recv_and_multicast(comms):
     np.testing.assert_allclose(out2.ravel(), want2)
 
 
+@pytest.mark.slow
 def test_sharded_cagra(comms):
     from raft_tpu.neighbors import cagra
 
