@@ -66,13 +66,18 @@ class Resources:
     def device(self) -> jax.Device:
         if self._device is not None:
             return self._device
-        return jax.devices()[0]
+        # local_devices: in a multi-controller deployment jax.devices()[0]
+        # can be another process's (non-addressable) device
+        return jax.local_devices()[0]
 
     @property
     def workspace_limit_bytes(self) -> int:
         if self._workspace_limit is not None:
             return self._workspace_limit
-        stats = getattr(self.device, "memory_stats", lambda: None)()
+        try:
+            stats = getattr(self.device, "memory_stats", lambda: None)()
+        except Exception:  # non-addressable device / backend w/o stats
+            stats = None
         if stats and "bytes_limit" in stats:
             # Leave headroom: workspace is for scratch, not the whole HBM.
             return int(stats["bytes_limit"] * 0.25)

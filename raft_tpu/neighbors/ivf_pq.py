@@ -740,17 +740,13 @@ _search_cache_jit = jax.jit(
 )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("metric", "k", "n_probes", "q_tile", "per_cluster",
-                     "pq_dim", "pq_bits", "has_filter", "lut_dtype",
-                     "dist_dtype"),
-)
-def _search_jit(queries, centers, rotation, codebooks, list_codes,
-                list_indices, list_sizes, filter_words,
-                metric: DistanceType, k: int, n_probes: int, q_tile: int,
-                per_cluster: bool, pq_dim: int, pq_bits: int,
-                has_filter: bool, lut_dtype, dist_dtype):
+def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
+                     list_indices, list_sizes, filter_words,
+                     metric: DistanceType, k: int, n_probes: int, q_tile: int,
+                     per_cluster: bool, pq_dim: int, pq_bits: int,
+                     has_filter: bool, lut_dtype, dist_dtype):
+    """LUT-engine scan over packed codes (traceable core — also runs inside
+    ``shard_map`` for the memory-lean sharded search, parallel/sharded.py)."""
     nq, dim = queries.shape
     n_lists, list_pad, _ = list_codes.shape
     pq_len = codebooks.shape[2]
@@ -875,6 +871,14 @@ def _search_jit(queries, centers, rotation, codebooks, list_codes,
         vals = vals.reshape(-1, k)
         idxs = idxs.reshape(-1, k)
     return vals[:nq], idxs[:nq]
+
+
+_search_jit = jax.jit(
+    _search_lut_core,
+    static_argnames=("metric", "k", "n_probes", "q_tile", "per_cluster",
+                     "pq_dim", "pq_bits", "has_filter", "lut_dtype",
+                     "dist_dtype"),
+)
 
 
 def search(

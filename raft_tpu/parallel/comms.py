@@ -204,8 +204,17 @@ class Comms:
                              out_specs=out_specs, check_vma=check_vma)
 
     def shard(self, x, spec: P):
-        """Place ``x`` with a NamedSharding on this mesh."""
-        return jax.device_put(x, NamedSharding(self.mesh, spec))
+        """Place ``x`` with a NamedSharding on this mesh. In a
+        multi-controller deployment the host value (assumed identical on
+        every process, like queries broadcast in raft-dask) is sliced
+        per-process via ``make_array_from_callback`` — ``device_put`` of a
+        host array onto a global sharding is single-controller-only."""
+        sharding = NamedSharding(self.mesh, spec)
+        if jax.process_count() > 1:
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
+        return jax.device_put(x, sharding)
 
     def sync(self, *arrays) -> None:
         """sync_stream analog: block on arrays / fence dispatch."""
