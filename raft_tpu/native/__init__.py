@@ -82,7 +82,8 @@ def _get_lib():
         lib.bin_write.restype = ctypes.c_int
         lib.hnswlib_write.argtypes = [
             ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64]
         lib.hnswlib_write.restype = ctypes.c_int
         lib.agglomerative_label.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -237,12 +238,17 @@ def iter_bin_batches_prefetch(path: str, batch_rows: int, dtype=None,
 
 
 def hnswlib_write(path: str, dataset: np.ndarray, graph: np.ndarray,
-                  space: str = "l2") -> None:
-    """Write a base-layer-only hnswlib index file (loadable by hnswlib's
-    HierarchicalNSW::loadIndex): header in saveIndex order, per-element
-    level-0 block [link_count u32][maxM0 u32 links][dim f32][label u64],
-    zero upper-level link lists. Reference: CAGRA→HNSW serializer
-    (neighbors/detail/hnsw_types.hpp:60-86)."""
+                  space: str = "l2", compat: str = "hnswlib") -> None:
+    """Write a base-layer-only hnswlib index file: header in saveIndex
+    order, per-element level-0 block [link_count u32][maxM0 u32 links]
+    [dim f32][label u64], zero upper-level link lists.
+
+    ``compat="hnswlib"`` (default) emits max_level=0/enterpoint=0 — safe
+    for stock hnswlib's loadIndex **and** search (no upper-layer descent).
+    ``compat="raft"`` reproduces the reference serializer byte-for-byte
+    (cagra_serialize.cuh:113-154; the base_layer_only loader contract of
+    hnsw_types.hpp:60-86) — stock hnswlib would crash *searching* that
+    variant, exactly as it does on the reference's own output."""
     dataset = np.ascontiguousarray(dataset, np.float32)
     graph = np.ascontiguousarray(graph, np.int32)
     n, dim = dataset.shape
@@ -250,20 +256,21 @@ def hnswlib_write(path: str, dataset: np.ndarray, graph: np.ndarray,
         raise ValueError("graph rows must match dataset rows")
     degree = graph.shape[1]
     sp = {"l2": 0, "ip": 1}[space]
+    rc_compat = {"hnswlib": 0, "raft": 1}[compat]
     lib = _get_lib()
     if lib is not None:
         rc = lib.hnswlib_write(path.encode(),
                                dataset.ctypes.data_as(ctypes.c_void_p),
                                graph.ctypes.data_as(ctypes.c_void_p),
-                               n, dim, degree, sp)
+                               n, dim, degree, sp, rc_compat)
         if rc != 0:
             raise IOError(f"hnswlib_write({path}) failed rc={rc}")
         return
-    _hnswlib_write_py(path, dataset, graph)
+    _hnswlib_write_py(path, dataset, graph, compat)
 
 
-def _hnswlib_write_py(path: str, dataset: np.ndarray,
-                      graph: np.ndarray) -> None:
+def _hnswlib_write_py(path: str, dataset: np.ndarray, graph: np.ndarray,
+                      compat: str = "hnswlib") -> None:
     import struct
 
     n, dim = dataset.shape
@@ -272,12 +279,18 @@ def _hnswlib_write_py(path: str, dataset: np.ndarray,
     data_size = dim * 4
     size_per_elem = size_links0 + data_size + 8
     m = max(degree // 2, 1)
+    # header constants must stay identical to the C++ writer (see
+    # hnswlib_write for the compat semantics) —
+    # test_hnswlib_python_fallback_writer gates this
+    raft = compat == "raft"
     with open(path, "wb") as f:
-        f.write(struct.pack("<QQQQQQiIQQQdQ",
-                            0, n, n, size_per_elem,
-                            size_links0 + data_size, size_links0,
-                            0, 0, m, degree, m,
-                            1.0 / np.log(max(m, 2)), 200))
+        f.write(struct.pack(
+            "<QQQQQQiiQQQdQ",
+            0, n, n, size_per_elem,
+            size_links0 + data_size, size_links0,
+            1 if raft else 0, n // 2 if raft else 0, m, degree, m,
+            0.42424242 if raft else 1.0 / np.log(max(m, 2)),
+            500 if raft else 200))
         for i in range(n):
             links = graph[i][graph[i] >= 0].astype(np.uint32)
             buf = bytearray(size_per_elem)

@@ -130,10 +130,20 @@ int bin_write(const char* path, const void* data, int64_t n_rows,
 // Writes a base-layer-only hnswlib index: header fields in hnswlib
 // saveIndex order, one level-0 block per element
 // [uint32 n_links][maxM0 x uint32][dim x float][size_t label], then a zero
-// linkListSize per element (no upper layers; maxlevel 0, enterpoint 0).
-// space: 0 = l2, 1 = ip.
+// linkListSize per element (no upper layers). space: 0 = l2, 1 = ip.
+// raft_compat selects the header constants:
+//   0 ("hnswlib"): max_level=0, enterpoint=0 — stock hnswlib's searchKnn
+//     never descends through (absent) upper layers, so the file is safe
+//     for an unpatched HierarchicalNSW::loadIndex + search.
+//   1 ("raft"): byte-identical to the reference serializer
+//     (cagra_serialize.cuh:113-154 — max_level=1, enterpoint=n/2,
+//     mult=0.42424242, efConstruction=500), the layout its
+//     base_layer_only fork loader consumes (hnsw_types.hpp:60-86). Stock
+//     hnswlib would crash searching this variant (null upper link list at
+//     the enterpoint) — it exists for byte-compat proofs.
 int hnswlib_write(const char* path, const float* data, const int32_t* graph,
-                  int64_t n, int64_t dim, int64_t degree, int64_t /*space*/) {
+                  int64_t n, int64_t dim, int64_t degree, int64_t /*space*/,
+                  int64_t raft_compat) {
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
 
@@ -145,13 +155,14 @@ int hnswlib_write(const char* path, const float* data, const int32_t* graph,
   const uint64_t size_per_elem = size_links0 + data_size + 8;
   const uint64_t label_offset = size_links0 + data_size;
   const uint64_t offset_data = size_links0;
-  const int32_t max_level = 0;
-  const uint32_t enterpoint = 0;
+  const int32_t max_level = raft_compat ? 1 : 0;
+  const int32_t enterpoint = raft_compat ? (int32_t)(n / 2) : 0;
   const uint64_t maxM = (uint64_t)degree / 2 ? (uint64_t)degree / 2 : 1;
   const uint64_t maxM0 = (uint64_t)degree;
   const uint64_t M = maxM;
-  const double mult = 1.0 / std::log((double)(M > 1 ? M : 2));
-  const uint64_t ef_construction = 200;
+  const double mult =
+      raft_compat ? 0.42424242 : 1.0 / std::log((double)(M > 1 ? M : 2));
+  const uint64_t ef_construction = raft_compat ? 500 : 200;
 
 #define W(x) if (std::fwrite(&(x), sizeof(x), 1, f) != 1) { std::fclose(f); return -2; }
   W(offset_level0);

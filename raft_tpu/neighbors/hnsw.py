@@ -22,13 +22,19 @@ from raft_tpu import native
 from raft_tpu.ops.distance import DistanceType
 
 
-def from_cagra(cagra_index, path: str) -> None:
+def from_cagra(cagra_index, path: str, compat: str = "hnswlib") -> None:
     """Serialize a CAGRA index as a base-layer-only hnswlib file
-    (reference: hnsw::from_cagra / serialize_to_hnswlib)."""
+    (reference: hnsw::from_cagra / serialize_to_hnswlib).
+
+    ``compat="hnswlib"`` (default) is loadable AND searchable by stock
+    hnswlib; ``compat="raft"`` reproduces the reference serializer
+    byte-for-byte (its output needs the base_layer_only fork loader,
+    hnsw_types.hpp:60-86 — stock hnswlib crashes searching it)."""
     space = ("ip" if cagra_index.metric == DistanceType.InnerProduct
              else "l2")
     native.hnswlib_write(path, np.asarray(cagra_index.dataset),
-                         np.asarray(cagra_index.graph), space=space)
+                         np.asarray(cagra_index.graph), space=space,
+                         compat=compat)
 
 
 class Index:

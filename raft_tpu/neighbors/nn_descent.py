@@ -8,17 +8,24 @@ edge insertion :499-510, ``BuildConfig`` :212).
 TPU-native design: the GPU GNND's scatter-heavy local join (every candidate
 pair scatters into two per-node heaps guarded by locks) is a poor fit for
 XLA's functional model. We reformulate each NN-descent round as a **gather +
-matmul + merge** pipeline with identical fixed-point semantics (a node's
-neighborhood is improved using neighbors-of-neighbors and reverse edges):
+matmul + merge** pipeline that keeps GNND's two load-bearing mechanisms:
 
-1. candidate generation: for node i take its neighbors, a sample of
-   neighbors-of-neighbors (the forward local join), a sample of reverse
-   neighbors, and random rows (the reference's num_random_samplings analog);
-2. exact distances d(i, c) for all candidates in one tiled einsum (MXU);
-3. merge: top-k over [old ∪ candidates] with duplicate suppression.
+- **new/old edge flags** (detail/nn_descent.cuh:319-330): every edge starts
+  "new"; each round a node expands its closest still-new neighbors (their
+  whole adjacency becomes candidates) and marks them joined, so converged
+  neighborhoods stop generating work — the functional analog of GNND's
+  flag-clearing sampled lists. Flags ride the merged top-k buffer
+  (duplicate collapse ORs the flag, ops/select_k.merge_topk_dedup_flagged).
+- **symmetric local join** (:358, :499-510): besides forward 2-hop
+  candidates (v ∈ G(u), u ∈ G(i)), each round expands sampled *reverse*
+  neighbors u (i ∈ G(u)) — their lists supply exactly the (i, v) pairs
+  with i, v ∈ G(u) that GNND's pair join produces; without this, edges
+  only propagate along the forward direction and clustered data stalls.
 
-Convergence matches the classic NN-descent fixed point; iterations are a
-static ``n_iters`` so the whole build jits into one XLA program.
+Each round: flag-preferring candidate generation → exact distances in one
+tiled einsum (MXU) → flagged top-k merge with duplicate + self
+suppression. A ``while_loop`` with the update-rate termination threshold
+(BuildConfig, :212) bounds iterations inside one XLA program.
 """
 
 from __future__ import annotations
@@ -37,7 +44,7 @@ from raft_tpu.ops.distance import (
     gathered_distances,
     resolve_metric,
 )
-from raft_tpu.ops.select_k import merge_topk_dedup
+from raft_tpu.ops.select_k import merge_topk_dedup, merge_topk_dedup_flagged
 from raft_tpu.utils.shape import cdiv
 
 
@@ -121,22 +128,23 @@ def _reverse_sample(key, graph, n_rev: int):
 @functools.partial(
     jax.jit,
     static_argnames=("k_inter", "n_iters", "metric", "node_tile",
-                     "expand_width", "rev_sample"),
+                     "fwd_expand", "rev_expand", "rev_sample"),
 )
 def _build_jit(key, x, term_threshold, k_inter: int, n_iters: int,
-               metric: DistanceType, node_tile: int, expand_width: int,
-               rev_sample: int):
+               metric: DistanceType, node_tile: int, fwd_expand: int,
+               rev_expand: int, rev_sample: int):
     n, dim = x.shape
     n_tiles = cdiv(n, node_tile)
     n_pad = n_tiles * node_tile
 
-    # init: random neighbors
+    # init: random neighbors, every surviving edge flagged "new"
     k0, key = jax.random.split(key)
     graph = jax.random.randint(k0, (n, k_inter), 0, n, jnp.int32)
     d0 = _candidate_distances(x, graph, metric, node_tile)
     graph, dists = _merge_topk(
         jnp.full((n, k_inter), -1, jnp.int32),
         jnp.full((n, k_inter), jnp.inf), graph, d0, k_inter)
+    flags = jnp.zeros((n, k_inter), bool)  # False = new (not yet joined)
 
     xf_pad = jnp.pad(x, ((0, n_pad - n), (0, 0)))
     node_ids = jnp.arange(n_pad, dtype=jnp.int32).reshape(n_tiles, node_tile)
@@ -145,11 +153,11 @@ def _build_jit(key, x, term_threshold, k_inter: int, n_iters: int,
         # early termination when the update rate drops below the threshold
         # (reference: BuildConfig.termination_threshold, GNND's convergence
         # check on the per-round update counter)
-        i, _, _, _, rate = state
+        i, _, _, _, _, rate = state
         return (i < n_iters) & (rate > term_threshold)
 
     def round_body(state):
-        i, graph, dists, key = state[:4]
+        i, graph, dists, flags, key = state[:5]
         old_graph = graph
         key, k_rev, k_rand = jax.random.split(key, 3)
 
@@ -159,52 +167,73 @@ def _build_jit(key, x, term_threshold, k_inter: int, n_iters: int,
         nb = jnp.maximum(graph, 0)
 
         def tile_body(args):
-            ids_t, xt, g_t, d_t, rev_t, rand_t = args
-            # full local join over the expand_width closest neighbors: every
-            # neighbor-of-near-neighbor is a candidate (the dense, MXU-sized
-            # replacement for GNND's sampled pair join)
-            mid = jnp.maximum(g_t[:, :expand_width], 0)  # [t, E]
-            nofn = nb[mid.reshape(-1)].reshape(
-                -1, expand_width * k_inter)  # [t, E*K]
-            cand = jnp.concatenate([nofn, rev_t, rand_t], axis=1)
+            ids_t, xt, g_t, d_t, f_t, rev_t, rand_t = args
+            t = ids_t.shape[0]
+            # GNND new-list sampling: expand the closest still-new
+            # neighbors and mark them joined (flag-clear on sample,
+            # nn_descent.cuh:319-330); entries stay distance-sorted, so a
+            # stable argsort on (joined, invalid) picks new-first in rank
+            # order
+            order = jnp.argsort(f_t | (g_t < 0), axis=1, stable=True)
+            pick = order[:, :fwd_expand]  # [t, E]
+            fwd = jnp.take_along_axis(g_t, pick, axis=1)
+            fwd_ok = jnp.take_along_axis(
+                (g_t >= 0) & ~f_t, pick, axis=1)
+            rows_t = jnp.arange(t)[:, None]
+            f_t = f_t.at[rows_t, pick].set(True)
+            fwd_nofn = nb[jnp.maximum(fwd, 0).reshape(-1)].reshape(
+                t, fwd_expand * k_inter)  # new × (new ∪ old) join
+            fwd_nofn = jnp.where(
+                jnp.repeat(fwd_ok, k_inter, axis=1), fwd_nofn, -1)
+            # symmetric join: reverse neighbors' lists supply the (i, v)
+            # pairs with i, v ∈ G(u) of GNND's pair join (:358, :499-510)
+            rexp = rev_t[:, :rev_expand]
+            rev_nofn = nb[jnp.maximum(rexp, 0).reshape(-1)].reshape(
+                t, rev_expand * k_inter)
+            rev_nofn = jnp.where(
+                jnp.repeat(rexp >= 0, k_inter, axis=1), rev_nofn, -1)
+
+            cand = jnp.concatenate([fwd_nofn, rev_nofn, rev_t, rand_t],
+                                   axis=1)
+            cand = jnp.where(cand == ids_t[:, None], -1, cand)  # self
             vecs = x[jnp.maximum(cand, 0)]  # [t, C, dim]
             cd = gathered_distances(xt, vecs, metric)
             if metric == DistanceType.InnerProduct:
                 cd = -cd
             cd = jnp.where(cand < 0, jnp.inf, cd)
-            return _merge_topk_rows(g_t, d_t, cand, cd, ids_t, k_inter)
+            ids = jnp.concatenate([g_t, cand], axis=1)
+            ds = jnp.concatenate([d_t, cd], axis=1)
+            fl = jnp.concatenate(
+                [f_t, jnp.zeros_like(cand, dtype=bool)], axis=1)
+            return merge_topk_dedup_flagged(ids, ds, fl, k_inter)
 
         g_pad = jnp.pad(graph, ((0, n_pad - n), (0, 0)), constant_values=-1)
         d_pad = jnp.pad(dists, ((0, n_pad - n), (0, 0)),
                         constant_values=jnp.inf)
+        f_pad = jnp.pad(flags, ((0, n_pad - n), (0, 0)),
+                        constant_values=True)
         rev_pad = jnp.pad(rev, ((0, n_pad - n), (0, 0)), constant_values=-1)
         rand_pad = jnp.pad(rand, ((0, n_pad - n), (0, 0)), constant_values=-1)
-        new_g, new_d = jax.lax.map(
+        new_g, new_d, new_f = jax.lax.map(
             tile_body,
             (node_ids,
              xf_pad.reshape(n_tiles, node_tile, dim),
              g_pad.reshape(n_tiles, node_tile, k_inter),
              d_pad.reshape(n_tiles, node_tile, k_inter),
+             f_pad.reshape(n_tiles, node_tile, k_inter),
              rev_pad.reshape(n_tiles, node_tile, rev_sample),
              rand_pad.reshape(n_tiles, node_tile, 8)),
         )
         new_graph = new_g.reshape(n_pad, k_inter)[:n]
         dists = new_d.reshape(n_pad, k_inter)[:n]
+        flags = new_f.reshape(n_pad, k_inter)[:n]
         rate = jnp.mean((new_graph != old_graph).astype(jnp.float32))
-        return i + 1, new_graph, dists, key, rate
+        return i + 1, new_graph, dists, flags, key, rate
 
-    _, graph, dists, _, _ = jax.lax.while_loop(
-        round_cond, round_body, (jnp.int32(0), graph, dists, key,
+    _, graph, dists, _, _, _ = jax.lax.while_loop(
+        round_cond, round_body, (jnp.int32(0), graph, dists, flags, key,
                                  jnp.float32(1.0)))
     return graph, dists
-
-
-def _merge_topk_rows(graph, dists, cand, cand_d, row_ids, k: int):
-    """Like _merge_topk but for a node tile whose global ids are ``row_ids``
-    (self-suppression uses the global id)."""
-    ids = jnp.concatenate([graph, cand], axis=1)
-    ds = jnp.concatenate([dists, cand_d], axis=1)
-    return merge_topk_dedup(ids, ds, k, exclude_ids=row_ids)
 
 
 class Index:
@@ -230,12 +259,15 @@ def build(
     k_inter = int(min(params.intermediate_graph_degree, n - 1))
     k_out = int(min(params.graph_degree, k_inter))
 
-    # candidate-set sizing: the dense local join expands the expand_width
-    # closest neighbors fully (E·K candidates/node/round — the coverage knob)
-    expand_width = int(np.clip(1024 // max(k_inter, 1), 4, 16))
-    expand_width = min(expand_width, k_inter)
+    # candidate-set sizing: the join expands fwd_expand still-new forward
+    # neighbors + rev_expand reverse neighbors fully ((E+R)·K candidates
+    # per node per round — the coverage knobs of GNND's sample sizes)
+    fwd_expand = int(np.clip(768 // max(k_inter, 1), 3, 12))
+    fwd_expand = min(fwd_expand, k_inter)
+    rev_expand = int(np.clip(384 // max(k_inter, 1), 2, 6))
+    rev_expand = min(rev_expand, k_inter)
     rev_sample = min(max(k_inter // 2, 16), 64)
-    n_cand = expand_width * k_inter + rev_sample + 8
+    n_cand = (fwd_expand + rev_expand) * k_inter + rev_sample + 8
     per_node = n_cand * (dim + 8) * 4 * 2
     node_tile = int(np.clip(res.workspace_limit_bytes // max(per_node, 1),
                             64, 4096))
@@ -244,5 +276,5 @@ def build(
     graph, dists = _build_jit(
         res.next_key(), x, jnp.float32(params.termination_threshold),
         k_inter, int(params.max_iterations), params.metric,
-        max(node_tile, 8), expand_width, rev_sample)
+        max(node_tile, 8), fwd_expand, rev_expand, rev_sample)
     return Index(graph[:, :k_out], dists[:, :k_out], params.metric)

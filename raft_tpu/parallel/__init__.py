@@ -1,7 +1,7 @@
 """Distributed layer (SURVEY.md §2.8): comms facade over XLA mesh
 collectives (ICI/DCN), multi-host bootstrap, sharded index build/search."""
 
-from raft_tpu.parallel import comms, sharded
+from raft_tpu.parallel import comms, host_p2p, sharded
 from raft_tpu.parallel.comms import (
     Comms,
     ReduceOp,
@@ -9,6 +9,7 @@ from raft_tpu.parallel.comms import (
     init_distributed,
     inject_comms,
 )
+from raft_tpu.parallel.host_p2p import HostP2P
 
-__all__ = ["comms", "sharded", "Comms", "ReduceOp", "init_comms",
-           "init_distributed", "inject_comms"]
+__all__ = ["comms", "host_p2p", "sharded", "Comms", "HostP2P", "ReduceOp",
+           "init_comms", "init_distributed", "inject_comms"]

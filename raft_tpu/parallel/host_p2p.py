@@ -1,0 +1,275 @@
+"""Host async point-to-point — the UCX role of the reference comms stack.
+
+Reference: ``comms_t::isend/irecv/waitall`` (core/comms.hpp:137-141), whose
+std_comms implementation runs host-side async messaging over UCX endpoints
+(comms/detail/std_comms.hpp:211-253, detail/ucp_helper.hpp) alongside
+NCCL's device collectives. Consumers use it to overlap host-side data
+exchange (metadata, ragged buffers, dataset spans) with device compute —
+the raft-dask pattern.
+
+TPU-native design: device traffic rides XLA collectives over ICI/DCN
+(:mod:`raft_tpu.parallel.comms`); this module supplies the *host* channel
+as plain TCP — no external dependency, usable across the hosts of a
+jax.distributed deployment (each process listens on its ``peers`` entry).
+Requests mirror the reference's ``request_t`` handles: ``isend``/``irecv``
+return immediately; ``waitall`` blocks on any mix of them.
+
+Ordering contract (matches MPI/UCX non-overtaking semantics): sends to one
+destination run on that destination's dedicated sender thread over one
+persistent connection, and the receiver matches messages to pending
+``irecv`` requests in post order — two isends with the same (dest, tag)
+are received in the order they were posted.
+
+Message framing: [i32 magic][i32 src][i32 tag][u64 nbytes][type byte]
+[payload]. ndarray payloads carry a dtype/shape header (npy) so they
+reconstruct on the receiving side; raw ``bytes`` pass through untouched.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import queue
+import socket
+import struct
+import threading
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+_MAGIC = 0x52465450  # "RFTP"
+_HDR = struct.Struct("<iiiQ")
+
+
+class Request:
+    """An in-flight isend/irecv (the request_t analog). ``wait`` blocks
+    until completion and, for receives, returns the payload. A receive
+    whose ``wait`` times out is cancelled: the message it would have
+    matched goes to the next ``irecv`` instead of being lost."""
+
+    def __init__(self, kind: str, lock: threading.Lock):
+        self.kind = kind
+        self._lock = lock  # endpoint matching lock
+        self._done = threading.Event()
+        self._cancelled = False
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, value=None, error: Optional[BaseException] = None):
+        self._value = value
+        self._error = error
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            with self._lock:
+                if not self._done.is_set():  # lost the race with delivery?
+                    self._cancelled = True
+                    raise TimeoutError(f"{self.kind} request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+def _encode(payload) -> Tuple[bytes, bytes]:
+    """→ (type tag, wire bytes). Arrays keep dtype/shape; bytes pass raw."""
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return b"B", bytes(payload)
+    arr = np.asarray(payload)
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return b"A", buf.getvalue()
+
+
+def _decode(tag: bytes, raw: bytes):
+    if tag == b"B":
+        return raw
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed mid-message")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+class HostP2P:
+    """One endpoint of the host p2p fabric (one per rank/process).
+
+    ``peers``: (host, port) per rank. ``peers=None`` → all-localhost at
+    ``base_port + r`` (single-host multiprocess, and the CI shape).
+    """
+
+    def __init__(self, rank: int, size: int,
+                 peers: Optional[Sequence[Tuple[str, int]]] = None,
+                 base_port: int = 41300, timeout: float = 120.0):
+        self.rank = int(rank)
+        self.size = int(size)
+        self.timeout = timeout
+        self.peers = (list(peers) if peers is not None
+                      else [("127.0.0.1", base_port + r)
+                            for r in range(size)])
+        if len(self.peers) != size:
+            raise ValueError(f"{len(self.peers)} peers for size {size}")
+        # receiver matching state, all under one lock: FIFO inbox of
+        # unclaimed messages + FIFO queue of waiting irecvs per (src, tag)
+        self._match_lock = threading.Lock()
+        self._inbox: dict = {}  # (src, tag) -> deque of payloads
+        self._waiting: dict = {}  # (src, tag) -> deque of Requests
+        # per-destination sender worker: one persistent connection, FIFO
+        self._send_queues: dict = {}
+        self._send_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        bind_host = self.peers[self.rank][0] if peers is not None \
+            else "127.0.0.1"
+        self._listener.bind((bind_host, self.peers[self.rank][1]))
+        self._listener.listen(size * 4)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"raft-tpu-hostp2p-{rank}")
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------- receive
+    def _accept_loop(self):
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        """One thread per inbound connection; messages on a connection are
+        delivered in arrival order (TCP preserves the sender's order)."""
+        try:
+            with conn:
+                while True:
+                    hdr = conn.recv(_HDR.size, socket.MSG_WAITALL)
+                    if len(hdr) < _HDR.size:
+                        return
+                    magic, src, tag, nbytes = _HDR.unpack(hdr)
+                    if magic != _MAGIC:
+                        raise ConnectionError("bad frame magic")
+                    ty = _read_exact(conn, 1)
+                    raw = _read_exact(conn, nbytes)
+                    self._deliver(src, tag, _decode(ty, raw))
+        except (ConnectionError, OSError):
+            return
+
+    def _deliver(self, src: int, tag: int, payload):
+        with self._match_lock:
+            waiting = self._waiting.get((src, tag))
+            while waiting:
+                req = waiting.popleft()
+                if not req._cancelled:
+                    req._finish(payload)
+                    return
+            self._inbox.setdefault((src, tag),
+                                   collections.deque()).append(payload)
+
+    def irecv(self, source: int, tag: int = 0) -> Request:
+        """Non-blocking receive (comms_t::irecv, core/comms.hpp:140);
+        ``req.wait()`` returns the payload. Requests posted earlier match
+        earlier messages (non-overtaking)."""
+        req = Request("irecv", self._match_lock)
+        with self._match_lock:
+            box = self._inbox.get((source, tag))
+            if box:
+                req._finish(box.popleft())
+            else:
+                self._waiting.setdefault(
+                    (source, tag), collections.deque()).append(req)
+        return req
+
+    # ---------------------------------------------------------------- send
+    def _sender_for(self, dest: int) -> "queue.Queue":
+        with self._send_lock:
+            q = self._send_queues.get(dest)
+            if q is None:
+                q = queue.Queue()
+                self._send_queues[dest] = q
+                threading.Thread(target=self._send_loop, args=(dest, q),
+                                 daemon=True,
+                                 name=f"raft-tpu-p2p-send-{dest}").start()
+            return q
+
+    def _send_loop(self, dest: int, q: "queue.Queue"):
+        """All sends to ``dest`` go through one connection in post order —
+        the non-overtaking half of the contract."""
+        sock = None
+        while not self._closed.is_set():
+            try:
+                item = q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            req, tag, ty, raw = item
+            try:
+                if sock is None:
+                    sock = socket.create_connection(self.peers[dest],
+                                                    timeout=self.timeout)
+                sock.sendall(_HDR.pack(_MAGIC, self.rank, tag, len(raw)))
+                sock.sendall(ty)
+                sock.sendall(raw)
+                req._finish()
+            except BaseException as e:  # surfaced at wait()
+                req._finish(error=e)
+                try:
+                    if sock is not None:
+                        sock.close()
+                finally:
+                    sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def isend(self, payload: Union[bytes, np.ndarray], dest: int,
+              tag: int = 0) -> Request:
+        """Non-blocking send (comms_t::isend, core/comms.hpp:137)."""
+        if not 0 <= dest < self.size:
+            raise ValueError(f"dest {dest} out of range")
+        req = Request("isend", self._match_lock)
+        ty, raw = _encode(payload)  # encode eagerly: caller may mutate
+        self._sender_for(dest).put((req, tag, ty, raw))
+        return req
+
+    # ---------------------------------------------------------------- wait
+    @staticmethod
+    def waitall(requests: List[Request],
+                timeout: Optional[float] = None) -> list:
+        """Block on a mix of send/recv requests (comms_t::waitall,
+        core/comms.hpp:141). Returns receive payloads in request order
+        (None for sends)."""
+        return [r.wait(timeout) for r in requests]
+
+    def sendrecv(self, payload, dest: int, source: int, tag: int = 0):
+        """Convenience paired exchange (device_sendrecv's host analog)."""
+        s = self.isend(payload, dest, tag)
+        r = self.irecv(source, tag)
+        self.waitall([s], self.timeout)
+        return r.wait(self.timeout)
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,139 @@
+"""Host async p2p tests — the UCX role (comms_t::isend/irecv/waitall,
+core/comms.hpp:137-141; std_comms UCX impl detail/std_comms.hpp:211-253).
+Endpoints here live in one process (threads), exactly how the reference's
+send_recv self-tests exercise the channel (comms/comms_test.hpp:269-340)."""
+
+import socket
+
+import numpy as np
+import pytest
+
+from raft_tpu.parallel.host_p2p import HostP2P
+
+
+def _ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.fixture()
+def pair():
+    ports = _ports(2)
+    peers = [("127.0.0.1", p) for p in ports]
+    a = HostP2P(0, 2, peers=peers, timeout=30)
+    b = HostP2P(1, 2, peers=peers, timeout=30)
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_isend_irecv_arrays(pair):
+    a, b = pair
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    s = a.isend(x, dest=1)
+    r = b.irecv(source=0)
+    HostP2P.waitall([s])
+    got = r.wait(30)
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, x)
+
+
+def test_bytes_passthrough_and_tags(pair):
+    a, b = pair
+    # out-of-order tags must route to the matching irecv
+    s1 = a.isend(b"tag7-payload", dest=1, tag=7)
+    s2 = a.isend(b"tag3-payload", dest=1, tag=3)
+    r3 = b.irecv(source=0, tag=3)
+    r7 = b.irecv(source=0, tag=7)
+    HostP2P.waitall([s1, s2])
+    assert r3.wait(30) == b"tag3-payload"
+    assert r7.wait(30) == b"tag7-payload"
+
+
+def test_waitall_mixed_and_ring_exchange(pair):
+    a, b = pair
+    xa = np.full((8,), 1.5, np.float32)
+    xb = np.full((8,), 2.5, np.float32)
+    reqs = [a.isend(xa, 1), b.isend(xb, 0),
+            a.irecv(source=1), b.irecv(source=0)]
+    out = HostP2P.waitall(reqs, timeout=30)
+    assert out[0] is None and out[1] is None  # sends carry no payload
+    np.testing.assert_array_equal(out[2], xb)
+    np.testing.assert_array_equal(out[3], xa)
+
+
+def test_sendrecv_paired(pair):
+    a, b = pair
+    import threading
+
+    res = {}
+
+    def right():
+        res["b"] = b.sendrecv(np.arange(3), dest=0, source=0)
+
+    t = threading.Thread(target=right)
+    t.start()
+    res["a"] = a.sendrecv(np.arange(5), dest=1, source=1)
+    t.join(30)
+    np.testing.assert_array_equal(res["a"], np.arange(3))
+    np.testing.assert_array_equal(res["b"], np.arange(5))
+
+
+def test_same_tag_messages_keep_post_order(pair):
+    """Non-overtaking: N isends with one (dest, tag) must be received by
+    N irecvs in post order (the MPI/UCX ordering contract)."""
+    a, b = pair
+    recvs = [b.irecv(source=0, tag=1) for _ in range(16)]
+    sends = [a.isend(np.array([i], np.int32), dest=1, tag=1)
+             for i in range(16)]
+    HostP2P.waitall(sends, timeout=30)
+    got = [int(r.wait(30)[0]) for r in recvs]
+    assert got == list(range(16)), got
+
+
+def test_timed_out_irecv_does_not_steal_message(pair):
+    """A cancelled (timed-out) irecv must not consume the message its
+    retry is waiting for."""
+    a, b = pair
+    r1 = b.irecv(source=0, tag=5)
+    with pytest.raises(TimeoutError):
+        r1.wait(0.2)
+    a.isend(b"late", dest=1, tag=5).wait(30)
+    r2 = b.irecv(source=0, tag=5)
+    assert r2.wait(30) == b"late"
+
+
+def test_irecv_timeout():
+    ports = _ports(1)
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=0.2)
+    try:
+        r = ep.irecv(source=0, tag=99)
+        with pytest.raises(TimeoutError):
+            r.wait(5)
+    finally:
+        ep.close()
+
+
+def test_overlap_with_device_compute(pair):
+    """The consumer pattern the facade exists for: host exchange in flight
+    while device work proceeds (raft-dask's overlap of UCX traffic with
+    stream compute)."""
+    import jax.numpy as jnp
+
+    a, b = pair
+    big = np.random.default_rng(0).standard_normal((512, 128)).astype(
+        np.float32)
+    s = a.isend(big, dest=1)
+    r = b.irecv(source=0)
+    dev = jnp.ones((256, 256)) @ jnp.ones((256, 256))  # device compute
+    out = r.wait(30)
+    HostP2P.waitall([s])
+    assert float(dev[0, 0]) == 256.0
+    np.testing.assert_array_equal(out, big)

@@ -15,6 +15,64 @@ def test_native_builds():
     assert native.available()
 
 
+def _golden_bytes(data, graph, max_level, enterpoint, mult, ef):
+    """Hand-packed hnswlib saveIndex bytes, authored independently from the
+    reference serializer's field list (cagra_serialize.cuh:113-202)."""
+    import struct
+
+    n, dim = data.shape
+    degree = graph.shape[1]
+    size_links0 = degree * 4 + 4
+    size_per_elem = size_links0 + dim * 4 + 8
+    return b"".join([
+        struct.pack("<Q", 0),                  # offset_level_0
+        struct.pack("<Q", n),                  # max_element
+        struct.pack("<Q", n),                  # curr_element_count
+        struct.pack("<Q", size_per_elem),      # size_data_per_element
+        struct.pack("<Q", size_per_elem - 8),  # label_offset
+        struct.pack("<Q", size_links0),        # offset_data
+        struct.pack("<i", max_level),
+        struct.pack("<i", enterpoint),
+        struct.pack("<Q", degree // 2),        # max_M
+        struct.pack("<Q", degree),             # max_M0
+        struct.pack("<Q", degree // 2),        # M
+        struct.pack("<d", mult),
+        struct.pack("<Q", ef),                 # efConstruction
+    ] + [
+        # per element: [int link_count][degree x uint32][dim x f32][size_t]
+        struct.pack("<i", degree)
+        + graph[i].astype("<u4").tobytes()
+        + data[i].astype("<f4").tobytes()
+        + struct.pack("<Q", i)
+        for i in range(n)
+    ] + [struct.pack("<i", 0)] * n)            # linkListSize zeros
+
+
+def test_hnswlib_golden_byte_layout(tmp_path):
+    """Byte-for-byte gate of the native hnswlib writer against hand-packed
+    fixtures — not a round-trip through our own parser (VERDICT r1 #8).
+    ``compat="raft"`` must equal the reference serializer's output
+    (cagra_serialize.cuh:113-202, the base_layer_only loader contract of
+    hnsw_types.hpp:60-86); ``compat="hnswlib"`` must emit the stock-safe
+    max_level=0/enterpoint=0 header."""
+    n, dim, degree = 3, 2, 2
+    data = np.arange(n * dim, dtype=np.float32).reshape(n, dim) * 0.5
+    graph = np.array([[1, 2], [0, 2], [0, 1]], np.int32)
+
+    for compat, (lvl, ep, mult, ef) in {
+        "raft": (1, n // 2, 0.42424242, 500),
+        "hnswlib": (0, 0, 1.0 / np.log(max(degree // 2, 2)), 200),
+    }.items():
+        path = str(tmp_path / f"golden_{compat}.hnsw")
+        native.hnswlib_write(path, data, graph, space="l2", compat=compat)
+        got = open(path, "rb").read()
+        want = _golden_bytes(data, graph, lvl, ep, mult, ef)
+        assert got == want, (
+            f"{compat}: diverges at byte "
+            f"{next((i for i, (a, b) in enumerate(zip(got, want)) if a != b), 'len')}"
+            f" (len {len(got)} vs {len(want)})")
+
+
 def test_bin_roundtrip(tmp_path, rng):
     x = rng.standard_normal((100, 16)).astype(np.float32)
     p = str(tmp_path / "data.fbin")
@@ -97,12 +155,14 @@ def test_hnswlib_python_fallback_writer(tmp_path, rng):
 
     db = rng.standard_normal((50, 8)).astype(np.float32)
     graph = rng.integers(0, 50, (50, 8)).astype(np.int32)
-    p1 = str(tmp_path / "c.hnsw")
-    p2 = str(tmp_path / "py.hnsw")
-    native.hnswlib_write(p1, db, graph)
-    native._hnswlib_write_py(p2, db, graph)
-    with open(p1, "rb") as f1, open(p2, "rb") as f2:
-        assert f1.read() == f2.read(), "C++ and python writers must agree"
+    for compat in ("hnswlib", "raft"):
+        p1 = str(tmp_path / f"c_{compat}.hnsw")
+        p2 = str(tmp_path / f"py_{compat}.hnsw")
+        native.hnswlib_write(p1, db, graph, compat=compat)
+        native._hnswlib_write_py(p2, db, graph, compat)
+        with open(p1, "rb") as f1, open(p2, "rb") as f2:
+            assert f1.read() == f2.read(), \
+                f"C++ and python writers must agree ({compat})"
 
 
 def test_prefetch_iterator_matches_sync(tmp_path):
