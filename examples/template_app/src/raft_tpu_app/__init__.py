@@ -1,0 +1,2 @@
+"""Template downstream app — shows the minimal surface a consumer needs
+(the role of the reference's cpp/template standalone project)."""

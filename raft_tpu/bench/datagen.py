@@ -1,0 +1,30 @@
+"""Synthetic benchmark data — single source for bench.py, the BASELINE
+target runner, and tests.
+
+Real ANN benchmark datasets (glove/deep/sift embeddings) share two
+properties the generator must reproduce or the numbers measure the
+generator, not the index: **low intrinsic dimension** (full-dim iid
+gaussians concentrate distances, so top-k gaps vanish as dim grows) and
+**one connected neighborhood manifold** (widely-separated clusters
+disconnect kNN graphs, which no graph walk can cross — only seeding can).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def low_rank_clusters(rng: np.random.Generator, n: int, dim: int,
+                      n_centers: int = 96, intrinsic: int = 16,
+                      spread: float = 1.5) -> np.ndarray:
+    """[n, dim] float32: gaussian clusters in an ``intrinsic``-dim latent
+    space (unit cluster std, centers ~ N(0, spread²)), embedded in ``dim``
+    ambient dims by one shared random projection. ``spread ≈ 1.5`` keeps
+    clusters overlapping (connected kNN graph); larger spreads separate
+    them (the disconnected regime — a seeding stress test, not a realistic
+    benchmark distribution)."""
+    proj = rng.standard_normal((intrinsic, dim)).astype(np.float32)
+    centers = rng.standard_normal((n_centers, intrinsic)) * spread
+    z = (centers[rng.integers(0, n_centers, n)]
+         + rng.standard_normal((n, intrinsic)))
+    return z.astype(np.float32) @ proj

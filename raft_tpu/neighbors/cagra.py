@@ -468,8 +468,12 @@ def search(
         # itopk buffer, bounded
         max_iter = int(np.clip(itopk // width + 10, 16, 200))
     n_rand = max(int(params.num_random_samplings), 1)
-    buf_size = itopk + width * index.graph_degree
-    n_seeds = min(max(itopk, n_rand * 16), index.size, buf_size)
+    # num_random_samplings multiplies the random seed pool (the reference's
+    # random init batches, search_plan.cuh) — the recall lever when the
+    # dataset has many well-separated clusters: a kNN graph cannot walk
+    # across disconnected components, so a query's component must be
+    # seeded. Seeds beyond itopk are fine: they enter through the merge.
+    n_seeds = min(max(itopk, 32) * n_rand, index.size)
     # deterministic pseudo-random seeds per query (rand_xor_mask analog)
     key = jax.random.fold_in(jax.random.key(params.rand_xor_mask & 0x7FFFFFFF),
                              queries.shape[0])

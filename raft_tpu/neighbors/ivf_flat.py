@@ -39,6 +39,7 @@ from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.neighbors import list_packing
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
 from raft_tpu.ops.select_k import select_k
 from raft_tpu.ops import rng as rrng
@@ -176,31 +177,28 @@ def extend(index: Index, new_vectors, new_indices=None,
 
     if index.list_data is None:
         data, idxs, sizes = _pack_lists(new_np, labels, index.n_lists, new_ids)
+        data, idxs, sizes = (jnp.asarray(data), jnp.asarray(idxs),
+                             jnp.asarray(sizes))
     else:
-        # merge: unpack existing valid rows, append, repack
-        old_data = np.asarray(index.list_data)
-        old_idx = np.asarray(index.list_indices)
+        # device-side append: grow the pad if needed, then segment-scatter
+        # the new batch after each list's tail — existing lists stay packed
+        # on device (same path as ivf_pq.extend; reference:
+        # build_index_kernel's list fill, detail/ivf_flat_build.cuh:123-160)
         old_sizes = np.asarray(index.list_sizes)
-        rows, ids, labs = [], [], []
-        for l in range(index.n_lists):
-            s = int(old_sizes[l])
-            if s:
-                rows.append(old_data[l, :s])
-                ids.append(old_idx[l, :s])
-                labs.append(np.full(s, l, np.int32))
-        rows.append(new_np)
-        ids.append(new_ids)
-        labs.append(labels)
-        data, idxs, sizes = _pack_lists(
-            np.concatenate(rows), np.concatenate(labs), index.n_lists,
-            np.concatenate(ids),
-        )
+        counts = np.bincount(labels, minlength=index.n_lists)
+        data, idxs = list_packing.grow_pad(
+            index.list_data, index.list_indices,
+            int((old_sizes + counts).max()))
+        data, idxs, sizes = list_packing.append_lists(
+            data, idxs, index.list_sizes,
+            jnp.asarray(new_np).astype(data.dtype), jnp.asarray(new_ids),
+            jnp.asarray(labels), index.n_lists)
     centers = index.centers
     if index.params.adaptive_centers:
-        dsum = jnp.asarray(data.astype(np.float32)).sum(axis=1)
-        centers = dsum / jnp.maximum(jnp.asarray(sizes, jnp.float32), 1.0)[:, None]
-    return Index(index.params, centers, jnp.asarray(data), jnp.asarray(idxs),
-                 jnp.asarray(sizes), index.n_rows + len(new_np))
+        dsum = data.astype(jnp.float32).sum(axis=1)
+        centers = dsum / jnp.maximum(sizes.astype(jnp.float32), 1.0)[:, None]
+    return Index(index.params, centers, data, idxs, sizes,
+                 index.n_rows + len(new_np))
 
 
 def _coarse_scores(queries, centers, metric: DistanceType):

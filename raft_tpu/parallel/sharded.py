@@ -320,8 +320,10 @@ def search_cagra(
     if max_iter <= 0:
         max_iter = int(np.clip(itopk // width + 10, 16, 200))
     degree = index.graphs.shape[2]
-    n_seeds = min(max(itopk, int(params.num_random_samplings) * 16),
-                  index.datasets.shape[1], itopk + width * degree)
+    # see cagra.search: seeds scale with num_random_samplings and may
+    # exceed the buffer (they enter through the merge)
+    n_seeds = min(max(itopk, 32) * max(int(params.num_random_samplings), 1),
+                  index.datasets.shape[1])
     key = jax.random.fold_in(
         jax.random.key(params.rand_xor_mask & 0x7FFFFFFF), nq)
     empty = jnp.zeros((0,), jnp.uint32)

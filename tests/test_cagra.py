@@ -77,6 +77,33 @@ def test_search_distances_match_exact(built, data):
     np.testing.assert_allclose(d, want, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
+def test_random_samplings_recover_disconnected_clusters():
+    """num_random_samplings scales the seed pool: with well-separated
+    clusters the kNN graph is disconnected, so recall is seed-bound —
+    more random seeds must recover it (reference lever:
+    search_params.num_random_samplings, cagra_types.hpp:66-116)."""
+    from raft_tpu.bench.datagen import low_rank_clusters
+
+    rng = np.random.default_rng(31)
+    n = 8000
+    # spread=4: deliberately disconnected clusters (the seeding stress)
+    both = low_rank_clusters(rng, n + 300, 64, n_centers=64, intrinsic=8,
+                             spread=4.0)
+    db, q = both[:n], both[n:]
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    gt = np.asarray(gt)
+    idx = cagra.build(db, cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24))
+    recalls = {}
+    for nr in (1, 8):
+        _, i = cagra.search(idx, q, 10, cagra.SearchParams(
+            itopk_size=64, search_width=2, num_random_samplings=nr))
+        recalls[nr] = float(neighborhood_recall(np.asarray(i), gt))
+    assert recalls[8] >= 0.97, recalls
+    assert recalls[8] >= recalls[1] - 1e-6, recalls
+
+
 def _naive_detour_counts(g):
     """Direct transcription of the detour-count definition (the oracle the
     blocked kernel must match bit-for-bit)."""

@@ -71,6 +71,27 @@ def test_inner_product(data):
     assert float(neighborhood_recall(np.asarray(i), want)) >= 0.999
 
 
+def test_extend_matches_single_shot_lists(data):
+    """Device-side extend must place rows/ids exactly where a from-scratch
+    pack of the same rows would (the ivf_flat analog of the ivf_pq gate)."""
+    from raft_tpu.neighbors import ivf_flat as fl
+
+    db, _ = data
+    params = fl.IndexParams(n_lists=12, add_data_on_build=False)
+    base = fl.build(db, params)
+    one = fl.extend(base, db)
+    half = len(db) // 2
+    two = fl.extend(base, db[:half])
+    two = fl.extend(two, db[half:])
+    assert two.size == one.size == len(db)
+    np.testing.assert_array_equal(np.asarray(one.list_sizes),
+                                  np.asarray(two.list_sizes))
+    np.testing.assert_array_equal(np.asarray(one.list_indices),
+                                  np.asarray(two.list_indices))
+    np.testing.assert_array_equal(np.asarray(one.list_data),
+                                  np.asarray(two.list_data))
+
+
 def test_extend(data, gt):
     db, q = data
     half = len(db) // 2

@@ -40,10 +40,10 @@ if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "cpu") == "cpu":
 import numpy as np  # noqa: E402
 
 
-def _clustered(rng, n, dim, n_centers=96, spread=3.0):
-    centers = rng.standard_normal((n_centers, dim)) * spread
-    return (centers[rng.integers(0, n_centers, n)]
-            + rng.standard_normal((n, dim))).astype(np.float32)
+def _clustered(rng, n, dim, **kw):
+    from raft_tpu.bench.datagen import low_rank_clusters
+
+    return low_rank_clusters(rng, n, dim, **kw)
 
 
 def _timed_search(search_fn, nq, iters=3):

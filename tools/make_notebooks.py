@@ -1,0 +1,98 @@
+"""Generate the notebooks/ tutorials from the examples/ scripts.
+
+Reference parity: the repo ships runnable tutorial notebooks
+(docs/source/tutorial_ivf_pq.ipynb, ivf_flat_example.ipynb) alongside the
+script form. Each example script here is the source of truth; this tool
+renders it as a notebook — module docstring → markdown intro, top-level
+``# <n>.`` comment blocks inside ``main()`` → one code cell each (dedented
+to notebook scope).
+
+Run: python tools/make_notebooks.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import textwrap
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+SCRIPTS = {
+    "tutorial_ivf_pq.py": "tutorial_ivf_pq.ipynb",
+    "ivf_flat_example.py": "ivf_flat_example.ipynb",
+    "sharded_mnmg.py": "sharded_mnmg.ipynb",
+}
+
+# notebooks always pin the CPU/current platform safely before any jax use
+_PREAMBLE = """\
+# Platform setup: pin to the available backend before first jax use.
+# (On TPU hardware remove the two config lines.)
+import jax
+jax.config.update("jax_platforms", "cpu")
+"""
+
+
+def _split_script(src: str):
+    """→ (docstring, imports+helpers, [numbered body blocks of main()])."""
+    mod = re.match(r'"""(.*?)"""', src, re.S)
+    doc = mod.group(1).strip() if mod else ""
+    rest = src[mod.end():] if mod else src
+    m = re.search(r"(?m)^def main\(\)[^\n]*:\n", rest)
+    head = rest[: m.start()] if m else rest
+    head = "\n".join(
+        ln for ln in head.splitlines()
+        if not ln.startswith("if __name__")).strip()
+    blocks = []
+    if m:
+        body = rest[m.end():]
+        stop = re.search(r"(?m)^\S", body)
+        body = body[: stop.start()] if stop else body
+        body = textwrap.dedent(body)
+        # split on section comments: "# <n>." or "# ---- <title>",
+        # falling back to one cell per blank-line-separated comment block
+        parts = re.split(r"(?m)^(?=# (?:\d+\.|-{2,}))", body)
+        if len(parts) == 1:
+            parts = re.split(r"(?m)^\n(?=#)", body)
+        blocks = [p.rstrip() for p in parts if p.strip()
+                  and "main()" not in p]
+    return doc, head, blocks
+
+
+def _render(script: pathlib.Path) -> dict:
+    doc, head, blocks = _split_script(script.read_text())
+    cells = [
+        {"cell_type": "markdown", "metadata": {},
+         "source": f"# {script.stem}\n\n{doc}\n\n*Generated from "
+                   f"`examples/{script.name}` by `tools/make_notebooks.py` "
+                   "— edit the script, then regenerate.*"},
+        {"cell_type": "code", "metadata": {}, "execution_count": None,
+         "outputs": [], "source": _PREAMBLE + "\n" + head},
+    ]
+    for b in blocks:
+        cells.append({"cell_type": "code", "metadata": {},
+                      "execution_count": None, "outputs": [], "source": b})
+    return {
+        "cells": cells,
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3",
+                           "language": "python", "name": "python3"},
+            "language_info": {"name": "python"},
+        },
+        "nbformat": 4,
+        "nbformat_minor": 5,
+    }
+
+
+def main():
+    out_dir = REPO / "notebooks"
+    out_dir.mkdir(exist_ok=True)
+    for script_name, nb_name in SCRIPTS.items():
+        nb = _render(REPO / "examples" / script_name)
+        (out_dir / nb_name).write_text(json.dumps(nb, indent=1))
+        print(f"wrote notebooks/{nb_name} ({len(nb['cells'])} cells)")
+
+
+if __name__ == "__main__":
+    main()
