@@ -278,6 +278,47 @@ def _pack_codes_np(codes: np.ndarray, pq_bits: int) -> np.ndarray:
     return np.packbits(flat, axis=1, bitorder="little")
 
 
+@functools.lru_cache(maxsize=None)
+def _pack_terms(pq_dim: int, pq_bits: int):
+    """Static (code index, shift) terms per output byte for device-side
+    bit-packing: byte j collects the codes whose [k·bits, (k+1)·bits) span
+    intersects [8j, 8j+8) — at most 3 codes for pq_bits ∈ [4, 8].
+    shift ≥ 0 means ``code << shift``, else ``code >> -shift``."""
+    n_bytes = pq_dim * pq_bits // 8
+    terms = []
+    for j in range(n_bytes):
+        lo_k = (8 * j) // pq_bits
+        hi_k = min((8 * j + 7) // pq_bits, pq_dim - 1)
+        terms.append([(k, k * pq_bits - 8 * j)
+                      for k in range(lo_k, hi_k + 1)])
+    width = max(len(t) for t in terms)
+    ks = np.zeros((n_bytes, width), np.int32)
+    shifts = np.zeros((n_bytes, width), np.int32)
+    valid = np.zeros((n_bytes, width), bool)
+    for j, t in enumerate(terms):
+        for w, (k, s) in enumerate(t):
+            ks[j, w], shifts[j, w], valid[j, w] = k, s, True
+    # plain numpy (trace-safe constants): this cache may be populated
+    # inside a jit trace, where a jnp array would memoize a leaked tracer
+    return ks, shifts, valid
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "pq_bits"))
+def _pack_codes_jit(codes, pq_dim: int, pq_bits: int):
+    """[..., pq_dim] int codes → [..., pq_dim·pq_bits/8] uint8, on device
+    (bit-identical to ``_pack_codes_np``; the packing half of
+    process_and_fill_codes, detail/ivf_pq_build.cuh:1185-1351)."""
+    ks, shifts, valid = _pack_terms(pq_dim, pq_bits)
+    c = jnp.take(codes.astype(jnp.int32), ks, axis=-1)  # [..., nb, w]
+    up = jnp.where(shifts >= 0, c << jnp.maximum(shifts, 0),
+                   c >> jnp.maximum(-shifts, 0))
+    up = jnp.where(valid, up, 0)
+    # in-byte bits of the terms are disjoint, so the mod-256 sum equals
+    # the OR of the in-byte contributions (out-of-byte bits fall off in
+    # the uint8 cast — they belong to neighboring bytes' own terms)
+    return up.sum(-1).astype(jnp.uint8)
+
+
 def _unpack_positions(pq_dim: int, pq_bits: int):
     """Static per-subspace (lo_byte, hi_byte, shift) for two-byte unpack."""
     pos = np.arange(pq_dim) * pq_bits
@@ -517,11 +558,12 @@ def build(
 
 
 def encode_batch(index: Index, vectors, labels,
-                 res: Optional[Resources] = None) -> np.ndarray:
+                 res: Optional[Resources] = None) -> jax.Array:
     """Residual-encode + bit-pack one batch of vectors against their coarse
-    labels → packed code bytes [n, pq_dim*pq_bits/8] (the per-batch body of
-    process_and_fill_codes, detail/ivf_pq_build.cuh:1185-1351). Shared by
-    ``extend`` and the streamed ``neighbors.ooc`` builder."""
+    labels → packed code bytes [n, pq_dim*pq_bits/8], entirely on device
+    (the per-batch body of process_and_fill_codes,
+    detail/ivf_pq_build.cuh:1185-1351). Shared by ``extend`` and the
+    streamed ``neighbors.ooc`` builder."""
     res = ensure_resources(res)
     per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
     row_tile = int(np.clip(
@@ -531,7 +573,7 @@ def encode_batch(index: Index, vectors, labels,
     codes = _encode_jit(jnp.asarray(vectors, jnp.float32),
                         jnp.asarray(labels), index.centers, index.rotation,
                         index.codebooks, per_cluster, max(row_tile, 8))
-    return _pack_codes_np(np.asarray(codes).astype(np.uint8), index.pq_bits)
+    return _pack_codes_jit(codes, index.pq_dim, index.pq_bits)
 
 
 def extend(index: Index, new_vectors, new_indices=None,

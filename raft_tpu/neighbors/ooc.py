@@ -203,7 +203,7 @@ def build_ivf_pq_from_file(path: str, params=None,
             path, batch_rows, dtype, row_range=(lo, hi)):
         rows = len(batch)
         lb = labels[start - lo:start - lo + rows]
-        packed = ivf_pq.encode_batch(index, batch, lb, res)
+        packed = np.asarray(ivf_pq.encode_batch(index, batch, lb, res))
         pos, cnt = _scatter_positions(lb, offsets)
         codes[lb, pos] = packed
         idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)

@@ -42,7 +42,7 @@ def _shard_device(comms: Comms, r: int) -> jax.Device:
     return np.asarray(np.take(comms.mesh.devices, r, axis=ax_pos)).flat[0]
 
 
-def _map_shards(comms: Comms, fn, res: Resources) -> dict:
+def _map_shards(comms: Comms, fn, res: Resources, spans=None) -> dict:
     """Run ``fn(r, shard_res)`` for every shard whose device belongs to this
     process, concurrently — one thread per local shard, each pinned to its
     shard's device via ``jax.default_device`` so per-shard builds dispatch
@@ -52,7 +52,8 @@ def _map_shards(comms: Comms, fn, res: Resources) -> dict:
     per-worker build role, raft_dask/common/comms.py:138-173).
 
     PRNG keys are pre-derived per shard (deterministic regardless of thread
-    completion order)."""
+    completion order). ``spans`` (rows per shard, when the caller knows
+    them) lets the warm-up cover every distinct shard shape exactly."""
     size = comms.size
     keys = [res.next_key() for _ in range(size)]
     devs = {r: _shard_device(comms, r) for r in range(size)}
@@ -66,13 +67,33 @@ def _map_shards(comms: Comms, fn, res: Resources) -> dict:
         with jax.default_device(devs[r]):
             results[r] = fn(r, shard_res)
 
-    if len(local) <= 1:
+    # Serial warm-up of one shard per distinct shard shape (from ``spans``
+    # when provided; endpoint shards otherwise — linspace puts the odd
+    # span sizes at the ends in the single-host case). The warm-up
+    # populates the jit cache so the parallel workers only *execute*
+    # concurrently. Concurrent XLA *compilation* of the same programs
+    # from multiple threads has segfaulted (observed on the CPU backend);
+    # compile-while-execute is the ordinary async-dispatch case and is
+    # safe.
+    if spans is not None:
+        seen: set = set()
+        warm = []
         for r in local:
-            run(r)
+            s = int(spans[r])
+            if s not in seen:
+                seen.add(s)
+                warm.append(r)
     else:
-        with ThreadPoolExecutor(max_workers=len(local)) as ex:
+        warm = [local[0]] + ([local[-1]] if len(local) > 1 else [])
+    for r in warm:
+        run(r)
+    rest = [r for r in local if r not in warm]
+    if len(rest) == 1:
+        run(rest[0])
+    elif rest:
+        with ThreadPoolExecutor(max_workers=len(rest)) as ex:
             # list() propagates the first worker exception
-            list(ex.map(run, local))
+            list(ex.map(run, rest))
     return results
 
 
@@ -172,6 +193,70 @@ def knn(
     fn = comms.run(local, (P(None, None), P(comms.axis, None)),
                    (P(None, None), P(None, None)))
     return jax.jit(fn)(q, x)
+
+
+# ---------------------------------------------- sharded pairwise distance
+
+
+def pairwise_distance(
+    comms: Comms,
+    x,
+    y,
+    metric="sqeuclidean",
+    metric_arg: float = 2.0,
+    res: Optional[Resources] = None,
+) -> jax.Array:
+    """Full [n, m] pairwise distances with BOTH operands row-sharded — the
+    MNMG pairwise primitive consumers run over raft::comms (cuML's
+    distributed pairwise role).
+
+    Ring schedule (the ring-attention pattern applied to distance tiles):
+    x shards stay put; y shards rotate over ICI via ``ppermute``, each
+    device computing one [n/S, m/S] MXU tile per step and writing it into
+    its output row-block. Peak per-device memory is O(nm/S²) per step +
+    the [n/S, m] output block; only y's shards ever move, overlapping with
+    compute (XLA schedules the collective ahead of the matmul).
+
+    Returns the distance matrix sharded over rows of ``x``.
+    """
+    ensure_resources(res)
+    m_ = resolve_metric(metric)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    n, dim = x.shape
+    m, _ = y.shape
+    size = comms.size
+    xs_rows = cdiv(n, size)
+    ys_rows = cdiv(m, size)
+    xp = jnp.pad(x, ((0, xs_rows * size - n), (0, 0)))
+    yp = jnp.pad(y, ((0, ys_rows * size - m), (0, 0)))
+    xsh = comms.shard(xp, P(comms.axis, None))
+    ysh = comms.shard(yp, P(comms.axis, None))
+
+    def local(x_loc, y_loc):
+        rank = comms.rank()
+
+        def tile(i, y_cur, out):
+            # after i ring shifts, this device holds shard (rank - i)
+            src = (rank - i) % size
+            d = _pairwise_impl(x_loc, y_cur, m_, metric_arg, 1 << 30)
+            return jax.lax.dynamic_update_slice(
+                out, d.astype(out.dtype), (0, src * ys_rows))
+
+        def step(i, carry):
+            y_cur, out = carry
+            return comms.shift(y_cur, 1), tile(i, y_cur, out)
+
+        out0 = jnp.zeros((x_loc.shape[0], ys_rows * size), jnp.float32)
+        # size-1 compute+shift steps, then a final compute — the last
+        # rotation's payload would never be read, so it is never sent
+        y_last, out = jax.lax.fori_loop(0, size - 1, step, (y_loc, out0))
+        return tile(size - 1, y_last, out)
+
+    fn = comms.run(local, (P(comms.axis, None), P(comms.axis, None)),
+                   P(comms.axis, None))
+    out = jax.jit(fn)(xsh, ysh)
+    return out[:n, :m]
 
 
 # ------------------------------------------------------- sharded k-means
@@ -282,7 +367,7 @@ def build_cagra(
         idx = cagra.build(dataset[lo:hi], params, res=shard_res)
         return np.asarray(idx.dataset), np.asarray(idx.graph)
 
-    subs = _map_shards(comms, one, res)
+    subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     # padding rows point at node 0 and are never seeded (their distances
     # are real but they are unreachable unless linked)
     return ShardedCagra(
@@ -419,7 +504,7 @@ def build_ivf_flat(
         gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
         return idx, gl_idx
 
-    subs = _map_shards(comms, one, res)
+    subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return _assemble_sharded_ivf_flat(comms, subs, params, n)
 
 
@@ -466,7 +551,7 @@ def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
             max_train_rows=max_train_rows, row_range=(lo, hi))
         return idx, np.asarray(idx.list_indices)  # ids file-absolute
 
-    subs = _map_shards(comms, one, res)
+    subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return assembler(comms, subs, params, n)
 
 
@@ -557,7 +642,7 @@ def build_ivf_pq(
         gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
         return idx, gl_idx
 
-    subs = _map_shards(comms, one, res)
+    subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return _assemble_sharded_ivf_pq(comms, subs, params, n,
                                     scan_mode=scan_mode,
                                     scan_cache_dtype=scan_cache_dtype)

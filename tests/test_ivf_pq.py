@@ -156,6 +156,22 @@ def test_extend(data, gt):
     assert float(neighborhood_recall(np.asarray(i), gt)) >= 0.7
 
 
+def test_device_pack_matches_numpy_pack():
+    """_pack_codes_jit (device) must be bit-identical to _pack_codes_np
+    (host, shared with the native packers) for every pq_bits."""
+    from raft_tpu.neighbors.ivf_pq import _pack_codes_jit, _pack_codes_np
+
+    rng = np.random.default_rng(0)
+    for pq_bits in (4, 5, 6, 7, 8):
+        pq_dim = 16 if (16 * pq_bits) % 8 == 0 else 8
+        codes = rng.integers(0, 1 << pq_bits,
+                             (37, pq_dim)).astype(np.uint8)
+        got = np.asarray(_pack_codes_jit(jnp.asarray(codes), pq_dim,
+                                         pq_bits))
+        want = _pack_codes_np(codes, pq_bits)
+        np.testing.assert_array_equal(got, want, err_msg=f"bits={pq_bits}")
+
+
 def test_extend_matches_single_shot_lists(data):
     """Device-side extend must place codes/ids exactly where a from-scratch
     pack of the same rows would (VERDICT r1 #3 gate: list contents identical

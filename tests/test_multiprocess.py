@@ -42,8 +42,8 @@ assert comms.size == 8, comms.size
 # count the shards this process actually builds (4 of 8)
 built = []
 orig = sharded._map_shards
-def counting_map(c, fn, res):
-    out = orig(c, fn, res)
+def counting_map(c, fn, res, **kw):
+    out = orig(c, fn, res, **kw)
     built.extend(out.keys())
     return out
 sharded._map_shards = counting_map

@@ -179,6 +179,21 @@ def test_sharded_ivf_pq_lut_matches_cache(comms):
                               ivf_pq.SearchParams(scan_mode="lut"))
 
 
+def test_ring_pairwise_distance_matches_single_device(comms):
+    """Ring-scheduled MNMG pairwise (x stationary, y rotating via
+    ppermute) must equal the single-device engine bit-for-bit."""
+    from raft_tpu.ops.distance import pairwise_distance as pd_single
+
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((130, 24)).astype(np.float32)
+    y = rng.standard_normal((75, 24)).astype(np.float32)
+    for metric in ("sqeuclidean", "cosine", "inner_product"):
+        got = np.asarray(sharded.pairwise_distance(comms, x, y, metric))
+        want = np.asarray(pd_single(x, y, metric))
+        assert got.shape == want.shape == (130, 75)
+        np.testing.assert_allclose(got, want, atol=1e-4, err_msg=metric)
+
+
 def test_allgatherv_gatherv(comms):
     counts = [(r % 3) + 1 for r in range(comms.size)]
     cap = max(counts)
