@@ -18,6 +18,7 @@ shards never move. Build shards rows round-robin; ids stay global.
 from __future__ import annotations
 
 import functools
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
@@ -67,14 +68,27 @@ def _map_shards(comms: Comms, fn, res: Resources, spans=None) -> dict:
         with jax.default_device(devs[r]):
             results[r] = fn(r, shard_res)
 
+    # XLA:CPU's compiler (LLVM JIT) is not safe under concurrent
+    # compilation from multiple threads — and op-by-op dispatch compiles
+    # per *device*, so even identical per-shard programs compile once per
+    # pinned device (observed segfaults in backend_compile_and_load on
+    # the 8-device virtual mesh, 128 GB free). Builds therefore run
+    # serially on the cpu platform; accelerator platforms keep the
+    # one-thread-per-shard dispatch. RAFT_TPU_PARALLEL_BUILD=1/0
+    # overrides either way.
+    force = os.environ.get("RAFT_TPU_PARALLEL_BUILD")
+    parallel = (devs[local[0]].platform != "cpu"
+                if force is None else force == "1") if local else False
+    if not parallel:
+        for r in local:
+            run(r)
+        return results
+
     # Serial warm-up of one shard per distinct shard shape (from ``spans``
     # when provided; endpoint shards otherwise — linspace puts the odd
     # span sizes at the ends in the single-host case). The warm-up
-    # populates the jit cache so the parallel workers only *execute*
-    # concurrently. Concurrent XLA *compilation* of the same programs
-    # from multiple threads has segfaulted (observed on the CPU backend);
-    # compile-while-execute is the ordinary async-dispatch case and is
-    # safe.
+    # populates the jit cache so the parallel workers mostly *execute*
+    # concurrently instead of compiling.
     if spans is not None:
         seen: set = set()
         warm = []

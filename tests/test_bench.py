@@ -40,6 +40,29 @@ def _config(files, indexes):
     }
 
 
+def test_shipped_configs_are_valid():
+    """The configs under bench/conf (the reference's run/conf role) must
+    parse into DatasetSpec + registered algos, with every search-param
+    dtype key accepted by the validators."""
+    import glob
+    import pathlib
+
+    conf_dir = pathlib.Path(runner.__file__).parent / "conf"
+    confs = sorted(glob.glob(str(conf_dir / "*.json")))
+    assert confs, "no shipped bench configs found"
+    for path in confs:
+        cfg = json.load(open(path))
+        runner.DatasetSpec(**cfg["dataset"])
+        for idx in cfg["index"]:
+            assert idx["algo"] in runner.ALGOS, (path, idx["algo"])
+            for sp in idx.get("search_params", [{}]):
+                runner._scan_dtype(sp)
+                runner._internal_distance_dtype(sp)
+                runner._lut_dtype(sp)
+                assert sp.get("scan_mode", "auto") in ("auto", "cache",
+                                                       "lut"), (path, sp)
+
+
 def test_competitor_wrappers_comparative_run(dataset_files, tmp_path):
     """Cross-library comparison in ONE run (the faiss/hnswlib wrapper role,
     bench/ann/src/faiss/faiss_wrapper.h): raft_tpu vs sklearn brute force
