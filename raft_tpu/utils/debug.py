@@ -1,0 +1,62 @@
+"""Runtime-checked execution — the sanitizer role under XLA's model.
+
+Reference: the CUDA stack relies on compute-sanitizer / stream-ordered
+discipline plus `RAFT_EXPECTS` host checks (core/error.hpp); SURVEY.md §5
+maps that, under JAX's functional model, to ``checkify`` (traced-value
+assertions inside jit) and NaN/index guards. This module packages those as
+an opt-in debug harness: zero cost when unused, no global flags flipped.
+
+    from raft_tpu.utils import debug
+
+    checked_search = debug.checked(ivf_pq.search)   # or checks=...
+    (dists, ids) = checked_search(index, q, 10)     # raises on NaN/OOB
+
+    with debug.debug_mode():                        # jax_debug_nans etc.
+        cagra.build(db)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+from jax.experimental import checkify
+
+
+#: default check set: float NaN/Inf production + out-of-bounds gathers —
+#: the two failure classes the CUDA sanitizers catch for the reference
+DEFAULT_CHECKS = checkify.float_checks | checkify.index_checks
+
+
+def checked(fn, checks=None):
+    """Wrap ``fn`` so traced-value errors (NaN/Inf, out-of-bounds indexing,
+    explicit ``checkify.check`` calls) raise ``JaxRuntimeError`` eagerly
+    instead of producing silent garbage. Works on jitted functions — the
+    checks compile into the program."""
+    checks = DEFAULT_CHECKS if checks is None else checks
+    cfn = checkify.checkify(fn, errors=checks)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def debug_mode(nans: bool = True, infs: bool = False):
+    """Scoped `jax_debug_nans`/`jax_debug_infs`: every primitive result is
+    re-checked on host and the offending op re-run un-jitted for a precise
+    traceback. Heavyweight — wrap only the region under investigation."""
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    try:
+        jax.config.update("jax_debug_nans", nans)
+        jax.config.update("jax_debug_infs", infs)
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_debug_infs", prev_infs)

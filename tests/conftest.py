@@ -26,6 +26,19 @@ import pytest  # noqa: E402
 # Force the config itself back to cpu-only for the test process.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: repeat suite runs skip most LLVM JIT work —
+# much faster, and it shrinks the exposure to an intermittent XLA:CPU
+# compiler segfault observed under heavy compile load (see ROUND_NOTES).
+# Repo-local dir (never the user's production cache); best-effort only.
+from raft_tpu.utils import enable_persistent_cache  # noqa: E402
+
+try:
+    enable_persistent_cache(os.environ.get(
+        "RAFT_TPU_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".xla_test_cache")))
+except OSError:
+    pass  # unwritable checkout: run without the cache
+
 
 @pytest.fixture(scope="session")
 def devices():

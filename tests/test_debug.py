@@ -1,0 +1,47 @@
+"""Opt-in runtime-check harness (SURVEY.md §5 sanitizer analog)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils import debug
+
+
+def test_checked_passes_clean_function():
+    f = debug.checked(jax.jit(lambda x: jnp.sqrt(x) + 1.0))
+    out = f(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_checked_catches_nan():
+    f = debug.checked(jax.jit(lambda x: jnp.log(x)))
+    with pytest.raises(Exception, match="nan"):
+        f(jnp.array([-1.0]))
+
+
+def test_checked_catches_oob_gather():
+    f = debug.checked(jax.jit(lambda x, i: x[i]))
+    with pytest.raises(Exception, match="out-of-bounds|index"):
+        f(jnp.arange(4.0), jnp.array([7]))
+
+
+def test_checked_on_library_search():
+    """The harness composes with real library entry points."""
+    from raft_tpu.neighbors import brute_force
+
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((200, 8)).astype(np.float32)
+    q = rng.standard_normal((10, 8)).astype(np.float32)
+    index = brute_force.build(db, metric="sqeuclidean")
+    d, i = debug.checked(lambda qq: brute_force.search(index, qq, 5))(q)
+    _, want = brute_force.search(index, q, 5)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(want))
+
+
+def test_debug_mode_restores_flags():
+    before = jax.config.jax_debug_nans
+    with debug.debug_mode():
+        assert jax.config.jax_debug_nans
+    assert jax.config.jax_debug_nans == before
