@@ -127,6 +127,7 @@ class HostP2P:
         # per-destination sender worker: one persistent connection, FIFO
         self._send_queues: dict = {}
         self._send_lock = threading.Lock()
+        self._conns: set = set()  # accepted connections, reaped by close()
         self._closed = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -146,6 +147,7 @@ class HostP2P:
                 conn, _ = self._listener.accept()
             except OSError:
                 return  # listener closed
+            self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -263,10 +265,35 @@ class HostP2P:
 
     def close(self):
         self._closed.set()
+        # closing an fd does NOT wake a thread blocked in accept() on
+        # Linux — poke the listener with a throwaway connection so the
+        # accept loop observes _closed and exits (no leaked threads)
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            socket.create_connection(
+                (self.peers[self.rank][0], self.peers[self.rank][1]),
+                timeout=0.5).close()
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        self._accept_thread.join(timeout=2.0)
+        # unblock _serve threads stuck in recv() on one-sided close
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
 
     def __enter__(self):
         return self

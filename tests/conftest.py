@@ -26,18 +26,13 @@ import pytest  # noqa: E402
 # Force the config itself back to cpu-only for the test process.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compile cache: repeat suite runs skip most LLVM JIT work —
-# much faster, and it shrinks the exposure to an intermittent XLA:CPU
-# compiler segfault observed under heavy compile load (see ROUND_NOTES).
-# Repo-local dir (never the user's production cache); best-effort only.
-from raft_tpu.utils import enable_persistent_cache  # noqa: E402
-
-try:
-    enable_persistent_cache(os.environ.get(
-        "RAFT_TPU_CACHE_DIR",
-        os.path.join(os.path.dirname(__file__), "..", ".xla_test_cache")))
-except OSError:
-    pass  # unwritable checkout: run without the cache
+# NOTE: do NOT enable the persistent compile cache here. On this image's
+# XLA:CPU, cached AOT executables are compiled with machine features the
+# loader reports as unsupported on the host ("+prefer-no-scatter … could
+# lead to execution errors such as SIGILL"), and cache write/load paths
+# have segfaulted mid-suite (ROUND_NOTES "Known flake"). The cache is the
+# TPU-deployment feature (utils.enable_persistent_cache) — not a CPU CI
+# accelerant.
 
 
 @pytest.fixture(scope="session")

@@ -2,6 +2,8 @@
 backend seam the reference lacks (SURVEY.md §4: raft-dask test_comms.py runs
 collectives on a LocalCUDACluster; here the mesh is the cluster)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -242,22 +244,22 @@ def test_device_send_recv_and_multicast(comms):
 
 
 @pytest.mark.slow
-def test_sharded_cagra(comms):
-    from raft_tpu.neighbors import cagra
+def test_sharded_cagra(tmp_path):
+    """Runs in a fresh subprocess: compiling the nn_descent build program
+    ~300 tests into a long-lived process intermittently segfaults this
+    image's XLA:CPU (LLVM JIT; see ROUND_NOTES "Known flake") — the same
+    compile is reliable in a fresh process, which is also how real
+    deployments encounter it."""
+    import pathlib
+    import subprocess
+    import sys
 
-    rng = np.random.default_rng(5)
-    # clustered so the graph walk converges quickly
-    centers = rng.standard_normal((20, 16)) * 6.0
-    db = (centers[rng.integers(0, 20, 2000)]
-          + rng.standard_normal((2000, 16))).astype(np.float32)
-    q = db[:40] + 0.01 * rng.standard_normal((40, 16)).astype(np.float32)
-    _, gt = brute_force.knn(q, db, k=5, metric="sqeuclidean")
-    idx = sharded.build_cagra(
-        comms, db, cagra.IndexParams(graph_degree=16,
-                                     intermediate_graph_degree=32))
-    d, i = sharded.search_cagra(idx, q, 5, cagra.SearchParams(itopk_size=32))
-    i = np.asarray(i)
-    assert i.shape == (40, 5)
-    assert (i < 2000).all() and (i >= -1).all()
-    recall = float(neighborhood_recall(i, np.asarray(gt)))
-    assert recall >= 0.8, f"sharded cagra recall {recall}"
+    body = pathlib.Path(__file__).with_name("_sharded_cagra_body.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = str(pathlib.Path(__file__).resolve().parents[1])
+    r = subprocess.run([sys.executable, str(body)], capture_output=True,
+                       text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    assert "SHARDED_CAGRA_OK" in r.stdout, r.stdout[-3000:]

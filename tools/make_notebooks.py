@@ -23,6 +23,7 @@ SCRIPTS = {
     "tutorial_ivf_pq.py": "tutorial_ivf_pq.ipynb",
     "ivf_flat_example.py": "ivf_flat_example.ipynb",
     "sharded_mnmg.py": "sharded_mnmg.ipynb",
+    "end_to_end_ann.py": "end_to_end_ann.ipynb",
 }
 
 # notebooks always pin the CPU/current platform safely before any jax use
