@@ -127,7 +127,8 @@ class HostP2P:
         # per-destination sender worker: one persistent connection, FIFO
         self._send_queues: dict = {}
         self._send_lock = threading.Lock()
-        self._conns: set = set()  # accepted connections, reaped by close()
+        self._conns: set = set()  # live accepted connections (see close())
+        self._conns_lock = threading.Lock()
         self._closed = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -147,7 +148,11 @@ class HostP2P:
                 conn, _ = self._listener.accept()
             except OSError:
                 return  # listener closed
-            self._conns.add(conn)
+            with self._conns_lock:
+                if self._closed.is_set():  # raced with close(): reap now
+                    conn.close()
+                    return
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -168,6 +173,9 @@ class HostP2P:
                     self._deliver(src, tag, _decode(ty, raw))
         except (ConnectionError, OSError):
             return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _deliver(self, src: int, tag: int, payload):
         with self._match_lock:
@@ -283,8 +291,13 @@ class HostP2P:
         except OSError:
             pass
         self._accept_thread.join(timeout=2.0)
-        # unblock _serve threads stuck in recv() on one-sided close
-        for conn in list(self._conns):
+        # unblock _serve threads stuck in recv() on one-sided close;
+        # the lock + _closed check in _accept_loop means no connection can
+        # be admitted after this reap
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
@@ -293,7 +306,6 @@ class HostP2P:
                 conn.close()
             except OSError:
                 pass
-        self._conns.clear()
 
     def __enter__(self):
         return self

@@ -40,7 +40,7 @@ def _split_script(src: str):
     mod = re.match(r'"""(.*?)"""', src, re.S)
     doc = mod.group(1).strip() if mod else ""
     rest = src[mod.end():] if mod else src
-    m = re.search(r"(?m)^def main\(\)[^\n]*:\n", rest)
+    m = re.search(r"(?m)^def main\([^\n]*\)[^\n]*:\n", rest)
     head = rest[: m.start()] if m else rest
     head = "\n".join(
         ln for ln in head.splitlines()
@@ -56,8 +56,13 @@ def _split_script(src: str):
         parts = re.split(r"(?m)^(?=# (?:\d+\.|-{2,}))", body)
         if len(parts) == 1:
             parts = re.split(r"(?m)^\n(?=#)", body)
-        blocks = [p.rstrip() for p in parts if p.strip()
-                  and "main()" not in p]
+        blocks = []
+        for p in parts:
+            # drop main()'s own return/exit plumbing — cells run flat
+            p = "\n".join(ln for ln in p.splitlines()
+                          if not re.match(r"return\b|sys\.exit", ln))
+            if p.strip() and not re.search(r"\bmain\(", p):
+                blocks.append(p.rstrip())
     return doc, head, blocks
 
 
