@@ -28,17 +28,29 @@ reconstruct on the receiving side; raw ``bytes`` pass through untouched.
 from __future__ import annotations
 
 import collections
+import errno
 import io
+import os
 import queue
+import selectors
 import socket
 import struct
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 _MAGIC = 0x52465450  # "RFTP"
 _HDR = struct.Struct("<iiiQ")
+
+
+class _EndpointClosed(ConnectionError):
+    """Sentinel for "the endpoint closed while this operation was in
+    flight". A distinct class because Python maps OSError(ECONNREFUSED/
+    ECONNRESET, ...) to ConnectionRefused/ResetError — ConnectionError
+    subclasses — so `except ConnectionError` would also swallow ordinary
+    refused connects."""
 
 
 class Request:
@@ -88,6 +100,18 @@ def _decode(tag: bytes, raw: bytes):
     if tag == b"B":
         return raw
     return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def _drain_queue(q: "queue.Queue", error: BaseException) -> None:
+    """Fail every request still sitting in a sender queue. Safe to call
+    from multiple threads: Queue.get_nowait is atomic, so each request is
+    finished exactly once."""
+    while True:
+        try:
+            req = q.get_nowait()[0]
+        except queue.Empty:
+            return
+        req._finish(error=error)
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -192,11 +216,16 @@ class HostP2P:
         """Non-blocking receive (comms_t::irecv, core/comms.hpp:140);
         ``req.wait()`` returns the payload. Requests posted earlier match
         earlier messages (non-overtaking)."""
+        if self._closed.is_set():
+            raise ConnectionError("irecv on a closed HostP2P endpoint")
         req = Request("irecv", self._match_lock)
         with self._match_lock:
             box = self._inbox.get((source, tag))
             if box:
                 req._finish(box.popleft())
+            elif self._closed.is_set():  # raced with close(): fail bounded
+                req._finish(error=ConnectionError(
+                    "HostP2P closed with receive outstanding"))
             else:
                 self._waiting.setdefault(
                     (source, tag), collections.deque()).append(req)
@@ -214,45 +243,143 @@ class HostP2P:
                                  name=f"raft-tpu-p2p-send-{dest}").start()
             return q
 
+    def _connect(self, dest: int) -> socket.socket:
+        """Open the persistent connection to ``dest``. The handshake runs
+        as a non-blocking connect polled in short slices that observe
+        ``_closed`` — closing an fd from another thread does NOT wake a
+        thread already blocked inside poll on Linux, so a plain blocking
+        connect could stall an in-flight isend's wait() for up to
+        ``timeout`` after close() returned. Sockets register in ``_conns``
+        so close() reaps them. Like socket.create_connection, every
+        getaddrinfo result (v4 and v6) is tried before giving up."""
+        host, port = self.peers[dest]
+        last_err: Optional[BaseException] = None
+        for family, stype, proto, _, addr in socket.getaddrinfo(
+                host, port, socket.AF_UNSPEC, socket.SOCK_STREAM):
+            sock = socket.socket(family, stype, proto)
+            with self._conns_lock:
+                if self._closed.is_set():
+                    sock.close()
+                    raise _EndpointClosed("HostP2P closed")
+                self._conns.add(sock)
+            try:
+                self._handshake(sock, addr, dest)
+                return sock
+            except _EndpointClosed:
+                self._drop_conn(sock)
+                raise  # closed mid-connect: don't try further addresses
+            except (OSError, TimeoutError) as e:
+                self._drop_conn(sock)
+                last_err = e
+        raise last_err if last_err is not None else OSError(
+            f"getaddrinfo returned no addresses for {host}:{port}")
+
+    def _wait_writable(self, sock: socket.socket) -> bool:
+        """One poll slice of the handshake. selectors (epoll on Linux)
+        rather than select(): no FD_SETSIZE-1024 limit. close() may reap
+        the socket concurrently — register/select then fail on the dead
+        fd, which maps to _EndpointClosed below."""
+        sel = selectors.DefaultSelector()
+        try:
+            sel.register(sock, selectors.EVENT_WRITE)
+            return bool(sel.select(0.25))
+        except (ValueError, OSError):
+            if self._closed.is_set():
+                raise _EndpointClosed("HostP2P closed during connect")
+            raise
+        finally:
+            sel.close()
+
+    def _handshake(self, sock: socket.socket, addr, dest: int) -> None:
+        """Sliced non-blocking connect (see _connect)."""
+        sock.setblocking(False)
+        rc = sock.connect_ex(addr)
+        if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
+            raise OSError(rc, os.strerror(rc))
+        deadline = time.monotonic() + self.timeout
+        while rc != 0:
+            if self._closed.is_set():
+                raise _EndpointClosed("HostP2P closed during connect")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"connect to rank {dest} {addr} timed out after "
+                    f"{self.timeout}s")
+            if self._wait_writable(sock):
+                try:
+                    rc = sock.getsockopt(socket.SOL_SOCKET,
+                                         socket.SO_ERROR)
+                except OSError:
+                    if self._closed.is_set():
+                        raise _EndpointClosed(
+                            "HostP2P closed during connect")
+                    raise
+                if rc != 0:
+                    raise OSError(rc, os.strerror(rc))
+        sock.setblocking(True)
+        sock.settimeout(self.timeout)
+
+    def _drop_conn(self, sock: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
     def _send_loop(self, dest: int, q: "queue.Queue"):
         """All sends to ``dest`` go through one connection in post order —
-        the non-overtaking half of the contract."""
+        the non-overtaking half of the contract. A send failure POISONS the
+        stream: every later request to this destination fails with the
+        original error, so the receiver can never observe a gap (message i
+        lost, i+1 delivered)."""
         sock = None
+        poison: Optional[BaseException] = None
         while not self._closed.is_set():
             try:
                 item = q.get(timeout=0.25)
             except queue.Empty:
                 continue
             req, tag, ty, raw = item
+            if poison is not None:
+                req._finish(error=ConnectionError(
+                    f"send stream to rank {dest} poisoned by earlier "
+                    f"failure: {poison!r}"))
+                continue
             try:
                 if sock is None:
-                    sock = socket.create_connection(self.peers[dest],
-                                                    timeout=self.timeout)
+                    sock = self._connect(dest)
                 sock.sendall(_HDR.pack(_MAGIC, self.rank, tag, len(raw)))
                 sock.sendall(ty)
                 sock.sendall(raw)
                 req._finish()
             except BaseException as e:  # surfaced at wait()
                 req._finish(error=e)
-                try:
-                    if sock is not None:
-                        sock.close()
-                finally:
+                poison = e
+                if sock is not None:
+                    self._drop_conn(sock)
                     sock = None
         if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._drop_conn(sock)
+        _drain_queue(q, ConnectionError(
+            f"HostP2P closed before send to rank {dest} completed"))
 
     def isend(self, payload: Union[bytes, np.ndarray], dest: int,
               tag: int = 0) -> Request:
         """Non-blocking send (comms_t::isend, core/comms.hpp:137)."""
         if not 0 <= dest < self.size:
             raise ValueError(f"dest {dest} out of range")
+        if self._closed.is_set():
+            raise ConnectionError("isend on a closed HostP2P endpoint")
         req = Request("isend", self._match_lock)
         ty, raw = _encode(payload)  # encode eagerly: caller may mutate
-        self._sender_for(dest).put((req, tag, ty, raw))
+        q = self._sender_for(dest)
+        q.put((req, tag, ty, raw))
+        if self._closed.is_set():
+            # lost the race with a concurrent close(): its drain (and the
+            # sender loop's exit drain) may already have run, so fail the
+            # late put ourselves — double-drain is safe (get is atomic)
+            _drain_queue(q, ConnectionError(
+                "HostP2P closed before send completed"))
         return req
 
     # ---------------------------------------------------------------- wait
@@ -261,8 +388,13 @@ class HostP2P:
                 timeout: Optional[float] = None) -> list:
         """Block on a mix of send/recv requests (comms_t::waitall,
         core/comms.hpp:141). Returns receive payloads in request order
-        (None for sends)."""
-        return [r.wait(timeout) for r in requests]
+        (None for sends). ``timeout`` is ONE deadline for the whole batch,
+        not per-request: each wait gets only the time remaining."""
+        if timeout is None:
+            return [r.wait() for r in requests]
+        deadline = time.monotonic() + timeout
+        return [r.wait(max(deadline - time.monotonic(), 0.0))
+                for r in requests]
 
     def sendrecv(self, payload, dest: int, source: int, tag: int = 0):
         """Convenience paired exchange (device_sendrecv's host analog)."""
@@ -306,6 +438,22 @@ class HostP2P:
                 conn.close()
             except OSError:
                 pass
+        # fail any isends still queued so no Request.wait() blocks forever
+        # (sender loops also drain on exit; double-drain is safe)
+        with self._send_lock:
+            queues = list(self._send_queues.values())
+        for q in queues:
+            _drain_queue(q, ConnectionError(
+                "HostP2P closed before send completed"))
+        # ... and symmetrically, every pending irecv: its message can no
+        # longer arrive (matching happens under _match_lock, so a request
+        # is either finished by a delivery or failed here, never both)
+        with self._match_lock:
+            waiting, self._waiting = self._waiting, {}
+        for reqs in waiting.values():
+            for req in reqs:
+                req._finish(error=ConnectionError(
+                    "HostP2P closed with receive outstanding"))
 
     def __enter__(self):
         return self

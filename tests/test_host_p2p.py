@@ -137,3 +137,121 @@ def test_overlap_with_device_compute(pair):
     HostP2P.waitall([s])
     assert float(dev[0, 0]) == 256.0
     np.testing.assert_array_equal(out, big)
+
+
+def test_close_fails_queued_sends_and_rejects_new_isend(monkeypatch):
+    """ADVICE r2: close() must not strand queued isends — every request
+    still in a sender queue fails with ConnectionError (never a hang), and
+    isend after close raises instead of silently queueing.
+
+    The sender's connect is patched to block until close() (a localhost
+    connect to a dead port fails instantly with ECONNREFUSED, which would
+    route requests through the poison path instead of the drain under
+    test): item 1 sits in-flight inside _connect, items 2-4 stay QUEUED."""
+    import raft_tpu.parallel.host_p2p as hp2p
+
+    def blocking_connect(self, dest):
+        self._closed.wait(30)
+        raise ConnectionError("connect aborted by close")
+
+    monkeypatch.setattr(hp2p.HostP2P, "_connect", blocking_connect)
+    ports = _ports(2)
+    peers = [("127.0.0.1", p) for p in ports]
+    a = HostP2P(0, 2, peers=peers, timeout=60)
+    reqs = [a.isend(b"x" * 64, dest=1) for _ in range(4)]
+    assert not any(r.done() for r in reqs)  # all pending: none connected
+    a.close()
+    for r in reqs:
+        with pytest.raises(ConnectionError):
+            r.wait(10)  # bounded: close() drained the queue
+    with pytest.raises(ConnectionError):
+        a.isend(b"late", dest=1)
+
+
+def test_close_interrupts_inflight_connect(monkeypatch):
+    """A sender blocked INSIDE the TCP handshake (peer blackholes SYNs —
+    dead host, dropped packets) must fail bounded at close(): _connect
+    polls the non-blocking handshake in short slices that observe _closed.
+    The handshake is forced to never complete (this sandbox's network
+    accepts connections to ANY address instantly, so no real blackhole
+    address exists here): connect_ex pends forever and the socket is
+    never reported writable."""
+    import time as _time
+
+    import raft_tpu.parallel.host_p2p as hp2p
+
+    monkeypatch.setattr(
+        socket.socket, "connect_ex",
+        lambda self, addr: __import__("errno").EINPROGRESS)
+    monkeypatch.setattr(
+        hp2p.HostP2P, "_wait_writable",
+        lambda self, sock: _time.sleep(0.1) or False)
+    ports = _ports(2)
+    peers = [("127.0.0.1", p) for p in ports]
+    a = HostP2P(0, 2, peers=peers, timeout=120)
+    try:
+        req = a.isend(b"x", dest=1)
+        _time.sleep(0.5)  # sender dequeues and enters the handshake loop
+        assert not req.done()  # genuinely stuck mid-handshake
+    finally:
+        a.close()
+    with pytest.raises(ConnectionError):
+        req.wait(10)  # bounded despite timeout=120
+
+
+def test_send_failure_poisons_stream(pair):
+    """ADVICE r2: after a failed send, later requests to that destination
+    fail too — the (dest, tag) stream never contains a silent gap."""
+    a, b = pair
+    # sanity: the stream works first
+    s0 = a.isend(b"ok", dest=1)
+    assert b.irecv(source=0).wait(30) == b"ok"
+    HostP2P.waitall([s0], timeout=30)
+    # break the transport under rank 0's sender: retarget dest 1 at a
+    # dead port and force reconnect by closing b's listener side
+    b.close()
+    # the established socket may absorb a send or two into its buffer
+    # before the peer's RST lands; keep sending until one fails (bounded).
+    # No reconnect ever happens: the first failure permanently poisons
+    # the stream, which is exactly the contract under test.
+    failed = False
+    for _ in range(20):
+        try:
+            a.isend(b"lost", dest=1).wait(30)
+        except OSError:
+            failed = True
+            break
+    assert failed, "no send ever failed against a closed peer"
+    s2 = a.isend(b"after", dest=1)
+    with pytest.raises(ConnectionError, match="poisoned"):
+        s2.wait(30)
+
+
+def test_waitall_single_deadline():
+    """ADVICE r2: waitall(requests, timeout) is one deadline for the whole
+    batch, not timeout x len(requests)."""
+    import time as _time
+
+    ports = _ports(1)
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=5)
+    try:
+        reqs = [ep.irecv(source=0, tag=7) for _ in range(5)]
+        t0 = _time.monotonic()
+        with pytest.raises(TimeoutError):
+            HostP2P.waitall(reqs, timeout=0.5)
+        assert _time.monotonic() - t0 < 2.0  # not 5 x 0.5 + slack
+    finally:
+        ep.close()
+
+
+def test_close_fails_pending_irecv():
+    """close() must fail pending irecvs too (their message can never
+    arrive), and irecv after close raises — symmetric with isend."""
+    ports = _ports(1)
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=5)
+    r = ep.irecv(source=0, tag=3)
+    ep.close()
+    with pytest.raises(ConnectionError):
+        r.wait(10)  # bounded, not a hang
+    with pytest.raises(ConnectionError):
+        ep.irecv(source=0)

@@ -1,0 +1,113 @@
+"""Staged TPU-tunnel probe with init-phase diagnostics.
+
+VERDICT r2 #1: the 180 s probe failed identically twice; this probe raises
+the budget (default 600 s/stage, 3 stages) and captures WHERE backend init
+hangs (PJRT plugin load vs device enumeration) by dumping the child's
+Python stacks via faulthandler at intervals. Evidence lands in a JSON
+artifact either way, so bench/judge output improves even on failure.
+
+Usage:  python tools/tpu_probe.py [--stages 3] [--timeout 600] \
+            [--out TPU_PROBE.json]
+
+Exit code 0 = TPU reachable, 1 = not reachable (artifact written).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Child payload: dump stacks every 60 s so a hang shows its frame; print
+# phase markers around each init step so the artifact shows how far it got.
+_CHILD = r"""
+import faulthandler, sys, os
+faulthandler.dump_traceback_later(60, repeat=True, file=sys.stderr)
+print("PHASE import-jax", flush=True)
+import jax
+print("PHASE jax-imported version=%s" % jax.__version__, flush=True)
+# the axon sitecustomize pre-sets jax_platforms at interpreter startup,
+# OVERRIDING the env var — re-apply the requested platform via jax.config
+force = os.environ.get("RAFT_PROBE_FORCE_PLATFORMS")
+if force:
+    jax.config.update("jax_platforms", force)
+    print("PHASE platforms-forced=%r" % force, flush=True)
+print("PHASE platforms-config=%r env=%r" % (
+    jax.config.jax_platforms, os.environ.get("JAX_PLATFORMS")), flush=True)
+print("PHASE devices-call", flush=True)
+devs = jax.devices()
+print("PHASE devices-ok n=%d kinds=%s" % (
+    len(devs), sorted({d.device_kind for d in devs})), flush=True)
+x = jax.numpy.ones((256, 256), dtype=jax.numpy.bfloat16)
+y = (x @ x).block_until_ready()
+print("PHASE matmul-ok platform=%s" % devs[0].platform, flush=True)
+"""
+
+
+def run_stage(timeout_s: int, env_overrides: dict) -> dict:
+    env = dict(os.environ)
+    env.update(env_overrides)
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run([sys.executable, "-c", _CHILD], timeout=timeout_s,
+                           capture_output=True, env=env)
+        out, err, rc, to = p.stdout, p.stderr, p.returncode, False
+    except subprocess.TimeoutExpired as e:
+        out, err, rc, to = e.stdout or b"", e.stderr or b"", None, True
+    dt = time.monotonic() - t0
+    phases = [ln for ln in out.decode("utf-8", "replace").splitlines()
+              if ln.startswith("PHASE ")]
+    return {
+        "env": env_overrides,
+        "timeout_s": timeout_s,
+        "elapsed_s": round(dt, 1),
+        "timed_out": to,
+        "returncode": rc,
+        "phases": phases,
+        "ok": bool(phases) and phases[-1].startswith("PHASE matmul-ok"),
+        "stderr_tail": err.decode("utf-8", "replace")[-3000:],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", type=int, default=3)
+    ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument("--out", default="TPU_PROBE.json")
+    args = ap.parse_args()
+
+    # Stage plans: default env (axon plugin as the sitecustomize set it
+    # up), tpu-only via jax.config (the env var alone is overridden by the
+    # sitecustomize at startup — distinguishes "axon plugin load hangs"
+    # from "no local tpu backend at all"), then default env again with TPU
+    # logging cranked up. Cycle if --stages exceeds the list.
+    plans = [
+        {},
+        {"JAX_PLATFORMS": "tpu", "RAFT_PROBE_FORCE_PLATFORMS": "tpu"},
+        {"TPU_STDERR_LOG_LEVEL": "0", "TPU_MIN_LOG_LEVEL": "0"},
+    ]
+    results = []
+    ok = False
+    for i in range(args.stages):
+        plan = plans[i % len(plans)]
+        print(f"probe stage {i + 1}/{args.stages} env={plan} "
+              f"timeout={args.timeout}s", flush=True)
+        r = run_stage(args.timeout, plan)
+        print(json.dumps({k: r[k] for k in
+                          ("elapsed_s", "timed_out", "returncode", "phases")}),
+              flush=True)
+        results.append(r)
+        if r["ok"]:
+            ok = True
+            break
+    artifact = {"ok": ok, "when": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                "stages": results}
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"probe: ok={ok} -> {args.out}", flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
