@@ -104,3 +104,14 @@ def _popcount32(x: jax.Array) -> jax.Array:
     x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
     x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
     return (x * jnp.uint32(0x01010101)) >> 24
+
+
+def filter_mask(ids: jax.Array, filter_words: jax.Array) -> jax.Array:
+    """Traceable membership test for candidate-id arrays against a bitset's
+    word array (the sample-filter bit test, sample_filter_types.hpp:27-82).
+    Negative ids (padding) index word 0 safely and should be masked by the
+    caller's validity mask. Shared by every IVF/CAGRA scan so the bit
+    arithmetic lives in exactly one place."""
+    safe_ids = jnp.maximum(ids, 0)
+    words = filter_words[safe_ids // 32]
+    return ((words >> (safe_ids % 32).astype(jnp.uint32)) & 1).astype(bool)

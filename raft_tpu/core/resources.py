@@ -71,6 +71,19 @@ class Resources:
         return jax.local_devices()[0]
 
     @property
+    def device_memory_bytes(self) -> Optional[int]:
+        """Total device memory (HBM) when the backend reports it, else
+        None (e.g. XLA:CPU). Engine/layout choices that must not OOM the
+        chip key off this (ivf_pq scan_mode="auto")."""
+        try:
+            stats = getattr(self.device, "memory_stats", lambda: None)()
+        except Exception:  # non-addressable device / backend w/o stats
+            stats = None
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+        return None
+
+    @property
     def workspace_limit_bytes(self) -> int:
         if self._workspace_limit is not None:
             return self._workspace_limit

@@ -36,6 +36,7 @@ import numpy as np
 
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.bitset import filter_mask as bitset_filter_mask
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
@@ -56,9 +57,20 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
     add_data_on_build: bool = True
+    # Padded-storage budget: list capacity is capped so L·pad plus the
+    # overflow block stays within this multiple of the raw row count; rows
+    # spilled from hot lists land in the overflow block, scanned
+    # brute-force by every query (a candidate superset — recall can only
+    # improve). The reference pays only group-of-32 padding on ragged
+    # lists (ivf_list.hpp); this bounds the dense-layout analog.
+    list_pad_expansion: float = 1.5
 
     def __post_init__(self):
         self.metric = resolve_metric(self.metric)
+        if self.list_pad_expansion < 1.0:
+            raise ValueError(
+                f"list_pad_expansion must be >= 1.0, got "
+                f"{self.list_pad_expansion}")
 
 
 @dataclasses.dataclass
@@ -80,13 +92,25 @@ class Index:
     + indices + sizes, centers, center norms)."""
 
     def __init__(self, params: IndexParams, centers, list_data, list_indices,
-                 list_sizes, n_rows: int):
+                 list_sizes, n_rows: int, overflow_data=None,
+                 overflow_indices=None):
         self.params = params
         self.centers = centers  # [n_lists, dim] fp32
         self.list_data = list_data  # [n_lists, list_pad, dim]
         self.list_indices = list_indices  # [n_lists, list_pad] int32, -1 pad
         self.list_sizes = list_sizes  # [n_lists] int32
         self.n_rows = int(n_rows)
+        # rows spilled past the capped list_pad (choose_list_pad): scanned
+        # brute-force by every query and merged into the final select_k.
+        # [n_over_pad, dim] / [n_over_pad] int32 (-1 = padding); empty in
+        # the balanced common case.
+        dim = centers.shape[1] if centers is not None else 0
+        dt = list_data.dtype if list_data is not None else jnp.float32
+        self.overflow_data = (overflow_data if overflow_data is not None
+                              else jnp.zeros((0, dim), dt))
+        self.overflow_indices = (
+            overflow_indices if overflow_indices is not None
+            else jnp.zeros((0,), jnp.int32))
         # lazy per-row squared norms for the Pallas fused scan (the
         # reference's center_norms analog at list granularity)
         self._row_norms = None
@@ -115,15 +139,34 @@ class Index:
 
 
 def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
-                ids: Optional[np.ndarray] = None):
+                ids: Optional[np.ndarray] = None,
+                max_expansion: float = 1.5):
     """Pack rows into padded [n_lists, pad, dim] storage via the native C++
     packer (host-side; analog of build_index_kernel's list fill,
-    detail/ivf_flat_build.cuh:123-160)."""
+    detail/ivf_flat_build.cuh:123-160). ``pad`` is budget-capped
+    (list_packing.choose_list_pad); rows past a hot list's cap spill to
+    the returned overflow block.
+
+    Returns (data, idxs, sizes, overflow_rows, overflow_ids)."""
     from raft_tpu import native
 
     sizes = np.bincount(labels, minlength=n_lists).astype(np.int32)
-    pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
-    return native.pack_lists(dataset, labels, n_lists, pad, ids)
+    pad = list_packing.choose_list_pad(sizes, max_expansion)
+    if ids is None:
+        ids = np.arange(len(dataset), dtype=np.int32)
+    if int(sizes.max(initial=0)) <= pad:
+        data, idxs, sizes = native.pack_lists(dataset, labels, n_lists, pad,
+                                              ids)
+        return data, idxs, sizes, *list_packing.pad_overflow_block(
+            dataset[:0], ids[:0])
+    keep = list_packing.fit_mask(labels, n_lists, pad)
+    data, idxs, sizes = native.pack_lists(
+        np.ascontiguousarray(dataset[keep]), labels[keep], n_lists, pad,
+        np.ascontiguousarray(ids[keep]))
+    over_rows, over_ids = list_packing.pad_overflow_block(
+        np.ascontiguousarray(dataset[~keep]),
+        np.ascontiguousarray(ids[~keep]))
+    return data, idxs, sizes, over_rows, over_ids
 
 
 def build(
@@ -167,38 +210,76 @@ def extend(index: Index, new_vectors, new_indices=None,
                                                 km_params, res=res))
     new_np = np.asarray(new_vectors)
     if new_indices is None:
-        # auto ids start past both the row count and any user-supplied id
+        # auto ids start past the row count and any user-supplied id —
+        # including ids that spilled to the overflow block
         base = index.n_rows
         if index.list_indices is not None:
             base = max(base, int(np.asarray(index.list_indices).max()) + 1)
+        if index.overflow_indices is not None and \
+                index.overflow_indices.shape[0]:
+            base = max(base,
+                       int(np.asarray(index.overflow_indices).max()) + 1)
         new_ids = np.arange(base, base + len(new_np), dtype=np.int32)
     else:
         new_ids = np.asarray(new_indices, np.int32)
 
     if index.list_data is None:
-        data, idxs, sizes = _pack_lists(new_np, labels, index.n_lists, new_ids)
+        data, idxs, sizes, over_rows, over_ids = _pack_lists(
+            new_np, labels, index.n_lists, new_ids,
+            index.params.list_pad_expansion)
         data, idxs, sizes = (jnp.asarray(data), jnp.asarray(idxs),
                              jnp.asarray(sizes))
+        over_rows, over_ids = jnp.asarray(over_rows), jnp.asarray(over_ids)
     else:
-        # device-side append: grow the pad if needed, then segment-scatter
-        # the new batch after each list's tail — existing lists stay packed
-        # on device (same path as ivf_pq.extend; reference:
-        # build_index_kernel's list fill, detail/ivf_flat_build.cuh:123-160)
+        # device-side append: grow the pad (budget-capped) if needed, then
+        # segment-scatter the new batch after each list's tail — existing
+        # lists stay packed on device (same path as ivf_pq.extend;
+        # reference: build_index_kernel's list fill,
+        # detail/ivf_flat_build.cuh:123-160). Rows past a hot list's cap
+        # spill to the overflow block (the pad never shrinks below the
+        # current storage — no repack on extend).
         old_sizes = np.asarray(index.list_sizes)
         counts = np.bincount(labels, minlength=index.n_lists)
+        n_over_old = int(jnp.sum(index.overflow_indices >= 0)) \
+            if len(index.overflow_indices) else 0
+        cap = max(list_packing.choose_list_pad(
+            old_sizes + counts, index.params.list_pad_expansion),
+            index.list_data.shape[1])
+        keep = list_packing.fit_mask(labels, index.n_lists, cap,
+                                     sizes=old_sizes)
         data, idxs = list_packing.grow_pad(
             index.list_data, index.list_indices,
-            int((old_sizes + counts).max()))
+            int((old_sizes + np.bincount(
+                labels[keep], minlength=index.n_lists)).max()))
         data, idxs, sizes = list_packing.append_lists(
             data, idxs, index.list_sizes,
-            jnp.asarray(new_np).astype(data.dtype), jnp.asarray(new_ids),
-            jnp.asarray(labels), index.n_lists)
+            jnp.asarray(new_np[keep]).astype(data.dtype),
+            jnp.asarray(new_ids[keep]), jnp.asarray(labels[keep]),
+            index.n_lists)
+        over_rows, over_ids = _merge_overflow(
+            index.overflow_data, index.overflow_indices, n_over_old,
+            new_np[~keep].astype(data.dtype), new_ids[~keep])
     centers = index.centers
     if index.params.adaptive_centers:
         dsum = data.astype(jnp.float32).sum(axis=1)
         centers = dsum / jnp.maximum(sizes.astype(jnp.float32), 1.0)[:, None]
     return Index(index.params, centers, data, idxs, sizes,
-                 index.n_rows + len(new_np))
+                 index.n_rows + len(new_np), over_rows, over_ids)
+
+
+def _merge_overflow(old_rows, old_ids, n_old_valid: int, new_rows_np,
+                    new_ids_np):
+    """Append spilled rows to the overflow block (8-aligned). Valid rows
+    are compacted first (padding slots sit only at the tail)."""
+    if len(new_rows_np) == 0:
+        return old_rows, old_ids
+    merged_rows = np.concatenate(
+        [np.asarray(old_rows)[:n_old_valid], new_rows_np], axis=0)
+    merged_ids = np.concatenate(
+        [np.asarray(old_ids)[:n_old_valid],
+         np.asarray(new_ids_np, np.int32)])
+    rows, ids = list_packing.pad_overflow_block(merged_rows, merged_ids)
+    return jnp.asarray(rows), jnp.asarray(ids)
 
 
 def _coarse_scores(queries, centers, metric: DistanceType):
@@ -217,18 +298,55 @@ def _coarse_scores(queries, centers, metric: DistanceType):
     return qn[:, None] + cn[None, :] - 2.0 * dots, True
 
 
+def _overflow_scan(qt, qf, o_scan, o_norms, o_ok_base, overflow_indices,
+                   filter_words, metric: DistanceType, has_filter: bool,
+                   fast_scan: bool, bad_fill):
+    """Brute-force distances of one query tile against the overflow block
+    (the spilled-rows complement of the probed-list scan): [t, O] distances
+    + broadcast ids, ready to concatenate into the final select_k."""
+    q_s = qt.astype(jnp.bfloat16) if fast_scan else qf
+    dots = jax.lax.dot_general(
+        q_s, o_scan, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(None if fast_scan else jax.lax.Precision.HIGHEST),
+    )  # [t, O]
+    if metric == DistanceType.InnerProduct:
+        od = dots
+    elif metric == DistanceType.CosineExpanded:
+        on = jnp.sqrt(jnp.maximum(o_norms, 1e-20))
+        qn = jnp.sqrt(jnp.maximum(row_norms_sq(qf), 1e-20))
+        od = 1.0 - dots / (on[None, :] * qn[:, None])
+    else:
+        od = jnp.maximum(
+            row_norms_sq(qf)[:, None] + o_norms[None, :] - 2.0 * dots, 0.0)
+        if metric == DistanceType.L2SqrtExpanded:
+            od = jnp.sqrt(od)
+    ok = o_ok_base
+    if has_filter:
+        ok = ok & bitset_filter_mask(overflow_indices, filter_words)
+    od = jnp.where(ok[None, :], od, bad_fill)
+    oi = jnp.broadcast_to(overflow_indices[None, :],
+                          (qt.shape[0], overflow_indices.shape[0]))
+    return od, oi
+
+
 def _search_core(queries, centers, list_data, list_indices, list_sizes,
                  filter_words, metric: DistanceType, k: int, n_probes: int,
                  q_tile: int, has_filter: bool, row_norms=None,
                  use_pallas: bool = False, pallas_interpret: bool = False,
-                 fast_scan: bool = False):
+                 fast_scan: bool = False, overflow_data=None,
+                 overflow_indices=None, has_overflow: bool = False):
     """Traceable search body — jitted below; also shard_mapped by
     raft_tpu.parallel.sharded for multi-device list-sharded search.
 
     ``use_pallas`` routes the probe scan through the fused scalar-prefetch
     kernel (ops.pallas_kernels.ivf_scan): probed list slabs are DMA'd
     straight to VMEM instead of materializing the [t, P, pad, dim] gather
-    in HBM; requires ``row_norms`` [L, pad]."""
+    in HBM; requires ``row_norms`` [L, pad].
+
+    ``has_overflow``: rows spilled past the capped list_pad are scanned
+    brute-force for every query and merged into the final select_k — a
+    strict candidate superset (exact distances), so recall never drops."""
     nq, dim = queries.shape
     n_lists, list_pad, _ = list_data.shape
     minimize = metric != DistanceType.InnerProduct
@@ -238,6 +356,11 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
     qp = jnp.pad(queries, ((0, pad_q), (0, 0)))
 
     valid_slot = jnp.arange(list_pad)[None, :] < list_sizes[:, None]  # [L, pad]
+    if has_overflow:
+        o_f32 = overflow_data.astype(jnp.float32)
+        o_norms = row_norms_sq(o_f32)  # [O]
+        o_ok_base = overflow_indices >= 0
+        o_scan = (overflow_data.astype(jnp.bfloat16) if fast_scan else o_f32)
 
     def q_body(qt):
         # ---- coarse: top-n_probes clusters per query
@@ -307,10 +430,7 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
         bad_fill = jnp.inf if minimize else -jnp.inf
         ok = g_valid
         if has_filter:
-            safe_ids = jnp.maximum(g_idx, 0)
-            words = filter_words[safe_ids // 32]
-            bits = ((words >> (safe_ids % 32).astype(jnp.uint32)) & 1).astype(bool)
-            ok = ok & bits
+            ok = ok & bitset_filter_mask(g_idx, filter_words)
         d = jnp.where(ok, d, bad_fill)
 
         # ---- final top-k across all probed candidates (k may exceed the
@@ -318,6 +438,13 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
         n_cand = n_probes * list_pad
         flat_d = d.reshape(qt.shape[0], n_cand)
         flat_i = g_idx.reshape(qt.shape[0], n_cand)
+        if has_overflow:
+            od, oi = _overflow_scan(qt, qf, o_scan, o_norms, o_ok_base,
+                                    overflow_indices, filter_words, metric,
+                                    has_filter, fast_scan, bad_fill)
+            flat_d = jnp.concatenate([flat_d, od], axis=1)
+            flat_i = jnp.concatenate([flat_i, oi], axis=1)
+            n_cand += od.shape[1]
         kk = min(k, n_cand)
         v, sel = select_k(flat_d, kk, select_min=minimize)
         i_out = jnp.take_along_axis(flat_i, sel, axis=1)
@@ -340,7 +467,8 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
 _search_jit = jax.jit(
     _search_core,
     static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter",
-                     "use_pallas", "pallas_interpret", "fast_scan"),
+                     "use_pallas", "pallas_interpret", "fast_scan",
+                     "has_overflow"),
 )
 
 
@@ -389,17 +517,18 @@ def search(
     # needless device-memory spike there).
     need_norms = use_pallas or (
         fast_scan and index.metric != DistanceType.InnerProduct)
+    has_overflow = index.overflow_data.shape[0] > 0
     return _search_jit(
         queries, index.centers, index.list_data, index.list_indices,
         index.list_sizes,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, int(k), n_probes, q_tile, filter is not None,
         index.ensure_row_norms() if need_norms else None, use_pallas, False,
-        fast_scan,
+        fast_scan, index.overflow_data, index.overflow_indices, has_overflow,
     )
 
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # v2: + list_pad_expansion, overflow block
 
 
 def serialize(index: Index, file) -> None:
@@ -414,11 +543,14 @@ def serialize(index: Index, file) -> None:
         w.scalar(index.params.kmeans_n_iters, "<i4")
         w.scalar(index.params.kmeans_trainset_fraction, "<f8")
         w.scalar(1 if index.params.adaptive_centers else 0, "<i4")
+        w.scalar(index.params.list_pad_expansion, "<f8")
         w.scalar(index.n_rows, "<i8")
         w.array(index.centers)
         w.array(index.list_data)
         w.array(index.list_indices)
         w.array(index.list_sizes)
+        w.array(index.overflow_data)
+        w.array(index.overflow_indices)
     finally:
         if close:
             stream.close()
@@ -434,13 +566,18 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
             n_lists=r.scalar(), metric=metric, kmeans_n_iters=r.scalar(),
             kmeans_trainset_fraction=r.scalar(),
             adaptive_centers=bool(r.scalar()),
+            # v1 files predate the capped pad: max-driven layout, no spill
+            list_pad_expansion=r.scalar() if r.version >= 2 else 1e30,
         )
         n_rows = r.scalar()
         centers = jnp.asarray(r.array())
         data = jnp.asarray(r.array())
         idxs = jnp.asarray(r.array())
         sizes = jnp.asarray(r.array())
-        return Index(params, centers, data, idxs, sizes, n_rows)
+        over_rows = jnp.asarray(r.array()) if r.version >= 2 else None
+        over_ids = jnp.asarray(r.array()) if r.version >= 2 else None
+        return Index(params, centers, data, idxs, sizes, n_rows,
+                     over_rows, over_ids)
     finally:
         if close:
             stream.close()

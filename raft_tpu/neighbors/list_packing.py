@@ -14,6 +14,80 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def choose_list_pad(sizes, max_expansion: float = 1.5,
+                    align: int = 8) -> int:
+    """Per-list capacity bounding padded storage (VERDICT r2 #2).
+
+    The reference pays only group-of-32 padding on ragged lists
+    (ivf_list.hpp); a dense [L, pad, ...] layout padded to the LARGEST
+    list lets one hot cluster inflate every list's storage — at DEEP-100M
+    nlist=50000 shapes, several-fold. This picks the largest ``align``-ed
+    capacity whose total storage — ``L·pad`` slots plus the (align-ed)
+    overflow block of rows spilled from longer lists — stays within
+    ``max_expansion ×`` the raw row count. When the max-driven pad already
+    fits the budget (the balanced common case) it is returned unchanged
+    and nothing spills.
+
+    Returns the chosen pad; overflow rows = ``sum(max(size - pad, 0))``.
+    """
+    sizes = np.asarray(sizes, np.int64)
+    n = int(sizes.sum())
+    n_lists = len(sizes)
+    up = lambda v: max(-(-int(v) // align) * align, align)  # noqa: E731
+    max_pad = up(sizes.max() if n_lists else 1)
+    budget = max_expansion * max(n, 1)
+    if n_lists * max_pad <= budget:
+        return max_pad
+    # prefix sums over descending sizes → vectorized overflow(cap)
+    s_desc = np.sort(sizes)[::-1]
+    csum = np.concatenate([[0], np.cumsum(s_desc)])
+    caps = np.arange(max_pad - align, 0, -align, dtype=np.int64)
+    m = np.searchsorted(-s_desc, -caps, side="left")  # lists with size > cap
+    overflow = csum[m] - caps * m
+    over_pad = np.where(overflow > 0,
+                        np.maximum(-(-overflow // align) * align, align), 0)
+    storage = n_lists * caps + over_pad
+    # largest cap within budget spills the fewest rows (overflow rows cost
+    # every query a scan, capacity slots only cost idle storage)
+    ok = np.flatnonzero(storage <= budget)
+    return int(caps[ok[0]]) if len(ok) else align
+
+
+def fit_mask(labels: np.ndarray, n_lists: int, cap,
+             sizes=None) -> np.ndarray:
+    """True for rows that fit their list's remaining capacity in batch
+    order, False for rows that spill to the overflow block. ``sizes``
+    gives each list's pre-batch occupancy (extend); default 0 (fresh
+    pack). ``cap`` may be scalar or per-list."""
+    labels = np.asarray(labels)
+    order = np.argsort(labels, kind="stable")
+    sl = labels[order]
+    starts = np.searchsorted(sl, np.arange(n_lists))
+    rank = np.arange(len(sl), dtype=np.int64) - starts[sl]
+    room = np.broadcast_to(np.asarray(cap, np.int64), (n_lists,)).copy()
+    if sizes is not None:
+        room = np.maximum(room - np.asarray(sizes, np.int64), 0)
+    keep = np.empty(len(labels), bool)
+    keep[order] = rank < room[sl]
+    return keep
+
+
+def pad_overflow_block(rows: np.ndarray, ids: np.ndarray,
+                       align: int = 8):
+    """Pad spilled rows/ids up to ``align`` (ids -1-filled) so the block
+    is lane-friendly; a zero-row block stays shape-[0]."""
+    n = len(rows)
+    if n == 0:
+        return rows, np.zeros((0,), np.int32)
+    pad = max(-(-n // align) * align, align)
+    out = np.zeros((pad,) + rows.shape[1:], rows.dtype)
+    out[:n] = rows
+    out_ids = np.full((pad,), -1, np.int32)
+    out_ids[:n] = ids
+    return out, out_ids
 
 
 def grow_pad(data, idxs, new_max: int):

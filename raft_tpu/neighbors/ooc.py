@@ -30,7 +30,7 @@ from raft_tpu import native
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core.resources import Resources, ensure_resources
-from raft_tpu.utils.shape import round_up_to
+from raft_tpu.neighbors import list_packing
 
 
 def sample_rows_from_file(path: str, n_sample: int, seed: int = 0,
@@ -136,23 +136,42 @@ def build_ivf_flat_from_file(path: str, params=None,
     labels = _labels_pass(path, centers, params.metric, batch_rows, dtype,
                           res, row_range=(lo, hi))
     sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
-    pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
+    pad = list_packing.choose_list_pad(sizes, params.list_pad_expansion)
 
     first = native.read_bin(path, 0, 1, dtype=dtype)
     data = np.zeros((params.n_lists, pad, dim), first.dtype)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
+    over_rows, over_ids = [], []
     for start, batch in native.iter_bin_batches_prefetch(
             path, batch_rows, dtype, row_range=(lo, hi)):
         rows = len(batch)
         lb = labels[start - lo:start - lo + rows]
+        row_ids = np.arange(start, start + rows, dtype=np.int32)
         pos, cnt = _scatter_positions(lb, offsets)
-        data[lb, pos] = batch
-        idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)
+        fits = pos < pad  # rows past a hot list's cap spill to overflow
+        data[lb[fits], pos[fits]] = batch[fits]
+        idxs[lb[fits], pos[fits]] = row_ids[fits]
+        if not fits.all():
+            over_rows.append(np.ascontiguousarray(batch[~fits]))
+            over_ids.append(row_ids[~fits])
         offsets += cnt
 
+    o_rows, o_ids = _gather_overflow(over_rows, over_ids, (0, dim),
+                                     first.dtype)
     return ivf_flat.Index(params, centers, jnp.asarray(data),
-                          jnp.asarray(idxs), jnp.asarray(sizes), n)
+                          jnp.asarray(idxs),
+                          jnp.asarray(np.minimum(sizes, pad)), n,
+                          jnp.asarray(o_rows), jnp.asarray(o_ids))
+
+
+def _gather_overflow(chunks, id_chunks, empty_shape, dtype):
+    """Concatenate spilled-row chunks into an 8-aligned overflow block."""
+    if not chunks:
+        return np.zeros(empty_shape, dtype), np.zeros((0,), np.int32)
+    return list_packing.pad_overflow_block(
+        np.concatenate(chunks, axis=0),
+        np.concatenate(id_chunks))
 
 
 def build_ivf_pq_from_file(path: str, params=None,
@@ -193,22 +212,38 @@ def build_ivf_pq_from_file(path: str, params=None,
     labels = _labels_pass(path, index.centers, params.metric, batch_rows,
                           dtype, res, row_range=(lo, hi))
     sizes = np.bincount(labels, minlength=params.n_lists).astype(np.int32)
-    pad = max(int(round_up_to(int(sizes.max()), 8)), 8)
+    pad = list_packing.choose_list_pad(sizes, params.list_pad_expansion)
     packed_width = index.pq_dim * index.pq_bits // 8
 
     codes = np.zeros((params.n_lists, pad, packed_width), np.uint8)
     idxs = np.full((params.n_lists, pad), -1, np.int32)
     offsets = np.zeros(params.n_lists, np.int64)
+    over_codes, over_labels, over_ids = [], [], []
     for start, batch in native.iter_bin_batches_prefetch(
             path, batch_rows, dtype, row_range=(lo, hi)):
         rows = len(batch)
         lb = labels[start - lo:start - lo + rows]
         packed = np.asarray(ivf_pq.encode_batch(index, batch, lb, res))
+        row_ids = np.arange(start, start + rows, dtype=np.int32)
         pos, cnt = _scatter_positions(lb, offsets)
-        codes[lb, pos] = packed
-        idxs[lb, pos] = np.arange(start, start + rows, dtype=np.int32)
+        fits = pos < pad
+        codes[lb[fits], pos[fits]] = packed[fits]
+        idxs[lb[fits], pos[fits]] = row_ids[fits]
+        if not fits.all():
+            over_codes.append(np.ascontiguousarray(packed[~fits]))
+            over_labels.append(lb[~fits])
+            over_ids.append(row_ids[~fits])
         offsets += cnt
 
+    o_codes, o_ids = _gather_overflow(over_codes, over_ids,
+                                      (0, packed_width), np.uint8)
+    o_labels = np.zeros((len(o_ids),), np.int32)
+    if over_labels:
+        lab = np.concatenate(over_labels)
+        o_labels[:len(lab)] = lab
     return ivf_pq.Index(params, index.pq_dim, index.centers, index.rotation,
                         index.codebooks, jnp.asarray(codes),
-                        jnp.asarray(idxs), jnp.asarray(sizes), n)
+                        jnp.asarray(idxs),
+                        jnp.asarray(np.minimum(sizes, pad)), n,
+                        jnp.asarray(o_codes), jnp.asarray(o_labels),
+                        jnp.asarray(o_ids))

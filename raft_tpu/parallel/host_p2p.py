@@ -274,47 +274,55 @@ class HostP2P:
         raise last_err if last_err is not None else OSError(
             f"getaddrinfo returned no addresses for {host}:{port}")
 
-    def _wait_writable(self, sock: socket.socket) -> bool:
-        """One poll slice of the handshake. selectors (epoll on Linux)
-        rather than select(): no FD_SETSIZE-1024 limit. close() may reap
-        the socket concurrently — register/select then fail on the dead
-        fd, which maps to _EndpointClosed below."""
-        sel = selectors.DefaultSelector()
+    def _wait_writable(self, sel: "selectors.BaseSelector") -> bool:
+        """One poll slice of the handshake (the socket is registered once
+        per connect — not one epoll fd per slice). close() may reap the
+        socket concurrently — polling a dead fd maps to _EndpointClosed."""
         try:
-            sel.register(sock, selectors.EVENT_WRITE)
             return bool(sel.select(0.25))
         except (ValueError, OSError):
             if self._closed.is_set():
                 raise _EndpointClosed("HostP2P closed during connect")
             raise
-        finally:
-            sel.close()
 
     def _handshake(self, sock: socket.socket, addr, dest: int) -> None:
-        """Sliced non-blocking connect (see _connect)."""
+        """Sliced non-blocking connect (see _connect). selectors (epoll on
+        Linux) rather than select(): no FD_SETSIZE-1024 limit."""
         sock.setblocking(False)
         rc = sock.connect_ex(addr)
         if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
             raise OSError(rc, os.strerror(rc))
         deadline = time.monotonic() + self.timeout
-        while rc != 0:
-            if self._closed.is_set():
-                raise _EndpointClosed("HostP2P closed during connect")
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"connect to rank {dest} {addr} timed out after "
-                    f"{self.timeout}s")
-            if self._wait_writable(sock):
+        sel = selectors.DefaultSelector()
+        try:
+            if rc != 0:
                 try:
-                    rc = sock.getsockopt(socket.SOL_SOCKET,
-                                         socket.SO_ERROR)
-                except OSError:
+                    sel.register(sock, selectors.EVENT_WRITE)
+                except (ValueError, OSError):
                     if self._closed.is_set():
                         raise _EndpointClosed(
                             "HostP2P closed during connect")
                     raise
-                if rc != 0:
-                    raise OSError(rc, os.strerror(rc))
+            while rc != 0:
+                if self._closed.is_set():
+                    raise _EndpointClosed("HostP2P closed during connect")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"connect to rank {dest} {addr} timed out after "
+                        f"{self.timeout}s")
+                if self._wait_writable(sel):
+                    try:
+                        rc = sock.getsockopt(socket.SOL_SOCKET,
+                                             socket.SO_ERROR)
+                    except OSError:
+                        if self._closed.is_set():
+                            raise _EndpointClosed(
+                                "HostP2P closed during connect")
+                        raise
+                    if rc != 0:
+                        raise OSError(rc, os.strerror(rc))
+        finally:
+            sel.close()
         sock.setblocking(True)
         sock.settimeout(self.timeout)
 

@@ -133,6 +133,15 @@ def _global_max_shape(comms: Comms, local_max: np.ndarray) -> np.ndarray:
     return np.asarray(jax.jit(fn)(x))
 
 
+def _global_any(comms: Comms, flag: bool) -> bool:
+    """OR of a per-process bool (pmax of 0/1). Decisions that gate
+    COLLECTIVES (e.g. whether overflow blocks get stacked) must be agreed
+    globally — a process-local flag would deadlock the processes that
+    disagree and compile divergent SPMD programs."""
+    return bool(_global_max_shape(
+        comms, np.asarray([1 if flag else 0], np.int64))[0])
+
+
 def _stack_sharded(comms: Comms, parts: dict, fill=0):
     """Assemble ``{r: np.ndarray}`` per-shard blocks (ragged dims allowed —
     padded with ``fill``) into a global ``[S, ...]`` array sharded
@@ -487,7 +496,8 @@ class ShardedIvfFlat:
     search is one SPMD program with an ICI candidate merge."""
 
     def __init__(self, comms: Comms, centers, list_data, list_indices,
-                 list_sizes, metric: DistanceType, n_rows: int):
+                 list_sizes, metric: DistanceType, n_rows: int,
+                 overflow_data=None, overflow_indices=None):
         self.comms = comms
         # all leading-axis [size, ...] stacked per-shard arrays
         self.centers = centers  # [S, L, dim]
@@ -496,6 +506,11 @@ class ShardedIvfFlat:
         self.list_sizes = list_sizes  # [S, L]
         self.metric = metric
         self.n_rows = n_rows
+        # per-shard budget-capped spill blocks (global ids; [S, O, dim] /
+        # [S, O], O = max over shards, -1-padded) — each device scans its
+        # own block alongside its probed lists
+        self.overflow_data = overflow_data
+        self.overflow_indices = overflow_indices
 
 
 def build_ivf_flat(
@@ -527,13 +542,18 @@ def build_ivf_flat(
     def one(r, shard_res):
         lo, hi = bounds[r], bounds[r + 1]
         idx = ivf_flat.build(dataset[lo:hi], params, res=shard_res)
-        # rewrite ids to global row ids
+        # rewrite ids to global row ids (spilled rows included)
         gl_idx = np.asarray(idx.list_indices)
         gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
-        return idx, gl_idx
+        return idx, gl_idx, _globalize_overflow_ids(idx, lo)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return _assemble_sharded_ivf_flat(comms, subs, params, n)
+
+
+def _globalize_overflow_ids(idx, lo: int) -> np.ndarray:
+    over = np.asarray(idx.overflow_indices)
+    return np.where(over >= 0, over + lo, -1).astype(np.int32)
 
 
 def build_ivf_flat_from_file(
@@ -577,7 +597,9 @@ def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
         idx = ooc_builder(
             path, params, res=shard_res, batch_rows=batch_rows, dtype=dtype,
             max_train_rows=max_train_rows, row_range=(lo, hi))
-        return idx, np.asarray(idx.list_indices)  # ids file-absolute
+        # ids are file-absolute already, overflow ids included
+        return idx, np.asarray(idx.list_indices), np.asarray(
+            idx.overflow_indices)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return assembler(comms, subs, params, n)
@@ -585,18 +607,29 @@ def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
 
 def _assemble_sharded_ivf_flat(comms: Comms, subs, params, n: int
                                ) -> ShardedIvfFlat:
-    """Place per-shard ``{r: (Index, global_ids)}`` as mesh-sharded [S, ...]
-    state (ragged list pads equalized per field; no one-host staging)."""
+    """Place per-shard ``{r: (Index, global_ids, global_overflow_ids)}``
+    as mesh-sharded [S, ...] state (ragged list pads equalized per field;
+    no one-host staging)."""
+    any_overflow = _global_any(
+        comms, any(len(go) for _, _, go in subs.values()))
     return ShardedIvfFlat(
         comms,
         _stack_sharded(comms, {r: np.asarray(i.centers)
-                               for r, (i, _) in subs.items()}),
+                               for r, (i, _, _) in subs.items()}),
         _stack_sharded(comms, {r: np.asarray(i.list_data)
-                               for r, (i, _) in subs.items()}),
-        _stack_sharded(comms, {r: g for r, (_, g) in subs.items()}, fill=-1),
+                               for r, (i, _, _) in subs.items()}),
+        _stack_sharded(comms, {r: g for r, (_, g, _) in subs.items()},
+                       fill=-1),
         _stack_sharded(comms, {r: np.asarray(i.list_sizes)
-                               for r, (i, _) in subs.items()}),
-        params.metric, n)
+                               for r, (i, _, _) in subs.items()}),
+        params.metric, n,
+        overflow_data=_stack_sharded(
+            comms, {r: np.asarray(i.overflow_data)
+                    for r, (i, _, _) in subs.items()})
+        if any_overflow else None,
+        overflow_indices=_stack_sharded(
+            comms, {r: go for r, (_, _, go) in subs.items()}, fill=-1)
+        if any_overflow else None)
 
 
 # ----------------------------------------------------- sharded ivf_pq
@@ -616,7 +649,9 @@ class ShardedIvfPq:
                  list_sizes, metric: DistanceType, n_rows: int,
                  list_decoded=None, decoded_norms=None, codebooks=None,
                  list_codes=None, per_cluster: bool = False,
-                 pq_dim: int = 0, pq_bits: int = 8):
+                 pq_dim: int = 0, pq_bits: int = 8,
+                 overflow_decoded=None, overflow_norms=None,
+                 overflow_indices=None):
         self.comms = comms
         # all leading-axis [S, ...] stacked per-shard arrays
         self.centers = centers  # [S, L, dim]
@@ -634,6 +669,12 @@ class ShardedIvfPq:
         self.per_cluster = per_cluster
         self.pq_dim = pq_dim
         self.pq_bits = pq_bits
+        # per-shard budget-capped spill blocks, decoded to full rotated
+        # vectors (see ivf_pq.ensure_overflow_decoded); global ids,
+        # [S, O, rot] / [S, O] — shared by both engines
+        self.overflow_decoded = overflow_decoded
+        self.overflow_norms = overflow_norms
+        self.overflow_indices = overflow_indices
 
 
 def build_ivf_pq(
@@ -675,7 +716,7 @@ def build_ivf_pq(
         idx = ivf_pq.build(dataset[lo:hi], params, res=shard_res)
         gl_idx = np.asarray(idx.list_indices)
         gl_idx = np.where(gl_idx >= 0, gl_idx + lo, -1).astype(np.int32)
-        return idx, gl_idx
+        return idx, gl_idx, _globalize_overflow_ids(idx, lo)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
     return _assemble_sharded_ivf_pq(comms, subs, params, n,
@@ -724,31 +765,51 @@ def _assemble_sharded_ivf_pq(comms: Comms, subs, params, n: int,
     first = next(iter(subs.values()))[0]
     common = dict(
         centers=_stack_sharded(comms, {r: np.asarray(i.centers)
-                                       for r, (i, _) in subs.items()}),
+                                       for r, (i, _, _) in subs.items()}),
         rotation=_stack_sharded(comms, {r: np.asarray(i.rotation)
-                                        for r, (i, _) in subs.items()}),
-        list_indices=_stack_sharded(comms, {r: g for r, (_, g)
+                                        for r, (i, _, _) in subs.items()}),
+        list_indices=_stack_sharded(comms, {r: g for r, (_, g, _)
                                             in subs.items()}, fill=-1),
         list_sizes=_stack_sharded(comms, {r: np.asarray(i.list_sizes)
-                                          for r, (i, _) in subs.items()}),
+                                          for r, (i, _, _) in subs.items()}),
     )
+    if _global_any(comms, any(len(go) for _, _, go in subs.values())):
+        for idx, _, _ in subs.values():
+            ivf_pq.ensure_overflow_decoded(idx, scan_cache_dtype)
+        # all-shard equalized decode dtype; a shard with no spill holds a
+        # [0, rot] block and pads to the global max with zeros/-1
+        common.update(
+            overflow_decoded=_stack_sharded(
+                comms, {r: np.asarray(
+                    i.overflow_decoded if i.overflow_decoded is not None
+                    else np.zeros((0, i.rot_dim),
+                                  dtype=jnp.dtype(scan_cache_dtype)))
+                    for r, (i, _, _) in subs.items()}),
+            overflow_norms=_stack_sharded(
+                comms, {r: np.asarray(
+                    i.overflow_norms if i.overflow_norms is not None
+                    else np.zeros((0,), np.float32))
+                    for r, (i, _, _) in subs.items()}),
+            overflow_indices=_stack_sharded(
+                comms, {r: go for r, (_, _, go) in subs.items()},
+                fill=-1))
     if scan_mode == "cache":
-        for idx, _ in subs.values():
+        for idx, _, _ in subs.values():
             ivf_pq.ensure_scan_cache(idx, scan_cache_dtype)
         return ShardedIvfPq(
             comms, **common, metric=params.metric, n_rows=n,
             list_decoded=_stack_sharded(
                 comms, {r: np.asarray(i.list_decoded)
-                        for r, (i, _) in subs.items()}),
+                        for r, (i, _, _) in subs.items()}),
             decoded_norms=_stack_sharded(
                 comms, {r: np.asarray(i.decoded_norms)
-                        for r, (i, _) in subs.items()}))
+                        for r, (i, _, _) in subs.items()}))
     return ShardedIvfPq(
         comms, **common, metric=params.metric, n_rows=n,
         codebooks=_stack_sharded(comms, {r: np.asarray(i.codebooks)
-                                         for r, (i, _) in subs.items()}),
+                                         for r, (i, _, _) in subs.items()}),
         list_codes=_stack_sharded(comms, {r: np.asarray(i.list_codes)
-                                          for r, (i, _) in subs.items()}),
+                                          for r, (i, _, _) in subs.items()}),
         per_cluster=(first.params.codebook_kind
                      == ivf_pq.CodebookGen.PER_CLUSTER),
         pq_dim=first.pq_dim, pq_bits=first.pq_bits)
@@ -797,6 +858,20 @@ def search_ivf_pq(
         vm, sel = select_k(v_all, int(k), select_min=minimize)
         return vm, jnp.take_along_axis(i_all, sel, axis=1)
 
+    has_overflow = index.overflow_decoded is not None
+    over_ops = ((index.overflow_decoded, index.overflow_norms,
+                 index.overflow_indices) if has_overflow else ())
+    over_specs = ((P(ax, None, None), P(ax, None), P(ax, None))
+                  if has_overflow else ())
+
+    def unpack_over(args):
+        # [1, O, ...] shard_map blocks → per-device overflow kwargs
+        if not has_overflow:
+            return {}
+        od, on, oi = args
+        return dict(overflow_decoded=od[0], overflow_norms=on[0],
+                    overflow_indices=oi[0], has_overflow=True)
+
     if mode == "cache":
         list_pad = index.list_decoded.shape[2]
         rot = index.list_decoded.shape[3]
@@ -806,22 +881,23 @@ def search_ivf_pq(
         if q_tile >= 8:
             q_tile -= q_tile % 8
 
-        def local(q_rep, c, ro, ld, dn, li, ls):
+        def local(q_rep, c, ro, ld, dn, li, ls, *over):
             v, i = ivf_pq._search_cache_core(
                 q_rep, c[0], ro[0], ld[0], dn[0], li[0], ls[0], empty_filter,
-                index.metric, int(k), n_probes, q_tile, False)
+                index.metric, int(k), n_probes, q_tile, False,
+                **unpack_over(over))
             return merge(v, i)
 
         fn = comms.run(
             local,
             (P(None, None), P(ax, None, None), P(ax, None, None),
              P(ax, None, None, None), P(ax, None, None), P(ax, None, None),
-             P(ax, None)),
+             P(ax, None)) + over_specs,
             (P(None, None), P(None, None)))
         q = comms.shard(queries, P(None, None))
         return jax.jit(fn)(q, index.centers, index.rotation,
                            index.list_decoded, index.decoded_norms,
-                           index.list_indices, index.list_sizes)
+                           index.list_indices, index.list_sizes, *over_ops)
 
     # LUT engine: packed codes only (the DEEP-100M/8 memory-lean shape)
     list_pad = index.list_codes.shape[2]
@@ -834,23 +910,24 @@ def search_ivf_pq(
     lut_dtype = jnp.dtype(params.lut_dtype).name
     dist_dtype = jnp.dtype(params.internal_distance_dtype).name
 
-    def local(q_rep, c, ro, cb, lc, li, ls):
+    def local(q_rep, c, ro, cb, lc, li, ls, *over):
         v, i = ivf_pq._search_lut_core(
             q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
             index.metric, int(k), n_probes, q_tile, index.per_cluster,
-            index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype)
+            index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
+            **unpack_over(over))
         return merge(v, i)
 
     fn = comms.run(
         local,
         (P(None, None), P(ax, None, None), P(ax, None, None),
          P(ax, None, None, None), P(ax, None, None, None),
-         P(ax, None, None), P(ax, None)),
+         P(ax, None, None), P(ax, None)) + over_specs,
         (P(None, None), P(None, None)))
     q = comms.shard(queries, P(None, None))
     return jax.jit(fn)(q, index.centers, index.rotation, index.codebooks,
                        index.list_codes, index.list_indices,
-                       index.list_sizes)
+                       index.list_sizes, *over_ops)
 
 
 def search_ivf_flat(
@@ -887,10 +964,9 @@ def search_ivf_flat(
         if index.list_data.dtype != jnp.float32:
             raise ValueError("scan_dtype requires fp32 list data")
 
-    def local(q_rep, c, ld, li, ls):
-        v, i = ivf_flat._search_core(
-            q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
-            int(k), n_probes, q_tile, False, fast_scan=fast_scan)
+    has_overflow = index.overflow_data is not None
+
+    def merge(v, i):
         v_all = comms.allgather(v, axis=1)
         i_all = comms.allgather(i, axis=1)
         v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)
@@ -898,6 +974,33 @@ def search_ivf_flat(
         return vm, jnp.take_along_axis(i_all, sel, axis=1)
 
     ax = comms.axis
+    if has_overflow:
+        # each device scans its own spill block alongside its probed lists
+        def local(q_rep, c, ld, li, ls, od, oi):
+            v, i = ivf_flat._search_core(
+                q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
+                int(k), n_probes, q_tile, False, fast_scan=fast_scan,
+                overflow_data=od[0], overflow_indices=oi[0],
+                has_overflow=True)
+            return merge(v, i)
+
+        fn = comms.run(
+            local,
+            (P(None, None), P(ax, None, None), P(ax, None, None, None),
+             P(ax, None, None), P(ax, None), P(ax, None, None),
+             P(ax, None)),
+            (P(None, None), P(None, None)))
+        q = comms.shard(queries, P(None, None))
+        return jax.jit(fn)(q, index.centers, index.list_data,
+                           index.list_indices, index.list_sizes,
+                           index.overflow_data, index.overflow_indices)
+
+    def local(q_rep, c, ld, li, ls):
+        v, i = ivf_flat._search_core(
+            q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
+            int(k), n_probes, q_tile, False, fast_scan=fast_scan)
+        return merge(v, i)
+
     fn = comms.run(
         local,
         (P(None, None), P(ax, None, None), P(ax, None, None, None),
