@@ -146,6 +146,22 @@ def _index_extras(k):
         rec = float(neighborhood_recall(np.asarray(i), gt))
         return {"qps": round(n_q / dt, 1), "recall": round(rec, 4)}
 
+    def lat_ms(search_small, batch):
+        """Serving latency at tiny batches (VERDICT r2 #7): median
+        wall-time of a single dispatch+sync after warmup; the query
+        bucketing in each search keeps every batch ≤ 256 on one compiled
+        program."""
+        d, i = search_small(batch)  # warm/compile the bucket
+        jax.block_until_ready((d, i))
+        samples = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            d, i = search_small(batch)
+            jax.block_until_ready((d, i))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return round(samples[len(samples) // 2] * 1e3, 3)
+
     t0 = time.perf_counter()
     fl = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=128), res=res)
     fl_build = time.perf_counter() - t0
@@ -153,6 +169,9 @@ def _index_extras(k):
     out["ivf_flat_nprobe32_bf16"] = timed(
         lambda: ivf_flat.search(fl, q, k, sp))
     out["ivf_flat_nprobe32_bf16"]["build_s"] = round(fl_build, 2)
+    for b in (1, 10):
+        out["ivf_flat_nprobe32_bf16"][f"latency_ms_b{b}"] = lat_ms(
+            lambda bb: ivf_flat.search(fl, q[:bb], k, sp), b)
 
     t0 = time.perf_counter()
     pq = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=128, pq_dim=64),
@@ -161,6 +180,9 @@ def _index_extras(k):
     psp = ivf_pq.SearchParams(n_probes=32)
     out["ivf_pq_nprobe32"] = timed(lambda: ivf_pq.search(pq, q, k, psp))
     out["ivf_pq_nprobe32"]["build_s"] = round(pq_build, 2)
+    for b in (1, 10):
+        out["ivf_pq_nprobe32"][f"latency_ms_b{b}"] = lat_ms(
+            lambda bb: ivf_pq.search(pq, q[:bb], k, psp), b)
 
     t0 = time.perf_counter()
     cg = cagra.build(db, cagra.IndexParams(graph_degree=32,
@@ -171,6 +193,9 @@ def _index_extras(k):
                              scan_dtype="bfloat16")
     out["cagra_itopk128_bf16"] = timed(lambda: cagra.search(cg, q, k, csp))
     out["cagra_itopk128_bf16"]["build_s"] = round(cg_build, 2)
+    for b in (1, 10):
+        out["cagra_itopk128_bf16"][f"latency_ms_b{b}"] = lat_ms(
+            lambda bb: cagra.search(cg, q[:bb], k, csp), b)
     return out
 
 

@@ -39,7 +39,7 @@ from raft_tpu.ops.distance import (
     _pairwise_impl,
 )
 from raft_tpu.ops.select_k import select_k
-from raft_tpu.utils.shape import cdiv
+from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
 
 class Index:
@@ -262,6 +262,8 @@ def search(index: Index, queries, k: int, filter=None,
                 "eligible: L2Expanded/L2SqrtExpanded/CosineExpanded/"
                 "InnerProduct")
     refine_mult = max(1, int(round(float(refine_ratio))))
+    nq = queries.shape[0]
+    queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     q_tile, db_tile = _choose_tiles(
         queries.shape[0], index.size, index.dim, k, res.workspace_limit_bytes
     )
@@ -272,13 +274,14 @@ def search(index: Index, queries, k: int, filter=None,
         per_row = k_refine * index.dim * 4
         q_cap = max(8, res.workspace_limit_bytes // (4 * max(per_row, 1)))
         q_tile = min(q_tile, q_cap - q_cap % 8 or 8)
-    return _knn_jit(
+    v, i = _knn_jit(
         queries, index.dataset, index.norms,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, index.metric_arg,
         k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
         fast_scan, refine_mult if fast_scan else 1,
     )
+    return v[:nq], i[:nq]
 
 
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,

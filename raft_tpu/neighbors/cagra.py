@@ -47,7 +47,7 @@ from raft_tpu.ops.distance import (
     resolve_metric,
 )
 from raft_tpu.ops.select_k import merge_topk_dedup, merge_topk_dedup_flagged
-from raft_tpu.utils.shape import cdiv
+from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
 
 class BuildAlgo(enum.IntEnum):
@@ -478,6 +478,8 @@ def search(
     if queries.shape[1] != index.dim:
         raise ValueError(
             f"query dim {queries.shape[1]} != index dim {index.dim}")
+    nq = queries.shape[0]
+    queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     itopk = max(int(params.itopk_size), k)
     width = max(int(params.search_width), 1)
     max_iter = int(params.max_iterations)
@@ -506,11 +508,12 @@ def search(
         if index.dataset.dtype != jnp.float32:
             raise ValueError("scan_dtype requires an fp32 dataset")
     scan_data = index.ensure_scan_dataset() if fast_scan else index.dataset
-    return _search_jit(
+    v, i = _search_jit(
         queries, index.dataset, scan_data, index.graph, seed_ids,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, int(k), itopk, width, max_iter, filter is not None,
         fast_scan)
+    return v[:nq], i[:nq]
 
 
 _SERIAL_VERSION = 1

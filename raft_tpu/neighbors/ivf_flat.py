@@ -44,7 +44,7 @@ from raft_tpu.neighbors import list_packing
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
 from raft_tpu.ops.select_k import select_k
 from raft_tpu.ops import rng as rrng
-from raft_tpu.utils.shape import cdiv, round_up_to
+from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
 
 @dataclasses.dataclass
@@ -492,6 +492,8 @@ def search(
     queries = jnp.asarray(queries)
     if queries.shape[1] != index.dim:
         raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    nq = queries.shape[0]
+    queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     n_probes = int(min(params.n_probes, index.n_lists))
     list_pad = index.list_data.shape[1]
     # q_tile from workspace: gathered tile is q_tile*n_probes*list_pad*dim fp32
@@ -518,7 +520,7 @@ def search(
     need_norms = use_pallas or (
         fast_scan and index.metric != DistanceType.InnerProduct)
     has_overflow = index.overflow_data.shape[0] > 0
-    return _search_jit(
+    v, i = _search_jit(
         queries, index.centers, index.list_data, index.list_indices,
         index.list_sizes,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
@@ -526,6 +528,7 @@ def search(
         index.ensure_row_norms() if need_norms else None, use_pallas, False,
         fast_scan, index.overflow_data, index.overflow_indices, has_overflow,
     )
+    return v[:nq], i[:nq]
 
 
 _SERIAL_VERSION = 2  # v2: + list_pad_expansion, overflow block

@@ -52,7 +52,7 @@ from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
 from raft_tpu.ops.select_k import select_k
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
-from raft_tpu.utils.shape import cdiv, round_up_to
+from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
 
 class CodebookGen(enum.IntEnum):
@@ -1111,6 +1111,8 @@ def search(
     queries = jnp.asarray(queries)
     if queries.shape[1] != index.dim:
         raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    nq = queries.shape[0]
+    queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     n_probes = int(min(params.n_probes, index.n_lists))
     list_pad = index.list_codes.shape[1]
     if params.scan_mode not in ("auto", "cache", "lut"):
@@ -1126,7 +1128,7 @@ def search(
     has_overflow = index.overflow_codes.shape[0] > 0
     if has_overflow:
         ensure_overflow_decoded(index, params.scan_cache_dtype)
-    if scan_mode in ("auto", "cache"):
+    if scan_mode == "cache":  # resolve_scan_mode never returns "auto"
         ensure_scan_cache(index, params.scan_cache_dtype)
         rot_dim = index.rot_dim
         # workspace: gathered decoded cache [t,P,pad,rot] bf16 + dists
@@ -1137,7 +1139,7 @@ def search(
             q_tile -= q_tile % 8
         from raft_tpu.ops import pallas_kernels as pk
 
-        return _search_cache_jit(
+        v, i = _search_cache_jit(
             queries, index.centers, index.rotation, index.list_decoded,
             index.decoded_norms, index.list_indices, index.list_sizes,
             filter.words if filter is not None else jnp.zeros((0,),
@@ -1147,6 +1149,7 @@ def search(
             index.overflow_decoded, index.overflow_norms,
             index.overflow_indices, has_overflow,
         )
+        return v[:nq], i[:nq]
     # workspace: LUT [t,P,s,book] fp32 + gathered codes [t,P,pad,bytes]
     per_q = n_probes * (index.pq_dim * index.pq_book_size * 4
                         + list_pad * (index.pq_dim * 4 + 16))
@@ -1154,7 +1157,7 @@ def search(
     if q_tile >= 8:
         q_tile -= q_tile % 8
     per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
-    return _search_jit(
+    v, i = _search_jit(
         queries, index.centers, index.rotation, index.codebooks,
         index.list_codes, index.list_indices, index.list_sizes,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
@@ -1165,6 +1168,7 @@ def search(
         index.overflow_decoded, index.overflow_norms,
         index.overflow_indices, has_overflow,
     )
+    return v[:nq], i[:nq]
 
 
 _SERIAL_VERSION = 2  # v2: + list_pad_expansion, overflow block

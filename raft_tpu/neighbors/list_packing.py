@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.utils.shape import round_up_to
+
 
 def choose_list_pad(sizes, max_expansion: float = 1.5,
                     align: int = 8) -> int:
@@ -36,8 +38,8 @@ def choose_list_pad(sizes, max_expansion: float = 1.5,
     sizes = np.asarray(sizes, np.int64)
     n = int(sizes.sum())
     n_lists = len(sizes)
-    up = lambda v: max(-(-int(v) // align) * align, align)  # noqa: E731
-    max_pad = up(sizes.max() if n_lists else 1)
+    max_pad = max(round_up_to(int(sizes.max() if n_lists else 1), align),
+                  align)
     budget = max_expansion * max(n, 1)
     if n_lists * max_pad <= budget:
         return max_pad
@@ -48,7 +50,7 @@ def choose_list_pad(sizes, max_expansion: float = 1.5,
     m = np.searchsorted(-s_desc, -caps, side="left")  # lists with size > cap
     overflow = csum[m] - caps * m
     over_pad = np.where(overflow > 0,
-                        np.maximum(-(-overflow // align) * align, align), 0)
+                        (-(-overflow // align)) * align, 0)
     storage = n_lists * caps + over_pad
     # largest cap within budget spills the fewest rows (overflow rows cost
     # every query a scan, capacity slots only cost idle storage)
@@ -82,7 +84,7 @@ def pad_overflow_block(rows: np.ndarray, ids: np.ndarray,
     n = len(rows)
     if n == 0:
         return rows, np.zeros((0,), np.int32)
-    pad = max(-(-n // align) * align, align)
+    pad = max(round_up_to(n, align), align)
     out = np.zeros((pad,) + rows.shape[1:], rows.dtype)
     out[:n] = rows
     out_ids = np.full((pad,), -1, np.int32)

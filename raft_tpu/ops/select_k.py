@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import enum
 import functools
+import json
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -35,10 +37,73 @@ class SelectAlgo(enum.Enum):
     PALLAS = "pallas"  # streaming k-extraction kernel (small k, wide rows)
 
 
-# Rows wider than this use the two-phase path under AUTO; beyond ~64k lanes a
-# single lax.top_k's full-row sort wastes HBM bandwidth vs tiled selection.
-_TWO_PHASE_THRESHOLD = 65536
 _TILE = 16384
+
+# ---------------------------------------------------------------- AUTO table
+#
+# AUTO picks DIRECT vs TWO_PHASE from a MEASURED per-platform crossover
+# table (VERDICT r2 #6: the old hardcoded 65536 was a guess): for each
+# k-band, the row width above which the tiled path wins. Produced by
+# ``tools/select_k_bench.py`` on the target backend (IVF-critical shapes:
+# batch 2048, k ∈ {10..256}, widths up to 512k — the reference's radix
+# vs warpsort decision space, detail/select_k-inl.cuh:48); override the
+# shipped tables with RAFT_TPU_SELECTK_TABLE=<artifact.json>. Platforms
+# without a measured table fall back to the "default" entry.
+#
+# Shipped CPU table measured on this image (SELECT_K_TABLE_cpu.json:
+# DIRECT won at every width ≤ 262144 and every k ≤ 256 — XLA:CPU's top_k
+# is already partial, so tiling only adds a merge pass). The "default"
+# (TPU et al) entry is provisional until tools/TPU_RUNBOOK.md's select_k
+# step runs tools/select_k_bench.py on hardware.
+_NEVER = 1 << 62
+_BUILTIN_TABLES = {
+    # k_max → min row width at which TWO_PHASE beats DIRECT
+    "cpu": {"inf": _NEVER},
+    "default": {"32": 65536, "256": 65536, "inf": 131072},
+}
+_auto_table_cache: Optional[dict] = None
+
+
+def _load_auto_table() -> dict:
+    global _auto_table_cache
+    if _auto_table_cache is None:
+        path = os.environ.get("RAFT_TPU_SELECTK_TABLE")
+        tables = dict(_BUILTIN_TABLES)
+        if path:
+            with open(path) as f:
+                art = json.load(f)
+            tables[art["platform"]] = art["crossovers"]
+        _auto_table_cache = tables
+    return _auto_table_cache
+
+
+def set_auto_table(platform: str, crossovers: Optional[dict]) -> None:
+    """Install (or with None, drop) a measured crossover table for a
+    platform: ``{"<k_max>"|"inf": min_two_phase_width}``."""
+    global _auto_table_cache
+    tables = _load_auto_table()
+    if crossovers is None:
+        tables.pop(platform, None)
+    else:
+        tables[platform] = dict(crossovers)
+    _auto_table_cache = tables
+
+
+def _resolve_auto(n: int, k: int) -> "SelectAlgo":
+    tables = _load_auto_table()
+    platform = jax.default_backend()
+    table = tables.get(platform, tables["default"])
+    # smallest k-band that covers k
+    band = None
+    for k_max, width in sorted(
+            ((float(km) if km != "inf" else float("inf"), w)
+             for km, w in table.items())):
+        if k <= k_max:
+            band = width
+            break
+    if band is None or n < band or k * 4 > n:
+        return SelectAlgo.DIRECT
+    return SelectAlgo.TWO_PHASE
 
 
 def _direct(values: jax.Array, k: int, select_min: bool):
@@ -69,12 +134,7 @@ def _two_phase(values: jax.Array, k: int, select_min: bool):
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min", "algo"))
 def _select_k_jit(values, k, select_min, algo):
-    if algo == SelectAlgo.AUTO:
-        algo = (
-            SelectAlgo.TWO_PHASE
-            if values.shape[-1] >= _TWO_PHASE_THRESHOLD and k * 4 <= values.shape[-1]
-            else SelectAlgo.DIRECT
-        )
+    assert algo != SelectAlgo.AUTO  # resolved in select_k(), pre-cache
     if algo == SelectAlgo.PALLAS:
         from raft_tpu.ops.pallas_kernels import pallas_select_k
 
@@ -111,6 +171,13 @@ def select_k(
         return v, i
     if k > values.shape[-1]:
         raise ValueError(f"k={k} > row length {values.shape[-1]}")
+    if algo == SelectAlgo.AUTO:
+        # Resolve BEFORE the jit boundary: the concrete algo is the compile
+        # key, so later set_auto_table()/RAFT_TPU_SELECTK_TABLE changes
+        # apply to fresh calls instead of being baked into a cached AUTO
+        # trace. (AUTO never picks PALLAS — its extraction is O(k) serial
+        # rounds, wrong for the IVF k=64-256 band.)
+        algo = _resolve_auto(values.shape[-1], int(k))
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo)
     if indices is not None:
         # preserve -1 null markers (PALLAS exhausted-row convention) —

@@ -24,3 +24,20 @@ def pad_rows(x, target_rows: int, fill=0):
         return x
     pad_widths = [(0, target_rows - n)] + [(0, 0)] * (x.ndim - 1)
     return jnp.pad(x, pad_widths, constant_values=fill)
+
+
+def query_bucket(nq: int, max_bucket: int = 256) -> int:
+    """Serving-latency batch bucket: round small query batches up to the
+    next power of two (min 8) so repeated small-batch searches of varying
+    size reuse ONE compiled program instead of recompiling per shape (the
+    role of the reference's MULTI_CTA/MULTI_KERNEL small-batch modes,
+    cagra_types.hpp:66-116 — on TPU the recompile, not the kernel shape,
+    is what kills small-batch latency). Batches above ``max_bucket`` keep
+    their exact size: throughput runs have stable shapes, and rounding
+    10k → 16k would waste real compute."""
+    if nq > max_bucket:
+        return nq
+    b = 8
+    while b < nq:
+        b *= 2
+    return b

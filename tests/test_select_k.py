@@ -108,3 +108,35 @@ def test_pallas_inf_rows_and_wide_k(rng):
                                rtol=1e-6)
     with pytest.raises(ValueError, match="small-k"):
         pallas_select_k(y, 1025, interpret=True)
+
+
+def test_auto_uses_measured_table():
+    """AUTO resolves DIRECT/TWO_PHASE from the per-platform measured
+    crossover table (VERDICT r2 #6), overridable via set_auto_table."""
+    import importlib
+
+    # the ops package rebinds the name `select_k` to the function, so the
+    # module must come from importlib
+    sk = importlib.import_module("raft_tpu.ops.select_k")
+
+    # cpu's measured table: DIRECT everywhere
+    assert sk._resolve_auto(262144, 128) == sk.SelectAlgo.DIRECT
+    # install a fake measured table and check band resolution
+    sk.set_auto_table("cpu", {"32": 1024, "256": 4096, "inf": 16384})
+    try:
+        assert sk._resolve_auto(2048, 10) == sk.SelectAlgo.TWO_PHASE
+        assert sk._resolve_auto(512, 10) == sk.SelectAlgo.DIRECT
+        assert sk._resolve_auto(8192, 128) == sk.SelectAlgo.TWO_PHASE
+        assert sk._resolve_auto(2048, 128) == sk.SelectAlgo.DIRECT
+        assert sk._resolve_auto(32768, 1024) == sk.SelectAlgo.TWO_PHASE
+        # k*4 > n guard: tiny rows always DIRECT
+        assert sk._resolve_auto(2048, 1024) == sk.SelectAlgo.DIRECT
+        # correctness is algo-independent: same results both ways
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 8192)).astype(np.float32)
+        vd, idd = select_k(x, 128, algo=SelectAlgo.DIRECT)
+        vt, idt = select_k(x, 128, algo=SelectAlgo.TWO_PHASE)
+        np.testing.assert_allclose(np.asarray(vd), np.asarray(vt))
+        np.testing.assert_array_equal(np.asarray(idd), np.asarray(idt))
+    finally:
+        sk.set_auto_table("cpu", {"inf": sk._NEVER})

@@ -1,0 +1,157 @@
+"""Flagship-scale smoke: 1M-row builds + sharded search (VERDICT r2 #4).
+
+Nothing ≥1M rows had ever been executed before round 3 — this runs the
+DEEP-100M pipeline shape at 1/100 scale on whatever backend is active
+(CPU here; re-run on TPU via tools/TPU_RUNBOOK.md):
+
+  1. 1M×96 clustered fbin dataset written to disk,
+  2. streamed sharded IVF-PQ build (``build_ivf_pq_from_file``,
+     scan_mode="lut" — the DEEP-100M memory-lean engine) over an 8-device
+     mesh + SPMD LUT search, recall vs an exact oracle,
+  3. CAGRA build at 1M (ivf_pq graph path — fully device-resident since
+     r3) + search recall,
+with wall-clock and peak-RSS recorded into an artifact JSON.
+
+Usage: python tools/flagship_1m.py [--out FLAGSHIP_1M_cpu.json]
+       [--rows 1000000] [--skip-cagra]
+"""
+
+import argparse
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def rss_gb() -> float:
+    return round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20), 2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="FLAGSHIP_1M_cpu.json")
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=96)
+    ap.add_argument("--queries", type=int, default=1000)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--skip-cagra", action="store_true")
+    ap.add_argument("--data", default="/tmp/flagship_1m.fbin")
+    args = ap.parse_args()
+
+    if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    from raft_tpu import Resources, native
+    from raft_tpu.bench.datagen import low_rank_clusters
+    from raft_tpu.neighbors import brute_force, cagra, ivf_pq
+    from raft_tpu.parallel import comms as comms_mod
+    from raft_tpu.parallel import sharded
+    from raft_tpu.stats import neighborhood_recall
+
+    art = {"rows": args.rows, "dim": args.dim,
+           "platform": jax.devices()[0].platform,
+           "n_devices": len(jax.devices()),
+           "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+    print(f"platform={art['platform']} devices={art['n_devices']}",
+          flush=True)
+
+    # ---- dataset on disk (chunked write keeps host RAM at one chunk)
+    rng = np.random.default_rng(0)
+    t0 = time.monotonic()
+    if not os.path.exists(args.data):
+        db = low_rank_clusters(rng, args.rows, args.dim, n_centers=1024)
+        native.write_bin(args.data, db)
+    else:
+        db = native.read_bin(args.data, 0, args.rows)
+    q = (db[rng.integers(0, args.rows, args.queries)]
+         + rng.standard_normal(
+             (args.queries, args.dim)).astype(np.float32) * 0.01)
+    art["datagen_s"] = round(time.monotonic() - t0, 1)
+    print(f"datagen {art['datagen_s']}s rss={rss_gb()}GB", flush=True)
+
+    # ---- exact oracle
+    t0 = time.monotonic()
+    _, gt = brute_force.knn(q, db, k=args.k, metric="sqeuclidean")
+    gt = np.asarray(gt)
+    art["oracle_s"] = round(time.monotonic() - t0, 1)
+    print(f"oracle {art['oracle_s']}s", flush=True)
+
+    # ---- sharded streamed IVF-PQ build + SPMD LUT search
+    comms = comms_mod.init_comms(axis="flagship")
+    params = ivf_pq.IndexParams(n_lists=1024, pq_dim=max(args.dim // 2, 8))
+    t0 = time.monotonic()
+    idx = sharded.build_ivf_pq_from_file(
+        comms, args.data, params, res=Resources(seed=0),
+        scan_mode="lut", max_train_rows=200_000)
+    jax.block_until_ready(idx.list_codes)
+    art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
+    art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])
+    n_over = (int(np.asarray(idx.overflow_indices >= 0).sum())
+              if idx.overflow_indices is not None else 0)
+    art["ivf_pq_overflow_rows"] = n_over
+    padded_slots = (idx.list_codes.shape[1] * idx.list_codes.shape[2]
+                    * comms.size
+                    + (idx.overflow_indices.shape[1] * comms.size
+                       if idx.overflow_indices is not None else 0))
+    art["padded_slots_over_raw"] = round(padded_slots / args.rows, 3)
+    print(f"sharded pq build {art['ivf_pq_sharded_build_s']}s "
+          f"pad={art['ivf_pq_list_pad']} overflow={n_over} "
+          f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
+          flush=True)
+
+    sp = ivf_pq.SearchParams(n_probes=64, scan_mode="lut")
+    d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
+    jax.block_until_ready((d, i))
+    t0 = time.monotonic()
+    d, i = sharded.search_ivf_pq(idx, q, args.k, sp)
+    jax.block_until_ready((d, i))
+    dt = time.monotonic() - t0
+    art["ivf_pq_sharded_qps"] = round(args.queries / dt, 1)
+    art["ivf_pq_sharded_recall"] = round(
+        float(neighborhood_recall(np.asarray(i), gt)), 4)
+    print(f"sharded lut search qps={art['ivf_pq_sharded_qps']} "
+          f"recall={art['ivf_pq_sharded_recall']}", flush=True)
+
+    # ---- CAGRA build at 1M (device-resident ivf_pq graph path)
+    if not args.skip_cagra:
+        t0 = time.monotonic()
+        cg = cagra.build(
+            db, cagra.IndexParams(graph_degree=32,
+                                  intermediate_graph_degree=64,
+                                  build_algo=cagra.BuildAlgo.IVF_PQ),
+            res=Resources(seed=0))
+        jax.block_until_ready(cg.graph)
+        art["cagra_build_s"] = round(time.monotonic() - t0, 1)
+        print(f"cagra build {art['cagra_build_s']}s rss={rss_gb()}GB",
+              flush=True)
+        csp = cagra.SearchParams(itopk_size=64, search_width=2)
+        d, i = cagra.search(cg, q, args.k, csp)
+        jax.block_until_ready((d, i))
+        t0 = time.monotonic()
+        d, i = cagra.search(cg, q, args.k, csp)
+        jax.block_until_ready((d, i))
+        art["cagra_qps"] = round(args.queries / (time.monotonic() - t0), 1)
+        art["cagra_recall"] = round(
+            float(neighborhood_recall(np.asarray(i), gt)), 4)
+        print(f"cagra qps={art['cagra_qps']} recall={art['cagra_recall']}",
+              flush=True)
+
+    art["peak_rss_gb"] = rss_gb()
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"-> {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Measure select_k algorithm crossovers at IVF-critical shapes.
+
+VERDICT r2 #6: AUTO's DIRECT/TWO_PHASE decision must come from
+measurement, not the old hardcoded 65536. This sweeps batch-2048 rows
+(the IVF probe-merge shape: [q_tile, n_probes·list_pad]) across widths
+and k ∈ {10, 32, 64, 128, 256} on whatever backend is active, times
+DIRECT vs TWO_PHASE vs (opt-in, small-k) PALLAS, and writes:
+
+  - a full timing grid, and
+  - the per-k-band crossover widths in the exact format
+    ``raft_tpu.ops.select_k.set_auto_table`` / RAFT_TPU_SELECTK_TABLE
+    consume.
+
+Run on TPU (tools/TPU_RUNBOOK.md step): RAFT_TPU_BENCH_PLATFORM=default
+  python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json
+CPU (this image): python tools/select_k_bench.py --out SELECT_K_TABLE_cpu.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SELECT_K_TABLE.json")
+    ap.add_argument("--batch", type=int, default=2048)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--widths", type=int, nargs="*",
+                    default=[4096, 16384, 32768, 65536, 131072, 262144])
+    ap.add_argument("--ks", type=int, nargs="*",
+                    default=[10, 32, 64, 128, 256])
+    ap.add_argument("--pallas", action="store_true",
+                    help="also time SelectAlgo.PALLAS (TPU only; the "
+                         "interpreter is not a measurement)")
+    args = ap.parse_args()
+
+    if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from raft_tpu.ops.select_k import SelectAlgo, select_k
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    grid = []
+    algos = [SelectAlgo.DIRECT, SelectAlgo.TWO_PHASE]
+    if args.pallas:
+        algos.append(SelectAlgo.PALLAS)
+
+    for n in args.widths:
+        x = jax.numpy.asarray(
+            rng.standard_normal((args.batch, n)).astype(np.float32))
+        for k in args.ks:
+            if k * 4 > n:
+                continue
+            row = {"n": n, "k": k}
+            for algo in algos:
+                if algo == SelectAlgo.PALLAS and k > 1024:
+                    continue
+                v, i = select_k(x, k, algo=algo)  # compile + warm
+                jax.block_until_ready((v, i))
+                t0 = time.perf_counter()
+                for _ in range(args.iters):
+                    v, i = select_k(x, k, algo=algo)
+                    jax.block_until_ready((v, i))
+                row[algo.value + "_ms"] = round(
+                    (time.perf_counter() - t0) / args.iters * 1e3, 3)
+            grid.append(row)
+            print(row, flush=True)
+
+    # per-k crossover: smallest width where TWO_PHASE beats DIRECT and
+    # keeps beating it for every larger measured width
+    crossover_by_k = {}
+    for k in args.ks:
+        rows = [r for r in grid if r["k"] == k and "two_phase_ms" in r]
+        cross = None
+        for r in sorted(rows, key=lambda r: r["n"]):
+            wins = r["two_phase_ms"] < r["direct_ms"]
+            if wins and cross is None:
+                cross = r["n"]
+            if not wins:
+                cross = None  # must win from here up
+        crossover_by_k[k] = cross
+    # band the per-k crossovers into the AUTO-table format (k_max -> width)
+    bands = {}
+    small = [c for k, c in crossover_by_k.items() if k <= 32 and c]
+    mid = [c for k, c in crossover_by_k.items() if 32 < k <= 256 and c]
+    if small:
+        bands["32"] = min(small)
+    if mid:
+        bands["256"] = min(mid)
+    bands["inf"] = max([c for c in crossover_by_k.values() if c],
+                       default=1 << 62)
+
+    art = {"platform": platform, "batch": args.batch, "grid": grid,
+           "crossover_by_k": crossover_by_k, "crossovers": bands,
+           "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"-> {args.out}\ncrossovers: {bands}")
+
+
+if __name__ == "__main__":
+    main()
