@@ -21,10 +21,10 @@ import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
 
 from raft_tpu import native
+from raft_tpu.bench import timing
 from raft_tpu.core.resources import Resources
 from raft_tpu.stats import neighborhood_recall
 
@@ -407,6 +407,9 @@ def run_benchmark(
     if gt is None:
         gt = generate_groundtruth(base, queries, k, metric, res=res)
     gt = gt[:, :k]
+    # one upload for the whole run — per-search re-uploads ride the slow
+    # tunnel link (~16 MB/s) and would dominate small-index measurements
+    queries = timing.prepare(np.asarray(queries))
 
     results = []
     for index_conf in config["index"]:
@@ -432,13 +435,10 @@ def run_benchmark(
 
 
 def _block_on_index(index) -> None:
-    """Fence the async build: block on every jax.Array the index holds
-    (effects_barrier only fences side effects, not pure dispatch)."""
-    attrs = getattr(index, "__dict__", {})
-    leaves = jax.tree_util.tree_leaves(list(attrs.values()))
-    for a in leaves:
-        if isinstance(a, jax.Array):
-            a.block_until_ready()
+    """Fence the async build via a host readback of every jax.Array the
+    index holds (block_until_ready under-waits on the axon tunnel — see
+    bench/timing.py)."""
+    timing.fence_index(index)
 
 
 def _run_search(algo, index, queries, k, search_param, gt, batch_size,
@@ -450,34 +450,43 @@ def _run_search(algo, index, queries, k, search_param, gt, batch_size,
       in-flight batches keep the chip saturated (the TPU analog of the
       thread-pool pipelining in bench/ann/src/common/thread_pool.hpp —
       XLA's async dispatch is the queue) → ``qps``.
-    - **latency**: each batch is synchronized before the next is issued →
-      ``latency_ms`` (mean per-batch wall time) and ``qps_latency_mode``.
+    - **latency**: batches are serialized by a data dependency (each
+      batch's input depends on the previous output), measuring device
+      serial latency with the host readback round-trip amortized →
+      ``latency_ms`` (mean per-batch time) and ``qps_latency_mode``.
     """
     nq = len(queries)
     bs = batch_size or nq
     n_batches = max(-(-nq // bs), 1)
 
-    def dispatch(s):
-        return algo.search(index, queries[s : s + bs], k, search_param, res)
+    def dispatch(s, q_batch=None):
+        qb = queries[s : s + bs] if q_batch is None else q_batch
+        return algo.search(index, qb, k, search_param, res)
 
     # warmup + correctness (also compiles both shapes: full + tail batch)
     outs = [dispatch(s) for s in range(0, nq, bs)]
-    jax.block_until_ready(outs)
+    timing.fence(outs)
     idx = np.concatenate([np.asarray(i) for _, i in outs])
     recall = float(neighborhood_recall(idx[:, :k], gt))
 
     # throughput mode: dispatch-ahead, one fence per pass
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready([dispatch(s) for s in range(0, nq, bs)])
-    thr_dt = (time.perf_counter() - t0) / iters
+    thr_dt = timing.time_dispatches(
+        lambda: [dispatch(s) for s in range(0, nq, bs)],
+        iters=iters, warmup=0)
 
-    # latency mode: per-batch synchronization
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        for s in range(0, nq, bs):
-            jax.block_until_ready(dispatch(s))
-    lat_dt = (time.perf_counter() - t0) / iters
+    # latency mode: batches serialized by a data dependency (per-batch
+    # host syncs would measure the tunnel round-trip, not the chip);
+    # the tail batch is timed separately when nq % bs != 0
+    def chained_latency(q0):
+        return timing.time_latency_chained(
+            lambda qq: timing.chain_perturb(q0, dispatch(0, q_batch=qq)),
+            q0, iters=max(iters * n_batches, 4))
+
+    n_full = nq // bs
+    lat_dt = chained_latency(queries[:bs]) * n_full if n_full else 0.0
+    tail = nq % bs
+    if tail:
+        lat_dt += chained_latency(queries[nq - tail:])
 
     return {"k": k, "batch_size": bs, "qps": round(nq / thr_dt, 1),
             "qps_latency_mode": round(nq / lat_dt, 1),

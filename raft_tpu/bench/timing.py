@@ -1,0 +1,157 @@
+"""Tunnel-safe timing primitives shared by every benchmark entry point.
+
+Measured on the axon TPU tunnel (2026-07-31, TPU_PROBE.json era):
+
+- ``jax.Array.block_until_ready()`` returns when the remote enqueue is
+  acknowledged, NOT when execution completes — an 8192³ bf16 matmul
+  "finished" in 0.03 ms (34 PFLOP/s, physically impossible; the chained
+  in-jit measurement gives 139 TFLOP/s ≈ 70% of v5e peak). The only
+  honest completion fence is a host readback of data that depends on the
+  result.
+- A host readback costs ~75-80 ms round-trip, and bulk transfers run at
+  ~16 MB/s up / ~7 MB/s down. Timed regions must therefore (a) amortize
+  ONE fence over many asynchronously dispatched repeats, and (b) never
+  contain a host→device upload of benchmark inputs.
+
+These helpers also behave correctly (just redundantly) on CPU/GPU where
+``block_until_ready`` does wait. This is the TPU analog of the CUDA-event
+timing fixture the reference benches use
+(``/root/reference/cpp/bench/prims/common/benchmark.hpp:84-105``): events
+fence device work without stalling the pipeline per iteration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "fence",
+    "fence_index",
+    "fence_overhead",
+    "prepare",
+    "time_dispatches",
+    "time_latency_chained",
+    "chain_perturb",
+]
+
+
+def fence(out: Any) -> None:
+    """Block until every execution producing ``out``'s array leaves has
+    completed, via a single scalar-per-leaf host readback.
+
+    An XLA execution is atomic, so reading one element of one output
+    forces the whole execution (and its dependencies) to finish; probing
+    every leaf covers outputs produced by distinct dispatches. All probes
+    are fetched in ONE transfer so the tunnel round-trip is paid once.
+    """
+    leaves = [l for l in jax.tree_util.tree_leaves(out)
+              if isinstance(l, jax.Array)]
+    if not leaves:
+        return
+    probes = [jnp.ravel(l)[:1].astype(jnp.float32) for l in leaves]
+    np.asarray(jnp.concatenate(probes))
+
+
+def fence_index(index: Any) -> None:
+    """Fence a built ANN index: readback-probe every jax.Array it holds
+    (indexes are plain classes; a slotted/NamedTuple type without
+    ``__dict__`` degrades to fencing nothing rather than raising)."""
+    attrs = getattr(index, "__dict__", {})
+    fence(list(attrs.values()))
+
+
+_FENCE_OVERHEAD_S: float | None = None
+
+
+def fence_overhead() -> float:
+    """Median cost of fencing already-ready data — the tunnel's readback
+    round-trip (~75-80 ms on axon, ~µs locally). Measured once per
+    process and cached; subtracted from timed loops so short-timescale
+    measurements (sub-ms select_k, single-query latency) aren't swamped
+    by the harness. The subtraction slightly over-corrects when the
+    readback overlaps trailing device work, so timed loops floor at a
+    tenth of the raw measurement."""
+    global _FENCE_OVERHEAD_S
+    if _FENCE_OVERHEAD_S is None:
+        x = jnp.zeros((8,), jnp.float32)
+        fence(x)
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fence(x)
+            samples.append(time.perf_counter() - t0)
+        _FENCE_OVERHEAD_S = sorted(samples)[1]
+    return _FENCE_OVERHEAD_S
+
+
+def _amortize(elapsed: float, iters: int) -> float:
+    """Per-iteration seconds with the single fence round-trip removed
+    (floored: the correction must never produce zero/negative time)."""
+    return max(elapsed - fence_overhead(), elapsed * 0.1) / iters
+
+
+def prepare(x: Any) -> Any:
+    """Move inputs to device OUTSIDE the timed region (uploads ride the
+    slow tunnel link) and fence so the transfer cannot leak into timing."""
+    def _put(a):
+        if isinstance(a, jax.Array):
+            return a  # already device-resident: never round-trip the link
+        if isinstance(a, np.ndarray):
+            return jax.device_put(a)
+        return a
+
+    out = jax.tree_util.tree_map(_put, x)
+    fence(out)
+    return out
+
+
+def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
+                    warmup: int = 1) -> float:
+    """Wall seconds per ``dispatch()``: ``iters`` asynchronous dispatches,
+    one fence at the end (throughput mode — the chip stays saturated by
+    in-flight work, matching the reference's thread-pool throughput mode,
+    raft_ann_benchmarks.md:154)."""
+    fence_overhead()  # calibrate OUTSIDE the timed region
+    for _ in range(warmup):
+        fence(dispatch())
+    t0 = time.perf_counter()
+    outs = [dispatch() for _ in range(iters)]
+    fence(outs)
+    return _amortize(time.perf_counter() - t0, iters)
+
+
+def time_latency_chained(step: Callable[[Any], Any], x0: Any,
+                         iters: int = 8) -> float:
+    """Per-call device latency WITHOUT a per-call readback: each call's
+    input depends on the previous call's output (caller encodes the
+    dependency, e.g. via :func:`chain_perturb`), so executions serialize
+    on-device; the fence round-trip is paid once and amortized."""
+    fence_overhead()  # calibrate OUTSIDE the timed region
+    fence(step(x0))  # warm / compile
+    t0 = time.perf_counter()
+    out = x0
+    for _ in range(iters):
+        out = step(out)
+    fence(out)
+    return _amortize(time.perf_counter() - t0, iters)
+
+
+def chain_perturb(x: jax.Array, prev_out: Any) -> jax.Array:
+    """Return ``x`` plus a zero-valued contribution of ``prev_out``'s
+    first leaf — value-identical to ``x`` but data-dependent on the
+    previous call, forcing serial on-device execution in chained-latency
+    loops."""
+    leaves = [l for l in jax.tree_util.tree_leaves(prev_out)
+              if isinstance(l, jax.Array)]
+    if not leaves:
+        return x
+    p = jnp.ravel(leaves[0])[0]
+    # inf/NaN probes (top-k pad values, bf16 overflow) must not poison the
+    # chain: inf * 0 = NaN would turn every later input into NaN
+    z = (jnp.where(jnp.isfinite(p), p, 0) * 0).astype(x.dtype)
+    return x + z

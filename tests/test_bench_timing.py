@@ -1,0 +1,63 @@
+"""bench/timing.py — tunnel-safe fences and timed loops (CPU-checked).
+
+On CPU the fence is redundant with block_until_ready, but every helper
+must still return sane values and preserve results, since the same code
+path produces all on-TPU artifacts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.bench.timing import (chain_perturb, fence, prepare,
+                                   time_dispatches, time_latency_chained)
+
+pytestmark = pytest.mark.fast
+
+
+def test_fence_handles_mixed_trees():
+    x = jnp.arange(6.0).reshape(2, 3)
+    fence({"a": x, "b": [x.astype(jnp.int32), None, "str"], "c": 3})
+    fence(None)  # no leaves: no-op
+
+
+def test_prepare_moves_to_device_and_roundtrips():
+    h = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    d = prepare({"x": h, "meta": "keep"})
+    assert isinstance(d["x"], jax.Array)
+    assert d["meta"] == "keep"
+    np.testing.assert_array_equal(np.asarray(d["x"]), h)
+
+
+def test_time_dispatches_positive_and_runs_fn():
+    calls = []
+    f = jax.jit(lambda x: (x * 2).sum())
+    x = jnp.ones((64, 64))
+
+    def dispatch():
+        calls.append(1)
+        return f(x)
+
+    dt = time_dispatches(dispatch, iters=3, warmup=1)
+    assert dt > 0
+    assert len(calls) == 4  # warmup + iters
+
+
+def test_chain_perturb_is_value_identity_but_dependent():
+    x = jnp.arange(8.0)
+    out = (jnp.ones((3,)), jnp.arange(3))
+    y = chain_perturb(x, out)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    y2 = chain_perturb(x, None)  # no leaves: passthrough
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+
+
+def test_time_latency_chained_serializes_and_returns_positive():
+    f = jax.jit(lambda q: q @ q.T)
+    q0 = jnp.ones((4, 4))
+
+    def step(q):
+        return chain_perturb(q0, f(q))
+
+    dt = time_latency_chained(step, q0, iters=4)
+    assert dt > 0

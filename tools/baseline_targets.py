@@ -39,6 +39,8 @@ if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "cpu") == "cpu":
 
 import numpy as np  # noqa: E402
 
+from raft_tpu.bench.timing import fence, time_dispatches  # noqa: E402
+
 
 def _clustered(rng, n, dim, **kw):
     from raft_tpu.bench.datagen import low_rank_clusters
@@ -47,17 +49,16 @@ def _clustered(rng, n, dim, **kw):
 
 
 def _timed_search(search_fn, nq, iters=3):
-    """Single-batch timing: the whole query set is one dispatch, so the
-    reference's throughput and latency modes coincide (one in-flight
-    batch, synchronized per pass). ``latency_ms`` is the per-PASS latency
-    at batch_size = nq — per-batch sweeps live in bench/runner.py's
-    ``_run_search``, which times the two modes separately."""
+    """Single-batch timing: the whole query set is one dispatch;
+    ``iters`` passes are dispatched ahead with ONE trailing fence
+    (bench/timing.py — block_until_ready under-waits on the axon tunnel,
+    and the fence round-trip is calibrated out). ``latency_ms`` is the
+    per-PASS time at batch_size = nq under that dispatch-ahead pipeline —
+    per-batch latency-mode sweeps live in bench/runner.py's
+    ``_run_search``."""
     out = search_fn()
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        jax.block_until_ready(search_fn())
-    dt = (time.perf_counter() - t0) / iters
+    fence(out)
+    dt = time_dispatches(search_fn, iters=iters, warmup=0)
     return {"qps": round(nq / dt, 1), "batch_size": nq,
             "latency_ms": round(1000.0 * dt, 3)}, out
 
@@ -95,7 +96,7 @@ def target2_kmeans_balanced(scale, rng):
     t0 = time.perf_counter()
     centers = kmeans_balanced.fit(res.next_key(), x, n_clusters, params,
                                   res=res)
-    centers.block_until_ready()
+    fence(centers)
     fit_s = time.perf_counter() - t0
     labels = kmeans_balanced.predict(centers, x, params, res=res)
     sizes = np.bincount(np.asarray(labels), minlength=n_clusters)
@@ -122,7 +123,7 @@ def target3_ivf_flat(scale, rng):
     t0 = time.perf_counter()
     index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=n_lists),
                            res=res)
-    jax.block_until_ready(index.list_data)
+    fence(index.list_data)
     build_s = time.perf_counter() - t0
     rows = []
     for nprobe in (32, 128):
@@ -196,7 +197,7 @@ def target5_cagra(scale, rng):
     index = cagra.build(
         db, cagra.IndexParams(intermediate_graph_degree=64, graph_degree=32),
         res=Resources(seed=0))
-    index.graph.block_until_ready()
+    fence(index.graph)
     build_s = time.perf_counter() - t0
     rows = []
     for itopk in (64, 128):

@@ -3,22 +3,31 @@
 Usage: python tools/bench_ann.py [ivf_flat|ivf_pq|cagra|bf|all] [n_rows]
 Set RAFT_TPU_PALLAS=1 to route IVF scans through the Pallas fused kernel.
 Clustered (make_blobs) data so recall reflects the IVF regime.
+Fence-based timing (bench/timing.py): block_until_ready under-waits on
+the axon tunnel, and queries are uploaded once before any timed region.
 """
-import json, sys, time
-import numpy as np, jax
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.bench.timing import fence, prepare, time_dispatches  # noqa: E402
 
 
 def timeit(f, iters=3):
-    r = f(); jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = f()
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / iters, r
+    r = f()
+    fence(r)
+    dt = time_dispatches(f, iters=iters, warmup=0)
+    return dt, r
 
 
 def main(which="all", n=100_000):
-    from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq, cagra
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
     from raft_tpu.ops import rng as rrng
     from raft_tpu.stats import neighborhood_recall
 
@@ -27,8 +36,8 @@ def main(which="all", n=100_000):
                            cluster_std=0.3)
     db = np.asarray(x, np.float32)
     rng = np.random.default_rng(1)
-    q = db[rng.integers(0, n, nq)] + 0.05 * rng.standard_normal(
-        (nq, dim)).astype(np.float32)
+    q = prepare(db[rng.integers(0, n, nq)] + 0.05 * rng.standard_normal(
+        (nq, dim)).astype(np.float32))
 
     bf = brute_force.build(db, metric="sqeuclidean")
     dt, (gt_d, gt_i) = timeit(lambda: brute_force.search(bf, q, k))
@@ -40,7 +49,7 @@ def main(which="all", n=100_000):
     if which in ("ivf_flat", "all"):
         t0 = time.perf_counter()
         idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
-        jax.block_until_ready(idx.list_data)
+        fence(idx.list_data)
         bt = time.perf_counter() - t0
         for np_ in (16, 32, 64):
             dt, (d, i) = timeit(lambda: ivf_flat.search(
@@ -54,10 +63,10 @@ def main(which="all", n=100_000):
         t0 = time.perf_counter()
         idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, pq_dim=48,
                                                   pq_bits=8))
-        jax.block_until_ready(idx.list_codes)
+        fence(idx.list_codes)
         bt = time.perf_counter() - t0
         ivf_pq.ensure_scan_cache(idx)
-        jax.block_until_ready(idx.list_decoded)
+        fence(idx.list_decoded)
         for np_ in (16, 32, 64):
             dt, (d, i) = timeit(lambda: ivf_pq.search(
                 idx, q, k, ivf_pq.SearchParams(n_probes=np_)))
@@ -70,7 +79,7 @@ def main(which="all", n=100_000):
         t0 = time.perf_counter()
         idx = cagra.build(db, cagra.IndexParams(
             graph_degree=32, intermediate_graph_degree=64))
-        jax.block_until_ready(idx.graph)
+        fence(idx.graph)
         bt = time.perf_counter() - t0
         for itopk in (32, 64):
             dt, (d, i) = timeit(lambda: cagra.search(

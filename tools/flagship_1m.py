@@ -27,6 +27,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+def _fence(x):
+    from raft_tpu.bench.timing import fence
+    fence(x)
+
 
 def rss_gb() -> float:
     return round(
@@ -94,7 +98,7 @@ def main():
     idx = sharded.build_ivf_pq_from_file(
         comms, args.data, params, res=Resources(seed=0),
         scan_mode="lut", max_train_rows=200_000)
-    jax.block_until_ready(idx.list_codes)
+    _fence(idx.list_codes)
     art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
     art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])
     n_over = (int(np.asarray(idx.overflow_indices >= 0).sum())
@@ -110,12 +114,15 @@ def main():
           f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
           flush=True)
 
+    # q stays a host array: the sharded search shards it over the mesh
+    # itself, and a device-0-committed input would fight that placement
+    # (384 KB upload noise is negligible at this scale)
     sp = ivf_pq.SearchParams(n_probes=64, scan_mode="lut")
     d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
-    jax.block_until_ready((d, i))
+    _fence((d, i))
     t0 = time.monotonic()
     d, i = sharded.search_ivf_pq(idx, q, args.k, sp)
-    jax.block_until_ready((d, i))
+    _fence((d, i))
     dt = time.monotonic() - t0
     art["ivf_pq_sharded_qps"] = round(args.queries / dt, 1)
     art["ivf_pq_sharded_recall"] = round(
@@ -131,16 +138,16 @@ def main():
                                   intermediate_graph_degree=64,
                                   build_algo=cagra.BuildAlgo.IVF_PQ),
             res=Resources(seed=0))
-        jax.block_until_ready(cg.graph)
+        _fence(cg.graph)
         art["cagra_build_s"] = round(time.monotonic() - t0, 1)
         print(f"cagra build {art['cagra_build_s']}s rss={rss_gb()}GB",
               flush=True)
         csp = cagra.SearchParams(itopk_size=64, search_width=2)
         d, i = cagra.search(cg, q, args.k, csp)
-        jax.block_until_ready((d, i))
+        _fence((d, i))
         t0 = time.monotonic()
         d, i = cagra.search(cg, q, args.k, csp)
-        jax.block_until_ready((d, i))
+        _fence((d, i))
         art["cagra_qps"] = round(args.queries / (time.monotonic() - t0), 1)
         art["cagra_recall"] = round(
             float(neighborhood_recall(np.asarray(i), gt)), 4)

@@ -1,15 +1,12 @@
 """Break down ivf_flat-style search costs on TPU."""
-import time, functools, json
+
 import numpy as np, jax, jax.numpy as jnp
 from raft_tpu.ops.select_k import select_k
 
+from raft_tpu.bench.timing import time_dispatches
+
 def bench(f, *a, iters=5):
-    r = f(*a); jax.block_until_ready(r)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        r = f(*a)
-    jax.block_until_ready(r)
-    return (time.perf_counter() - t0) / iters
+    return time_dispatches(lambda: f(*a), iters=iters)
 
 rng = np.random.default_rng(0)
 L, pad, dim = 1024, 128, 96

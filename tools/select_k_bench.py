@@ -26,6 +26,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from raft_tpu.bench.timing import time_dispatches  # noqa: E402
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -66,14 +68,9 @@ def main():
             for algo in algos:
                 if algo == SelectAlgo.PALLAS and k > 1024:
                     continue
-                v, i = select_k(x, k, algo=algo)  # compile + warm
-                jax.block_until_ready((v, i))
-                t0 = time.perf_counter()
-                for _ in range(args.iters):
-                    v, i = select_k(x, k, algo=algo)
-                    jax.block_until_ready((v, i))
-                row[algo.value + "_ms"] = round(
-                    (time.perf_counter() - t0) / args.iters * 1e3, 3)
+                dt = time_dispatches(lambda: select_k(x, k, algo=algo),
+                                     iters=args.iters)
+                row[algo.value + "_ms"] = round(dt * 1e3, 3)
             grid.append(row)
             print(row, flush=True)
 

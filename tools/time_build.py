@@ -1,4 +1,5 @@
-import time, numpy as np, jax
+import time, numpy as np
+from raft_tpu.bench.timing import fence
 t00 = time.perf_counter()
 from raft_tpu.neighbors import ivf_flat
 rng = np.random.default_rng(0)
@@ -6,9 +7,9 @@ db = rng.standard_normal((100_000, 96)).astype(np.float32)
 print("import+data", round(time.perf_counter()-t00,1), flush=True)
 t0 = time.perf_counter()
 idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
-jax.block_until_ready(idx.list_data)
+fence(idx.list_data)
 print("build", round(time.perf_counter()-t0,1), flush=True)
 t0 = time.perf_counter()
 idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024))
-jax.block_until_ready(idx.list_data)
+fence(idx.list_data)
 print("build2", round(time.perf_counter()-t0,1), flush=True)
