@@ -23,7 +23,7 @@ fence device work without stalling the pipeline per iteration.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -95,6 +95,21 @@ def _amortize(elapsed: float, iters: int) -> float:
     return max(elapsed - fence_overhead(), elapsed * 0.1) / iters
 
 
+_MAX_ITERS = 4096
+
+
+def _scaled_iters(elapsed: float, iters: int) -> Optional[int]:
+    """When the fence round-trip dominates a measured loop (sub-ms work on
+    the ~75 ms tunnel), the subtraction is noise-bound — return a larger
+    iteration count that makes real work ~10x the RTT, or None if the
+    measurement already dominates (or the cap is hit)."""
+    oh = fence_overhead()
+    if elapsed >= 5 * oh or iters >= _MAX_ITERS:
+        return None
+    per_iter = max((elapsed - oh) / iters, elapsed * 0.02 / iters, 1e-7)
+    return int(min(_MAX_ITERS, max(iters * 2, (10 * oh) / per_iter)))
+
+
 def prepare(x: Any) -> Any:
     """Move inputs to device OUTSIDE the timed region (uploads ride the
     slow tunnel link) and fence so the transfer cannot leak into timing."""
@@ -119,10 +134,15 @@ def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
     fence_overhead()  # calibrate OUTSIDE the timed region
     for _ in range(warmup):
         fence(dispatch())
-    t0 = time.perf_counter()
-    outs = [dispatch() for _ in range(iters)]
-    fence(outs)
-    return _amortize(time.perf_counter() - t0, iters)
+    while True:
+        t0 = time.perf_counter()
+        outs = [dispatch() for _ in range(iters)]
+        fence(outs)
+        elapsed = time.perf_counter() - t0
+        nxt = _scaled_iters(elapsed, iters)
+        if nxt is None:
+            return _amortize(elapsed, iters)
+        iters = nxt  # RTT-dominated: amortize over more dispatches
 
 
 def time_latency_chained(step: Callable[[Any], Any], x0: Any,
@@ -133,12 +153,17 @@ def time_latency_chained(step: Callable[[Any], Any], x0: Any,
     on-device; the fence round-trip is paid once and amortized."""
     fence_overhead()  # calibrate OUTSIDE the timed region
     fence(step(x0))  # warm / compile
-    t0 = time.perf_counter()
-    out = x0
-    for _ in range(iters):
-        out = step(out)
-    fence(out)
-    return _amortize(time.perf_counter() - t0, iters)
+    while True:
+        t0 = time.perf_counter()
+        out = x0
+        for _ in range(iters):
+            out = step(out)
+        fence(out)
+        elapsed = time.perf_counter() - t0
+        nxt = _scaled_iters(elapsed, iters)
+        if nxt is None:
+            return _amortize(elapsed, iters)
+        iters = nxt  # RTT-dominated: chain more calls
 
 
 def chain_perturb(x: jax.Array, prev_out: Any) -> jax.Array:

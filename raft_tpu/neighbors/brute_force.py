@@ -38,7 +38,8 @@ from raft_tpu.ops.distance import (
     row_norms_sq,
     _pairwise_impl,
 )
-from raft_tpu.ops.select_k import select_k
+from raft_tpu.ops.select_k import (SelectAlgo, select_k,
+                                   select_k_maybe_approx)
 from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
 
@@ -101,14 +102,19 @@ _FAST_SCAN_METRICS = (
 @functools.partial(
     jax.jit,
     static_argnames=("metric", "metric_arg", "k", "q_tile", "db_tile",
-                     "budget", "has_filter", "fast_scan", "refine_mult"),
+                     "budget", "has_filter", "fast_scan", "refine_mult",
+                     "select_recall"),
 )
 def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
              q_tile, db_tile, budget, has_filter: bool = False,
-             fast_scan: bool = False, refine_mult: int = 4):
+             fast_scan: bool = False, refine_mult: int = 4,
+             select_recall: float = 1.0):
     nq, dim = queries.shape
     ndb = dataset.shape[0]
     minimize = is_min_close(metric)
+
+    def _sel(vals, kk, sel_min):
+        return select_k_maybe_approx(vals, kk, sel_min, select_recall)
     use_cached_norms = db_norms is not None and metric in (
         DistanceType.L2Expanded,
         DistanceType.L2SqrtExpanded,
@@ -190,7 +196,7 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
                 # bitset_filter, sample_filter_types.hpp:55-82)
                 bad = bad | ~_filter_pass(t * db_tile + jnp.arange(db_tile))
             d = jnp.where(bad[None, :], bad_fill, d)
-            v, i = select_k(d, k_scan, select_min=minimize)
+            v, i = _sel(d, k_scan, minimize)
             return v, i + t * db_tile
 
         tile_v, tile_i = jax.lax.map(db_body, jnp.arange(n_db_tiles))
@@ -202,8 +208,7 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
         if fast_scan:
             # Exact fp32 re-rank of the scanned candidates (reference analog:
             # neighbors::refine over a coarse candidate list).
-            _, sel = select_k(all_v, min(k_refine, all_v.shape[-1]),
-                              select_min=minimize)
+            _, sel = _sel(all_v, min(k_refine, all_v.shape[-1]), minimize)
             cand_i = jnp.take_along_axis(all_i, sel, axis=1)
             cand_vecs = jnp.take(dbp, cand_i, axis=0)  # [q_tile, k_ref, dim]
             exact = gathered_distances(qt, cand_vecs, metric)
@@ -228,7 +233,8 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
 
 def search(index: Index, queries, k: int, filter=None,
            res: Optional[Resources] = None, scan_dtype=None,
-           refine_ratio: float = 4.0) -> Tuple[jax.Array, jax.Array]:
+           refine_ratio: float = 4.0,
+           select_recall: float = 1.0) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN search → (distances [nq, k], indices [nq, k]).
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
@@ -280,16 +286,19 @@ def search(index: Index, queries, k: int, filter=None,
         index.metric, index.metric_arg,
         k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
         fast_scan, refine_mult if fast_scan else 1,
+        select_recall=float(select_recall),
     )
     return v[:nq], i[:nq]
 
 
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
         res: Optional[Resources] = None, scan_dtype=None,
-        refine_ratio: float = 4.0) -> Tuple[jax.Array, jax.Array]:
+        refine_ratio: float = 4.0,
+        select_recall: float = 1.0) -> Tuple[jax.Array, jax.Array]:
     """One-shot exact kNN (reference: brute_force::knn)."""
     return search(build(dataset, metric, metric_arg, res), queries, k,
-                  res=res, scan_dtype=scan_dtype, refine_ratio=refine_ratio)
+                  res=res, scan_dtype=scan_dtype, refine_ratio=refine_ratio,
+                  select_recall=select_recall)
 
 
 _SERIAL_VERSION = 1

@@ -49,7 +49,8 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
-from raft_tpu.ops.select_k import select_k
+from raft_tpu.ops.select_k import (SelectAlgo, select_k,
+                                   select_k_maybe_approx)
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
 from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
@@ -123,6 +124,10 @@ class SearchParams:
     # traffic, ~1e-3 recall cost — the reference's fp16/fp8-LUT trade) or
     # float32 (bit-exact vs the LUT path).
     scan_cache_dtype: object = jnp.bfloat16
+    # <1.0 routes internal top-k through the TPU PartialReduce engine
+    # (ops.select_k APPROX) at this per-element recall target; exact by
+    # default — the same recall/speed dial family as lut_dtype
+    select_recall: float = 1.0
 
 
 def _calc_pq_dim(dim: int) -> int:
@@ -787,13 +792,17 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
                        use_pallas: bool = False,
                        pallas_interpret: bool = False,
                        overflow_decoded=None, overflow_norms=None,
-                       overflow_indices=None, has_overflow: bool = False):
+                       overflow_indices=None, has_overflow: bool = False,
+                 select_recall: float = 1.0):
     """ADC scan over the decoded-residual cache: identical distances to the
     LUT formulation (||q_res − dec||² expands to ||q_res||² − 2 q_res·dec +
     ||dec||²), evaluated as one batched matvec per probe on the MXU."""
     nq, dim = queries.shape
     n_lists, list_pad, rot_dim = list_decoded.shape
     minimize = metric != DistanceType.InnerProduct
+
+    def _sel(vals, kk, sel_min):
+        return select_k_maybe_approx(vals, kk, sel_min, select_recall)
 
     n_q_tiles = cdiv(nq, q_tile)
     pad_q = n_q_tiles * q_tile - nq
@@ -818,11 +827,10 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
             precision=jax.lax.Precision.HIGHEST,
         )
         if metric == DistanceType.InnerProduct:
-            _, probes = select_k(dots_c, n_probes, select_min=False)
+            _, probes = _sel(dots_c, n_probes, False)
         else:
             cn = jnp.sum(centers_rot * centers_rot, -1)
-            _, probes = select_k(cn[None, :] - 2.0 * dots_c, n_probes,
-                                 select_min=True)
+            _, probes = _sel(cn[None, :] - 2.0 * dots_c, n_probes, True)
 
         g_idx = list_indices[probes]
         g_valid = valid_slot[probes]
@@ -884,7 +892,7 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
             flat_i = jnp.concatenate([flat_i, oi], axis=1)
             n_cand += od.shape[1]
         kk = min(k, n_cand)
-        v, sel = select_k(flat_d, kk, select_min=minimize)
+        v, sel = _sel(flat_d, kk, minimize)
         i_out = jnp.take_along_axis(flat_i, sel, axis=1)
         if kk < k:
             v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=bad_fill)
@@ -906,7 +914,8 @@ def _search_cache_core(queries, centers, rotation, list_decoded,
 _search_cache_jit = jax.jit(
     _search_cache_core,
     static_argnames=("metric", "k", "n_probes", "q_tile", "has_filter",
-                     "use_pallas", "pallas_interpret", "has_overflow"),
+                     "use_pallas", "pallas_interpret", "has_overflow",
+                     "select_recall"),
 )
 
 
@@ -916,7 +925,8 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
                      per_cluster: bool, pq_dim: int, pq_bits: int,
                      has_filter: bool, lut_dtype, dist_dtype,
                      overflow_decoded=None, overflow_norms=None,
-                     overflow_indices=None, has_overflow: bool = False):
+                     overflow_indices=None, has_overflow: bool = False,
+                 select_recall: float = 1.0):
     """LUT-engine scan over packed codes (traceable core — also runs inside
     ``shard_map`` for the memory-lean sharded search, parallel/sharded.py)."""
     nq, dim = queries.shape
@@ -924,6 +934,9 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
     pq_len = codebooks.shape[2]
     book = codebooks.shape[1]
     minimize = metric != DistanceType.InnerProduct
+
+    def _sel(vals, kk, sel_min):
+        return select_k_maybe_approx(vals, kk, sel_min, select_recall)
 
     n_q_tiles = cdiv(nq, q_tile)
     pad_q = n_q_tiles * q_tile - nq
@@ -952,11 +965,11 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
         )
         if metric == DistanceType.InnerProduct:
             coarse = dots_c
-            _, probes = select_k(coarse, n_probes, select_min=False)
+            _, probes = _sel(coarse, n_probes, False)
         else:
             cn = jnp.sum(centers_rot * centers_rot, -1)
             coarse = cn[None, :] - 2.0 * dots_c  # + ||q||² (rank-invariant)
-            _, probes = select_k(coarse, n_probes, select_min=True)
+            _, probes = _sel(coarse, n_probes, True)
         # [t, P]
 
         # ---- LUT per (query, probe): [t, P, pq_dim, book]
@@ -1032,7 +1045,7 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
             flat_i = jnp.concatenate([flat_i, oi], axis=1)
             n_cand += od.shape[1]
         kk = min(k, n_cand)
-        v, sel = select_k(flat_d, kk, select_min=minimize)
+        v, sel = _sel(flat_d, kk, minimize)
         i_out = jnp.take_along_axis(flat_i, sel, axis=1)
         if kk < k:
             v = jnp.pad(v, ((0, 0), (0, k - kk)), constant_values=bad_fill)
@@ -1054,7 +1067,7 @@ _search_jit = jax.jit(
     _search_lut_core,
     static_argnames=("metric", "k", "n_probes", "q_tile", "per_cluster",
                      "pq_dim", "pq_bits", "has_filter", "lut_dtype",
-                     "dist_dtype", "has_overflow"),
+                     "dist_dtype", "has_overflow", "select_recall"),
 )
 
 
@@ -1148,6 +1161,7 @@ def search(
             pk.pallas_enabled(), False,
             index.overflow_decoded, index.overflow_norms,
             index.overflow_indices, has_overflow,
+            select_recall=float(params.select_recall),
         )
         return v[:nq], i[:nq]
     # workspace: LUT [t,P,s,book] fp32 + gathered codes [t,P,pad,bytes]
@@ -1167,6 +1181,7 @@ def search(
             params.internal_distance_dtype).name,
         index.overflow_decoded, index.overflow_norms,
         index.overflow_indices, has_overflow,
+        select_recall=float(params.select_recall),
     )
     return v[:nq], i[:nq]
 

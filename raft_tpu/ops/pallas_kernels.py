@@ -213,12 +213,15 @@ def _extract_topk(work, ci, k: int, kp: int):
     def body(r, carry):
         work, vals, idxs = carry
         a = jnp.argmin(work, axis=1)
-        # one reduction + a cheap gather per round (not min + argmin twice)
-        m = jnp.take_along_axis(work, a[:, None], axis=1)[:, 0]
+        # min + argmin as two reductions: Mosaic has no 1-per-row gather
+        # lowering (take_along_axis asserts in _gather_lowering_rule), and
+        # reductions are VPU-native anyway
+        m = jnp.min(work, axis=1)
         if ci is None:
             src = a.astype(jnp.int32)
         else:
-            src = jnp.take_along_axis(ci, a[:, None], axis=1)[:, 0]
+            src = jnp.min(jnp.where(lane == a[:, None], ci,
+                                    jnp.iinfo(jnp.int32).max), axis=1)
         # +inf (exactly) is the extraction sentinel: once a row is
         # exhausted (fewer than k non-sentinel entries) argmin would
         # re-pick masked slots — emit the -1 null index instead. A

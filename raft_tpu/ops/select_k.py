@@ -35,6 +35,7 @@ class SelectAlgo(enum.Enum):
     DIRECT = "direct"  # single lax.top_k over the full row
     TWO_PHASE = "two_phase"  # per-tile top-k, then merge (wide rows)
     PALLAS = "pallas"  # streaming k-extraction kernel (small k, wide rows)
+    APPROX = "approx"  # TPU PartialReduce (lax.approx_min_k), recall<1
 
 
 _TILE = 16384
@@ -59,6 +60,12 @@ _NEVER = 1 << 62
 _BUILTIN_TABLES = {
     # k_max → min row width at which TWO_PHASE beats DIRECT
     "cpu": {"inf": _NEVER},
+    # Measured on v5e 2026-07-31 (SELECT_K_TABLE_tpu.json, batch 2048,
+    # widths 4096-131072, k 10-256): DIRECT won everywhere except
+    # k=256 at width >= 131072, where TWO_PHASE's flat ~175 ms beats
+    # DIRECT's k-linear growth (208 ms). APPROX is 10-40x faster still
+    # but is opt-in via search params (recall < 1).
+    "tpu": {"128": _NEVER, "256": 131072, "inf": 131072},
     "default": {"32": 65536, "256": 65536, "inf": 131072},
 }
 _auto_table_cache: Optional[dict] = None
@@ -112,6 +119,20 @@ def _direct(values: jax.Array, k: int, select_min: bool):
     return (-top_v if select_min else top_v), top_i
 
 
+def _approx(values: jax.Array, k: int, select_min: bool,
+            recall_target: float):
+    """TPU-native approximate selection via the PartialReduce custom call
+    (``lax.approx_min_k``) — measured 10-40x faster than ``lax.top_k`` at
+    the IVF-critical shapes (batch 2048, width 16k-131k, k<=256) on v5e,
+    at a per-element recall target. This is the TPU analog of the recall/
+    speed dial the reference exposes through search params (its select_k
+    itself is exact, but lut_dtype/internal_distance_dtype make the same
+    trade upstream of selection, ivf_pq_types.hpp:110-146). Results come
+    back sorted like DIRECT's."""
+    fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
+    return fn(values, k, recall_target=recall_target)
+
+
 def _two_phase(values: jax.Array, k: int, select_min: bool):
     batch, n = values.shape
     tile = max(_TILE, k)
@@ -132,8 +153,9 @@ def _two_phase(values: jax.Array, k: int, select_min: bool):
     return (-mv if select_min else mv), out_i
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select_min", "algo"))
-def _select_k_jit(values, k, select_min, algo):
+@functools.partial(jax.jit,
+                   static_argnames=("k", "select_min", "algo", "recall"))
+def _select_k_jit(values, k, select_min, algo, recall=0.95):
     assert algo != SelectAlgo.AUTO  # resolved in select_k(), pre-cache
     if algo == SelectAlgo.PALLAS:
         from raft_tpu.ops.pallas_kernels import pallas_select_k
@@ -142,6 +164,8 @@ def _select_k_jit(values, k, select_min, algo):
         # Mosaic interpreter elsewhere (CPU CI)
         return pallas_select_k(values, k, select_min,
                                interpret=jax.default_backend() != "tpu")
+    if algo == SelectAlgo.APPROX:
+        return _approx(values, k, select_min, recall)
     if algo == SelectAlgo.DIRECT:
         return _direct(values, k, select_min)
     return _two_phase(values, k, select_min)
@@ -153,16 +177,24 @@ def select_k(
     select_min: bool = True,
     indices: Optional[jax.Array] = None,
     algo: SelectAlgo = SelectAlgo.AUTO,
+    recall_target: float = 0.95,
 ) -> Tuple[jax.Array, jax.Array]:
     """Select k smallest (or largest) per row of ``values`` [batch, len].
 
     Returns (selected_values [batch, k], selected_indices [batch, k]).
     When ``indices`` is given, returned indices are gathered from it —
     the source-index relabeling the reference supports via its in_idx arg.
+
+    ``algo=APPROX`` opts into the TPU PartialReduce engine at the given
+    per-element ``recall_target`` — AUTO never picks it (the public
+    primitive stays exact, matching matrix::select_k); ANN searches opt
+    in through their search params where the recall trade is theirs to
+    make.
     """
     values = jnp.asarray(values)
     if values.ndim == 1:
-        v, i = select_k(values[None], k, select_min, None, algo)
+        v, i = select_k(values[None], k, select_min, None, algo,
+                        recall_target)
         v, i = v[0], i[0]
         if indices is not None:
             # preserve -1 null markers (PALLAS exhausted-row convention)
@@ -178,7 +210,8 @@ def select_k(
         # trace. (AUTO never picks PALLAS — its extraction is O(k) serial
         # rounds, wrong for the IVF k=64-256 band.)
         algo = _resolve_auto(values.shape[-1], int(k))
-    out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo)
+    out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo,
+                                 float(recall_target))
     if indices is not None:
         # preserve -1 null markers (PALLAS exhausted-row convention) —
         # take_along_axis would wrap -1 to the last column's real id
@@ -186,6 +219,19 @@ def select_k(
                                         jnp.maximum(out_i, 0), axis=1)
         out_i = jnp.where(out_i < 0, -1, relabeled)
     return out_v, out_i
+
+
+def select_k_maybe_approx(values, k: int, select_min: bool,
+                          select_recall: float):
+    """Traceable select used inside search bodies: exact AUTO at
+    ``select_recall >= 1.0``, the APPROX (PartialReduce) engine at the
+    given per-element recall target below it. One definition so every
+    search (single-chip and sharded) makes the same dispatch."""
+    if select_recall < 1.0:
+        return select_k(values, k, select_min=select_min,
+                        algo=SelectAlgo.APPROX,
+                        recall_target=select_recall)
+    return select_k(values, k, select_min=select_min)
 
 
 def merge_topk_dedup(ids, dists, k: int, exclude_ids=None):

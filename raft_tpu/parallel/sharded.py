@@ -835,6 +835,7 @@ def search_ivf_pq(
     minimize = index.metric != DistanceType.InnerProduct
     n_lists = index.centers.shape[1]
     n_probes = int(min(params.n_probes, n_lists))
+    select_recall = float(getattr(params, "select_recall", 1.0))
     if params.scan_mode not in ("auto", "cache", "lut"):
         raise ValueError(f"unknown scan_mode: {params.scan_mode!r}")
     mode = params.scan_mode
@@ -885,7 +886,7 @@ def search_ivf_pq(
             v, i = ivf_pq._search_cache_core(
                 q_rep, c[0], ro[0], ld[0], dn[0], li[0], ls[0], empty_filter,
                 index.metric, int(k), n_probes, q_tile, False,
-                **unpack_over(over))
+                select_recall=select_recall, **unpack_over(over))
             return merge(v, i)
 
         fn = comms.run(
@@ -915,7 +916,7 @@ def search_ivf_pq(
             q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
             index.metric, int(k), n_probes, q_tile, index.per_cluster,
             index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
-            **unpack_over(over))
+            select_recall=select_recall, **unpack_over(over))
         return merge(v, i)
 
     fn = comms.run(
@@ -956,6 +957,10 @@ def search_ivf_flat(
         q_tile -= q_tile % 8
     empty_filter = jnp.zeros((0,), jnp.uint32)
     fast_scan = getattr(params, "scan_dtype", None) is not None
+    select_recall = float(getattr(params, "select_recall", 1.0))
+    refine_mult = (max(1, int(round(float(getattr(params, "refine_ratio",
+                                                  4.0)))))
+                   if fast_scan else 1)
     if fast_scan:
         if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
             raise ValueError(
@@ -981,7 +986,8 @@ def search_ivf_flat(
                 q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
                 int(k), n_probes, q_tile, False, fast_scan=fast_scan,
                 overflow_data=od[0], overflow_indices=oi[0],
-                has_overflow=True)
+                has_overflow=True, select_recall=select_recall,
+                refine_mult=refine_mult)
             return merge(v, i)
 
         fn = comms.run(
@@ -998,7 +1004,8 @@ def search_ivf_flat(
     def local(q_rep, c, ld, li, ls):
         v, i = ivf_flat._search_core(
             q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
-            int(k), n_probes, q_tile, False, fast_scan=fast_scan)
+            int(k), n_probes, q_tile, False, fast_scan=fast_scan,
+            select_recall=select_recall, refine_mult=refine_mult)
         return merge(v, i)
 
     fn = comms.run(

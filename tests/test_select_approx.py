@@ -1,0 +1,64 @@
+"""SelectAlgo.APPROX (TPU PartialReduce / lax.approx_min_k) semantics.
+
+On CPU the approx primitive falls back to an exact implementation, so
+these tests gate CONTRACT (shapes, ordering, recall floor, plumbing into
+searches) — the speed claim is measured on hardware by
+tools/select_k_bench.py / bench_ann.py."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.ops.select_k import SelectAlgo, select_k
+from raft_tpu.stats import neighborhood_recall
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    return rng.standard_normal((64, 4096)).astype(np.float32)
+
+
+def test_approx_recall_floor_and_order(data):
+    k = 32
+    v_e, i_e = select_k(data, k)
+    v_a, i_a = select_k(data, k, algo=SelectAlgo.APPROX, recall_target=0.95)
+    assert v_a.shape == (64, k) and i_a.shape == (64, k)
+    # returned values ascend (sorted like DIRECT)
+    va = np.asarray(v_a)
+    assert (np.diff(va, axis=1) >= 0).all()
+    rec = float(neighborhood_recall(np.asarray(i_a), np.asarray(i_e)))
+    assert rec >= 0.95
+
+
+def test_approx_max_side(data):
+    v_a, i_a = select_k(data, 8, select_min=False, algo=SelectAlgo.APPROX)
+    v_e, _ = select_k(data, 8, select_min=False)
+    # the true maximum is found even approximately (recall>=0.95 per row)
+    np.testing.assert_allclose(np.asarray(v_a)[:, 0], np.asarray(v_e)[:, 0])
+
+
+def test_search_select_recall_plumbs_through():
+    from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((4000, 32)).astype(np.float32)
+    q = rng.standard_normal((100, 32)).astype(np.float32)
+    _, gt = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    gt = np.asarray(gt)
+
+    _, i_bf = brute_force.search(
+        brute_force.build(db, metric="sqeuclidean"), q, 10,
+        select_recall=0.95)
+    assert float(neighborhood_recall(np.asarray(i_bf), gt)) >= 0.9
+
+    fl = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    _, i_fl = ivf_flat.search(
+        fl, q, 10, ivf_flat.SearchParams(n_probes=16, select_recall=0.95))
+    assert float(neighborhood_recall(np.asarray(i_fl), gt)) >= 0.9
+
+    pq = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=16, pq_dim=16))
+    _, i_pq = ivf_pq.search(
+        pq, q, 10, ivf_pq.SearchParams(n_probes=16, select_recall=0.95))
+    assert float(neighborhood_recall(np.asarray(i_pq), gt)) >= 0.8

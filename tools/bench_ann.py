@@ -52,12 +52,18 @@ def main(which="all", n=100_000):
         fence(idx.list_data)
         bt = time.perf_counter() - t0
         for np_ in (16, 32, 64):
-            dt, (d, i) = timeit(lambda: ivf_flat.search(
-                idx, q, k, ivf_flat.SearchParams(n_probes=np_)))
-            rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "ivf_flat", "build_s": round(bt, 2),
-                              "n_probes": np_, "qps": round(nq/dt, 1),
-                              "recall": round(rec, 4)}), flush=True)
+            for scan, rc in (("fp32", 1.0), ("bf16", 1.0), ("bf16", 0.95)):
+                sp = ivf_flat.SearchParams(
+                    n_probes=np_,
+                    scan_dtype="bfloat16" if scan == "bf16" else None,
+                    select_recall=rc)
+                dt, (d, i) = timeit(lambda: ivf_flat.search(idx, q, k, sp))
+                rec = float(neighborhood_recall(np.asarray(i), gt_i))
+                print(json.dumps(
+                    {"algo": "ivf_flat", "build_s": round(bt, 2),
+                     "n_probes": np_, "scan": scan, "select_recall": rc,
+                     "qps": round(nq/dt, 1),
+                     "recall": round(rec, 4)}), flush=True)
 
     if which in ("ivf_pq", "all"):
         t0 = time.perf_counter()
@@ -68,12 +74,15 @@ def main(which="all", n=100_000):
         ivf_pq.ensure_scan_cache(idx)
         fence(idx.list_decoded)
         for np_ in (16, 32, 64):
-            dt, (d, i) = timeit(lambda: ivf_pq.search(
-                idx, q, k, ivf_pq.SearchParams(n_probes=np_)))
-            rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "ivf_pq", "build_s": round(bt, 2),
-                              "n_probes": np_, "qps": round(nq/dt, 1),
-                              "recall": round(rec, 4)}), flush=True)
+            for rc in (1.0, 0.95):
+                sp = ivf_pq.SearchParams(n_probes=np_, select_recall=rc)
+                dt, (d, i) = timeit(lambda: ivf_pq.search(idx, q, k, sp))
+                rec = float(neighborhood_recall(np.asarray(i), gt_i))
+                print(json.dumps(
+                    {"algo": "ivf_pq", "build_s": round(bt, 2),
+                     "n_probes": np_, "select_recall": rc,
+                     "qps": round(nq/dt, 1),
+                     "recall": round(rec, 4)}), flush=True)
 
     if which in ("cagra", "all"):
         t0 = time.perf_counter()
