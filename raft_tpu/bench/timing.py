@@ -140,7 +140,15 @@ def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
         fence(outs)
         elapsed = time.perf_counter() - t0
         nxt = _scaled_iters(elapsed, iters)
-        if nxt is None:
+        if nxt is not None:
+            # every retained result stays alive on device until the fence:
+            # cap in-flight growth so scaled loops can't exhaust HBM
+            out_bytes = sum(
+                l.size * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(outs[0])
+                if isinstance(l, jax.Array)) or 1
+            nxt = min(nxt, max(iters, (1 << 30) // out_bytes))
+        if nxt is None or nxt <= iters:
             return _amortize(elapsed, iters)
         iters = nxt  # RTT-dominated: amortize over more dispatches
 

@@ -38,7 +38,7 @@ from raft_tpu.ops.distance import (
     row_norms_sq,
     _pairwise_impl,
 )
-from raft_tpu.ops.select_k import (SelectAlgo, select_k,
+from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
 from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
 
@@ -267,7 +267,7 @@ def search(index: Index, queries, k: int, filter=None,
                 f"scan_dtype unsupported for metric {index.metric.name}; "
                 "eligible: L2Expanded/L2SqrtExpanded/CosineExpanded/"
                 "InnerProduct")
-    refine_mult = max(1, int(round(float(refine_ratio))))
+    refine_mult = refine_multiplier(refine_ratio, fast_scan)
     nq = queries.shape[0]
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     q_tile, db_tile = _choose_tiles(
@@ -285,7 +285,7 @@ def search(index: Index, queries, k: int, filter=None,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, index.metric_arg,
         k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
-        fast_scan, refine_mult if fast_scan else 1,
+        fast_scan, refine_mult,
         select_recall=float(select_recall),
     )
     return v[:nq], i[:nq]

@@ -398,8 +398,8 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
     # buffer-resident flags are a complete visited set.
     rows = jnp.arange(nq)[:, None]
 
-    def body(it, state):
-        buf_ids, buf_d, buf_fl, done = state
+    def body(state):
+        it, buf_ids, buf_d, buf_fl, done = state
         # pickup_next_parents: best `width` unexpanded buffer entries
         cand_d = jnp.where(buf_fl | (buf_ids < 0), bad, buf_d)
         p_d, p_sel = jax.lax.top_k(-cand_d, width)
@@ -431,11 +431,17 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
         buf_d = jnp.where(keep, buf_d, nb_d)
         buf_fl = jnp.where(keep, buf_fl, nb_fl)
         done = done | newly_done
-        return buf_ids, buf_d, buf_fl, done
+        return it + 1, buf_ids, buf_d, buf_fl, done
 
+    # while_loop with an all-done exit instead of a fixed fori_loop: once
+    # every query's buffer has no unexpanded parent, further iterations
+    # are pure wasted HBM gathers (the batch converges well before the
+    # max_iter bound in practice; the reference's terminate_flag plays the
+    # same role, search_single_cta_kernel-inl.cuh)
     done0 = jnp.zeros((nq,), bool)
-    buf_ids, buf_d, buf_fl, _ = jax.lax.fori_loop(
-        0, max_iter, body, (buf_ids, buf_d, buf_fl, done0))
+    _, buf_ids, buf_d, buf_fl, _ = jax.lax.while_loop(
+        lambda s: (s[0] < max_iter) & ~jnp.all(s[4]),
+        body, (jnp.int32(0), buf_ids, buf_d, buf_fl, done0))
 
     if fast_scan:
         # exact fp32 re-rank of the whole itopk buffer (nq×itopk×dim — tiny

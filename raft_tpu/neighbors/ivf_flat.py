@@ -43,7 +43,7 @@ from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops.distance import (DistanceType, gathered_distances,
                                     resolve_metric, row_norms_sq)
-from raft_tpu.ops.select_k import (SelectAlgo, select_k,
+from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
 from raft_tpu.ops import rng as rrng
 from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
@@ -577,7 +577,7 @@ def search(
         index.ensure_row_norms() if need_norms else None, use_pallas, False,
         fast_scan, index.overflow_data, index.overflow_indices, has_overflow,
         float(params.select_recall),
-        max(1, int(round(float(params.refine_ratio)))) if fast_scan else 1,
+        refine_multiplier(params.refine_ratio, fast_scan),
     )
     return v[:nq], i[:nq]
 

@@ -49,8 +49,7 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
-from raft_tpu.ops.select_k import (SelectAlgo, select_k,
-                                   select_k_maybe_approx)
+from raft_tpu.ops.select_k import select_k_maybe_approx
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
 from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket

@@ -234,6 +234,13 @@ def select_k_maybe_approx(values, k: int, select_min: bool,
     return select_k(values, k, select_min=select_min)
 
 
+def refine_multiplier(refine_ratio, fast_scan: bool) -> int:
+    """Round a ``refine_ratio`` search param to the static screen multiple
+    shared by every fast-scan path (brute_force, ivf_flat, sharded) — 1
+    when the fast scan is off, so it never varies the jit cache key."""
+    return max(1, int(round(float(refine_ratio)))) if fast_scan else 1
+
+
 def merge_topk_dedup(ids, dists, k: int, exclude_ids=None):
     """Top-``k`` smallest ``dists`` per row with duplicate-id suppression
     (traceable; the shared merge step of graph algorithms — nn-descent's

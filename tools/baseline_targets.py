@@ -68,7 +68,7 @@ def target1_brute_force(scale, rng):
     from raft_tpu.neighbors import brute_force
     from raft_tpu.stats import neighborhood_recall
 
-    n = 10_000 if scale == "cpu" else 1_000_000
+    n = {"cpu": 10_000, "chip": 1_000_000}.get(scale, 1_000_000)
     nq, dim, k = 10_000, 128, 10
     db = rng.standard_normal((n, dim)).astype(np.float32)
     q = rng.standard_normal((nq, dim)).astype(np.float32)
@@ -88,7 +88,7 @@ def target2_kmeans_balanced(scale, rng):
     from raft_tpu.cluster import kmeans_balanced
     from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 
-    n = 100_000 if scale == "cpu" else 1_000_000
+    n = {"cpu": 100_000, "chip": 1_000_000}.get(scale, 1_000_000)
     dim, n_clusters = 128, 1024 if scale == "cpu" else 8192
     x = _clustered(rng, n, dim, n_centers=n_clusters // 4)
     res = Resources(seed=0)
@@ -112,7 +112,7 @@ def target3_ivf_flat(scale, rng):
     from raft_tpu.neighbors import brute_force, ivf_flat
     from raft_tpu.stats import neighborhood_recall
 
-    n = 100_000 if scale == "cpu" else 1_000_000
+    n = {"cpu": 100_000, "chip": 1_000_000}.get(scale, 1_000_000)
     nq, dim, k = 2_000 if scale == "cpu" else 10_000, 128, 10
     n_lists = 1024
     db = _clustered(rng, n, dim)
@@ -145,9 +145,12 @@ def target4_ivf_pq_sharded(scale, rng):
     from raft_tpu.parallel import comms as cm, sharded
     from raft_tpu.stats import neighborhood_recall
 
-    n = 80_000 if scale == "cpu" else 100_000_000
-    nq, dim, k = 1_000 if scale == "cpu" else 10_000, 96, 10
-    n_lists = 256 if scale == "cpu" else 50_000
+    # "chip" = single v5e behind the slow tunnel: 4M rows (~1.5 GB once)
+    # keeps the DEEP pipeline shape while fitting the link; "full" keeps
+    # the BASELINE spec for a pod with a local host.
+    n = {"cpu": 80_000, "chip": 4_000_000}.get(scale, 100_000_000)
+    nq, dim, k = {"cpu": 1_000}.get(scale, 10_000), 96, 10
+    n_lists = {"cpu": 256, "chip": 4096}.get(scale, 50_000)
     pq_dim = 48 if scale == "cpu" else 64
     db = _clustered(rng, n, dim)
     q = _clustered(rng, nq, dim)
@@ -187,7 +190,7 @@ def target5_cagra(scale, rng):
     from raft_tpu.neighbors import brute_force, cagra
     from raft_tpu.stats import neighborhood_recall
 
-    n = 60_000 if scale == "cpu" else 1_183_514  # glove-100 row count
+    n = ({"cpu": 60_000}.get(scale, 1_183_514))  # glove-100 row count
     nq, dim, k = 2_000 if scale == "cpu" else 10_000, 100, 10
     db = _clustered(rng, n, dim)
     q = _clustered(rng, nq, dim)
@@ -216,7 +219,7 @@ TARGETS = [target1_brute_force, target2_kmeans_balanced, target3_ivf_flat,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--scale", choices=("cpu", "full"), default="cpu")
+    ap.add_argument("--scale", choices=("cpu", "chip", "full"), default="cpu")
     ap.add_argument("--out", default=None)
     ap.add_argument("--targets", default="1,2,3,4,5",
                     help="comma-separated subset, e.g. 1,3")

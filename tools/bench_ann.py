@@ -91,12 +91,16 @@ def main(which="all", n=100_000):
         fence(idx.graph)
         bt = time.perf_counter() - t0
         for itopk in (32, 64):
-            dt, (d, i) = timeit(lambda: cagra.search(
-                idx, q, k, cagra.SearchParams(itopk_size=itopk)))
-            rec = float(neighborhood_recall(np.asarray(i), gt_i))
-            print(json.dumps({"algo": "cagra", "build_s": round(bt, 2),
-                              "itopk": itopk, "qps": round(nq/dt, 1),
-                              "recall": round(rec, 4)}), flush=True)
+            for scan in ("fp32", "bf16"):
+                csp = cagra.SearchParams(
+                    itopk_size=itopk,
+                    scan_dtype="bfloat16" if scan == "bf16" else None)
+                dt, (d, i) = timeit(lambda: cagra.search(idx, q, k, csp))
+                rec = float(neighborhood_recall(np.asarray(i), gt_i))
+                print(json.dumps(
+                    {"algo": "cagra", "build_s": round(bt, 2),
+                     "itopk": itopk, "scan": scan, "qps": round(nq/dt, 1),
+                     "recall": round(rec, 4)}), flush=True)
 
 
 if __name__ == "__main__":
