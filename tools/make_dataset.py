@@ -1,0 +1,54 @@
+"""Generate a synthetic dataset in raft-ann-bench file layout.
+
+Zero-egress stand-in for the real million-scale suites (SURVEY §6:
+sift-128-euclidean et al; layout docs raft_ann_benchmarks.md): writes
+``base.fbin`` + ``query.fbin`` under ``datasets/<name>/`` using the
+shared low-rank clustered generator (bench.datagen — realistic intrinsic
+dimension; iid gaussian concentrates distances and measures the
+generator, not the index). Groundtruth is left absent on purpose: the
+bench runner computes it exactly on the active backend
+(runner.generate_groundtruth), so recall is gated against a true oracle.
+
+Usage: python tools/make_dataset.py [--name sift-128-euclidean]
+           [--rows 1000000] [--dim 128] [--queries 10000] [--out datasets]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", default="sift-128-euclidean")
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--queries", type=int, default=10_000)
+    ap.add_argument("--out", default="datasets")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from raft_tpu import native
+    from raft_tpu.bench.datagen import low_rank_clusters
+
+    rng = np.random.default_rng(args.seed)
+    out_dir = os.path.join(args.out, args.name)
+    os.makedirs(out_dir, exist_ok=True)
+    base = low_rank_clusters(rng, args.rows, args.dim, n_centers=1024)
+    # queries: perturbed base rows — the ann-benchmarks regime where
+    # true neighbors exist at small but nonzero distances
+    sel = rng.integers(0, args.rows, args.queries)
+    queries = base[sel] + 0.05 * rng.standard_normal(
+        (args.queries, args.dim)).astype(np.float32)
+    native.write_bin(os.path.join(out_dir, "base.fbin"), base)
+    native.write_bin(os.path.join(out_dir, "query.fbin"), queries)
+    print(f"wrote {out_dir}/base.fbin {base.shape} and query.fbin "
+          f"{queries.shape} (synthetic; groundtruth computed by the runner)")
+
+
+if __name__ == "__main__":
+    main()
