@@ -1,0 +1,97 @@
+"""Persistent-compilation-cache cold/warm probe on the active backend.
+
+VERDICT r2 #10: the AOT/persistent-cache story (the -ext/-inl explicit-
+instantiation role, SURVEY §1 idioms) was disabled on CPU (XLA:CPU AOT
+artifacts SIGILL'd) and never proven on TPU. This measures, for each of
+the five BASELINE target programs, the jit compile wall-time with a
+fresh cache directory (cold) and again in a child process sharing the
+cache (warm). Artifact: AOT_CACHE_tpu.json.
+
+Usage: python tools/aot_cache_probe.py [--out AOT_CACHE_tpu.json]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHILD = r"""
+import json, time, sys
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from raft_tpu import Resources
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+rng = np.random.default_rng(0)
+db = rng.standard_normal((8192, 96)).astype(np.float32)
+q = rng.standard_normal((256, 96)).astype(np.float32)
+res = Resources(seed=0)
+out = {}
+
+def timed(name, fn):
+    t0 = time.perf_counter()
+    fn()
+    out[name] = round(time.perf_counter() - t0, 2)
+
+timed("brute_force", lambda: brute_force.knn(q, db, 10,
+                                             metric="sqeuclidean", res=res))
+timed("kmeans_balanced", lambda: kmeans_balanced.fit(
+    res.next_key(), db, 64, res=res))
+fl = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=64), res=res)
+timed("ivf_flat_search", lambda: ivf_flat.search(
+    fl, q, 10, ivf_flat.SearchParams(n_probes=8)))
+pq = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=64, pq_dim=48), res=res)
+timed("ivf_pq_search", lambda: ivf_pq.search(
+    pq, q, 10, ivf_pq.SearchParams(n_probes=8)))
+cg = cagra.build(db, cagra.IndexParams(graph_degree=16,
+                                       intermediate_graph_degree=32),
+                 res=res)
+timed("cagra_search", lambda: cagra.search(
+    cg, q, 10, cagra.SearchParams(itopk_size=32)))
+print("RESULT " + json.dumps(out))
+"""
+
+
+def run_pass(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    p = subprocess.run([sys.executable, "-c", _CHILD, cache_dir],
+                       capture_output=True, env=env, timeout=1500)
+    for ln in p.stdout.decode("utf-8", "replace").splitlines():
+        if ln.startswith("RESULT "):
+            return json.loads(ln[7:])
+    raise RuntimeError(
+        f"child produced no RESULT (rc={p.returncode}): "
+        f"{p.stderr.decode('utf-8', 'replace')[-800:]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="AOT_CACHE_tpu.json")
+    args = ap.parse_args()
+    import jax
+
+    with tempfile.TemporaryDirectory(prefix="raft_tpu_aot_") as cache_dir:
+        cold = run_pass(cache_dir)
+        warm = run_pass(cache_dir)
+        n_entries = len(os.listdir(cache_dir))
+    art = {"platform": jax.default_backend(),
+           "when": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+           "cache_entries": n_entries, "cold_s": cold, "warm_s": warm,
+           "speedup": {k: round(cold[k] / warm[k], 2)
+                       for k in cold if warm.get(k)}}
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art))
+
+
+if __name__ == "__main__":
+    main()

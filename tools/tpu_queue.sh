@@ -1,0 +1,40 @@
+#!/bin/bash
+# Unattended on-chip benchmark queue (round 3). Waits for the axon tunnel
+# (probed by /tmp/tpu_watch.sh -> /tmp/tpu_up), then runs the pending
+# hardware jobs sequentially (ONE TPU process at a time), each with its
+# own log + artifact. Survives tunnel drops: every step re-probes first
+# and a failed step doesn't block later ones on the next window.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
+LOG=/tmp/tpu_queue.log
+state() { date -u +"%H:%M:%SZ $*" >> "$LOG"; }
+
+probe() { timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+
+wait_up() {
+  while ! probe; do state "tunnel down; sleeping"; sleep 300; done
+  state "tunnel up"
+}
+
+run_step() {  # run_step <name> <done-marker-file> <cmd...>
+  local name=$1 marker=$2; shift 2
+  [ -f "$marker" ] && return 0
+  wait_up
+  state "start $name"
+  if "$@" > "/tmp/q_$name.log" 2>&1; then
+    touch "$marker"; state "done $name"
+  else
+    state "FAIL $name (rc=$?)"
+  fi
+}
+
+run_step cagra  /tmp/q_cagra.done  timeout 2400 python tools/bench_ann.py cagra 100000
+run_step bench  /tmp/q_bench.done  timeout 1200 python bench.py
+run_step pareto /tmp/q_pareto.done timeout 5400 python -m raft_tpu.bench run \
+  --conf raft_tpu/bench/conf/sift-128-euclidean.json \
+  --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
+run_step targets /tmp/q_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
+run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
+state "queue complete"
