@@ -36,5 +36,6 @@ run_step pareto /tmp/q_pareto.done timeout 5400 python -m raft_tpu.bench run \
   --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
 run_step targets /tmp/q_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
+run_step pallas /tmp/q_pallas.done timeout 1800 python tools/pallas_probe.py
 run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
 state "queue complete"
