@@ -81,15 +81,27 @@ def main():
     fence((d_e, i_e))
     gt = np.asarray(i_e)
 
-    # bf16 MXU fast-scan + exact fp32 re-rank; keep it only if recall holds
-    d_f, i_f = brute_force.search(index, q, k, scan_dtype="bfloat16")
-    recall = float(neighborhood_recall(np.asarray(i_f), gt))
-    use_fast = recall >= 0.999
-    scan_dtype = "bfloat16" if use_fast else None
+    # Fast variants (ordered fastest-first), each gated on recall >= 0.999
+    # against the exact pass: bf16 MXU screen + exact fp32 re-rank, with
+    # and without APPROX candidate selection (the final re-rank select
+    # stays exact either way, so the approx screen only risks candidate
+    # misses the gate would catch).
+    variants = [
+        ({"scan_dtype": "bfloat16", "select_recall": 0.95},
+         "bf16+approx95+fp32refine"),
+        ({"scan_dtype": "bfloat16"}, "bf16+fp32refine"),
+        ({}, "fp32"),
+    ]
+    recall, chosen, label = 1.0, {}, "fp32"
+    for kw, name in variants:
+        d_f, i_f = brute_force.search(index, q, k, **kw)
+        rec = float(neighborhood_recall(np.asarray(i_f), gt))
+        if rec >= 0.999 or not kw:
+            recall, chosen, label = rec, kw, name
+            break
 
     dt = time_dispatches(
-        lambda: brute_force.search(index, q, k, scan_dtype=scan_dtype),
-        iters=5)
+        lambda: brute_force.search(index, q, k, **chosen), iters=5)
     qps = n_q / dt
 
     row = {
@@ -97,8 +109,8 @@ def main():
         "value": round(qps, 1),
         "unit": "QPS",
         "vs_baseline": 1.0,
-        "recall": round(recall, 5) if use_fast else 1.0,
-        "scan": "bf16+fp32refine" if use_fast else "fp32",
+        "recall": round(recall, 5),
+        "scan": label,
         "platform": platform,
     }
 

@@ -38,4 +38,6 @@ run_step targets /tmp/q_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
 run_step pallas /tmp/q_pallas.done timeout 1800 python tools/pallas_probe.py
 run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
+run_step flagship /tmp/q_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json
 state "queue complete"
