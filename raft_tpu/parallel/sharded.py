@@ -27,10 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from raft_tpu.ops import select_k as select_k_mod
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric, _pairwise_impl
-from raft_tpu.ops.select_k import select_k
+from raft_tpu.ops.select_k import refine_multiplier, select_k
 from raft_tpu.parallel.comms import Comms
 from raft_tpu.utils.shape import cdiv
 
@@ -959,7 +958,7 @@ def search_ivf_flat(
     empty_filter = jnp.zeros((0,), jnp.uint32)
     fast_scan = getattr(params, "scan_dtype", None) is not None
     select_recall = float(getattr(params, "select_recall", 1.0))
-    refine_mult = select_k_mod.refine_multiplier(
+    refine_mult = refine_multiplier(
         getattr(params, "refine_ratio", 4.0), fast_scan)
     if fast_scan:
         if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
