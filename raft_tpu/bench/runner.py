@@ -110,7 +110,8 @@ class BruteForce(AnnAlgo):
         return brute_force.search(
             index, queries, k, res=res,
             scan_dtype=_scan_dtype(search_param),
-            refine_ratio=float(search_param.get("refine_ratio", 4.0)))
+            refine_ratio=float(search_param.get("refine_ratio", 4.0)),
+            select_recall=float(search_param.get("select_recall", 1.0)))
 
     def save(self, index, path):
         from raft_tpu.neighbors import brute_force
@@ -142,7 +143,9 @@ class IvfFlat(AnnAlgo):
 
         sp = ivf_flat.SearchParams(
             n_probes=int(search_param.get("nprobe", 20)),
-            scan_dtype=_scan_dtype(search_param))
+            scan_dtype=_scan_dtype(search_param),
+            refine_ratio=float(search_param.get("refine_ratio", 4.0)),
+            select_recall=float(search_param.get("select_recall", 1.0)))
         return ivf_flat.search(index, queries, k, sp, res=res)
 
     def save(self, index, path):
@@ -191,6 +194,7 @@ class IvfPq(AnnAlgo):
             lut_dtype=lut,
             internal_distance_dtype=_internal_distance_dtype(search_param),
             scan_mode=scan_mode,
+            select_recall=float(search_param.get("select_recall", 1.0)),
         )
         rr = float(search_param.get("refine_ratio", 1.0))
         if rr > 1.0:
