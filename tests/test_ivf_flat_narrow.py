@@ -47,3 +47,27 @@ def test_narrow_dtype_matches_fp32(dtype):
     _, gt = brute_force.knn(qf, dbf, k=10, metric="sqeuclidean")
     rec = float(neighborhood_recall(np.asarray(i_n), np.asarray(gt)))
     assert rec >= 0.5  # probe-miss-bound on unclustered data, not dtype
+
+
+def test_uint8_ivf_pq_and_cagra():
+    """The other index families accept narrow dtypes too (reference:
+    int8/uint8 ivf_pq and cagra instantiations, cpp/src/neighbors/)."""
+    from raft_tpu.neighbors import cagra, ivf_pq
+
+    rng = np.random.default_rng(0)
+    db = rng.integers(0, 256, (8000, 32)).astype(np.uint8)
+    q = db[rng.integers(0, 8000, 200)]
+    _, gt = brute_force.knn(q.astype(np.float32), db.astype(np.float32),
+                            k=10, metric="sqeuclidean")
+    gt = np.asarray(gt)
+
+    idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=32, pq_dim=16),
+                       res=Resources(seed=0))
+    _, i_pq = ivf_pq.search(idx, q, 10, ivf_pq.SearchParams(n_probes=8))
+    assert float(neighborhood_recall(np.asarray(i_pq), gt)) >= 0.6
+
+    cg = cagra.build(db, cagra.IndexParams(graph_degree=16,
+                                           intermediate_graph_degree=32),
+                     res=Resources(seed=0))
+    _, i_cg = cagra.search(cg, q, 10, cagra.SearchParams(itopk_size=32))
+    assert float(neighborhood_recall(np.asarray(i_cg), gt)) >= 0.9
