@@ -20,6 +20,13 @@ import sys
 
 
 def _cmd_run(args) -> int:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize pre-sets jax_platforms at interpreter
+        # startup, overriding the env var — honor an explicit cpu request
+        # via jax.config so CPU runs can't hang on a dead tunnel
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from raft_tpu.bench import export, runner
 
     with open(args.conf) as f:
