@@ -23,7 +23,7 @@ import sys
 import time
 
 
-def _probe_platform(timeout_s: int = 180) -> str:
+def _probe_platform(timeout_s: int = 540) -> str:
     """Return "default" if the default JAX backend initializes in a
     subprocess within the timeout, else "cpu" (hung/broken accelerator).
 
@@ -32,6 +32,7 @@ def _probe_platform(timeout_s: int = 180) -> str:
     warm tunnel make the second init much cheaper than the first."""
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return "cpu"
+    timeout_s = int(os.environ.get("RAFT_TPU_PROBE_TIMEOUT", timeout_s))
     try:
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
