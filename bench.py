@@ -93,16 +93,24 @@ def main():
         ({"scan_dtype": "bfloat16"}, "bf16+fp32refine"),
         ({}, "fp32"),
     ]
-    recall, chosen, label = 1.0, {}, "fp32"
+    best = None  # (dt, recall, kwargs, label) — measured, not assumed:
+    # variant ordering flips between platforms (approx wins on TPU's
+    # PartialReduce, loses to plain top_k on CPU's exact fallback)
     for kw, name in variants:
         d_f, i_f = brute_force.search(index, q, k, **kw)
         rec = float(neighborhood_recall(np.asarray(i_f), gt))
-        if rec >= 0.999 or not kw:
-            recall, chosen, label = rec, kw, name
-            break
+        if rec < 0.999 and kw:
+            continue
+        dt_v = time_dispatches(
+            lambda: brute_force.search(index, q, k, **kw), iters=2,
+            warmup=0)
+        if best is None or dt_v < best[0]:
+            best = (dt_v, rec, kw, name)
+    _, recall, chosen, label = best
 
     dt = time_dispatches(
-        lambda: brute_force.search(index, q, k, **chosen), iters=5)
+        lambda: brute_force.search(index, q, k, **chosen), iters=5,
+        warmup=0)
     qps = n_q / dt
 
     row = {
