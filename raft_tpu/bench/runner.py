@@ -37,6 +37,11 @@ class AnnAlgo:
     save / load with dict params."""
 
     name = "base"
+    # Host-library algos (sklearn/scipy/hnswlib) consume numpy queries; on
+    # accelerator runs handing them the device copy would make every timed
+    # dispatch pay a device→host readback over the tunnel (~7 MB/s), skewing
+    # the comparative pareto against the CPU baselines (ADVICE r3).
+    wants_host_queries = False
 
     def build(self, dataset: np.ndarray, build_param: Dict[str, Any],
               metric: str, res: Resources):
@@ -276,6 +281,7 @@ class SklearnBruteForce(AnnAlgo):
     """Exact CPU baseline (the faiss_cpu/bruteforce comparison role)."""
 
     name = "sklearn_brute_force"
+    wants_host_queries = True
 
     def build(self, dataset, build_param, metric, res):
         from sklearn.neighbors import NearestNeighbors
@@ -297,6 +303,7 @@ class ScipyKDTree(AnnAlgo):
     """cKDTree baseline (the hnswlib-CPU comparison role for low dims)."""
 
     name = "scipy_kdtree"
+    wants_host_queries = True
 
     def build(self, dataset, build_param, metric, res):
         from scipy.spatial import cKDTree
@@ -412,8 +419,14 @@ def run_benchmark(
         gt = generate_groundtruth(base, queries, k, metric, res=res)
     gt = gt[:, :k]
     # one upload for the whole run — per-search re-uploads ride the slow
-    # tunnel link (~16 MB/s) and would dominate small-index measurements
-    queries = timing.prepare(np.asarray(queries))
+    # tunnel link (~16 MB/s) and would dominate small-index measurements;
+    # host-library algos instead get the numpy copy so their timed loops
+    # don't pay a device→host readback per dispatch (ADVICE r3). Skip the
+    # upload entirely for a baselines-only config.
+    queries_host = np.asarray(queries)
+    queries = (timing.prepare(queries_host)
+               if any(not ALGOS[c["algo"]].wants_host_queries
+                      for c in config["index"]) else queries_host)
 
     results = []
     for index_conf in config["index"]:
@@ -423,8 +436,9 @@ def run_benchmark(
                            res)
         _block_on_index(index)
         build_time = time.perf_counter() - t0
+        q = queries_host if algo.wants_host_queries else queries
         for sp in index_conf.get("search_params", [{}]):
-            row = _run_search(algo, index, queries, k, sp, gt, batch_size,
+            row = _run_search(algo, index, q, k, sp, gt, batch_size,
                               search_iters, res)
             row.update({"name": index_conf.get("name", index_conf["algo"]),
                         "algo": index_conf["algo"],
@@ -477,14 +491,20 @@ def _run_search(algo, index, queries, k, search_param, gt, batch_size,
     thr_dt = timing.time_dispatches(
         lambda: [dispatch(s) for s in range(0, nq, bs)],
         iters=iters, warmup=0)
+    thr_rtt_bound = timing.last_info["rtt_bound"]
 
     # latency mode: batches serialized by a data dependency (per-batch
     # host syncs would measure the tunnel round-trip, not the chip);
     # the tail batch is timed separately when nq % bs != 0
+    lat_rtt_bound = False
+
     def chained_latency(q0):
-        return timing.time_latency_chained(
+        nonlocal lat_rtt_bound
+        dt = timing.time_latency_chained(
             lambda qq: timing.chain_perturb(q0, dispatch(0, q_batch=qq)),
             q0, iters=max(iters * n_batches, 4))
+        lat_rtt_bound = lat_rtt_bound or timing.last_info["rtt_bound"]
+        return dt
 
     n_full = nq // bs
     lat_dt = chained_latency(queries[:bs]) * n_full if n_full else 0.0
@@ -492,7 +512,14 @@ def _run_search(algo, index, queries, k, search_param, gt, batch_size,
     if tail:
         lat_dt += chained_latency(queries[nq - tail:])
 
-    return {"k": k, "batch_size": bs, "qps": round(nq / thr_dt, 1),
-            "qps_latency_mode": round(nq / lat_dt, 1),
-            "latency_ms": round(1000.0 * lat_dt / n_batches, 3),
-            "recall": round(recall, 4)}
+    row = {"k": k, "batch_size": bs, "qps": round(nq / thr_dt, 1),
+           "qps_latency_mode": round(nq / lat_dt, 1),
+           "latency_ms": round(1000.0 * lat_dt / n_batches, 3),
+           "recall": round(recall, 4)}
+    # noise-bound (elapsed < 5× fence RTT at the iteration cap), flagged
+    # per mode so a clean qps isn't caveated by an RTT-bound tail chain
+    if thr_rtt_bound:
+        row["rtt_bound_qps"] = True
+    if lat_rtt_bound:
+        row["rtt_bound_latency"] = True
+    return row

@@ -37,10 +37,21 @@ __all__ = [
     "time_dispatches",
     "time_latency_chained",
     "chain_perturb",
+    "last_info",
 ]
 
+# Populated by time_dispatches / time_latency_chained after every
+# measurement: {"rtt_bound": bool, "fence_overhead_frac": float}. A loop
+# that is still RTT-dominated when iteration scaling gives up (the
+# _MAX_ITERS / HBM caps) returns a noise-bound number; callers that
+# persist results should record this flag so artifacts distinguish clean
+# from noise-bound measurements (ADVICE r3). Contract: read IMMEDIATELY
+# after the timing call returns — the next timing call (including any
+# nested inside a dispatch fn) overwrites it.
+last_info: dict = {"rtt_bound": False, "fence_overhead_frac": 0.0}
 
-def fence(out: Any) -> None:
+
+def fence(out: Any) -> int:
     """Block until every execution producing ``out``'s array leaves has
     completed, via a single scalar-per-leaf host readback.
 
@@ -48,13 +59,22 @@ def fence(out: Any) -> None:
     forces the whole execution (and its dependencies) to finish; probing
     every leaf covers outputs produced by distinct dispatches. All probes
     are fetched in ONE transfer so the tunnel round-trip is paid once.
+    Returns the number of leaves fenced (0 = pure-host data, no readback
+    paid — timed loops must then skip the RTT subtraction).
     """
     leaves = [l for l in jax.tree_util.tree_leaves(out)
               if isinstance(l, jax.Array)]
     if not leaves:
-        return
-    probes = [jnp.ravel(l)[:1].astype(jnp.float32) for l in leaves]
-    np.asarray(jnp.concatenate(probes))
+        return 0
+    try:
+        probes = [jnp.ravel(l)[:1].astype(jnp.float32) for l in leaves]
+        np.asarray(jnp.concatenate(probes))
+    except ValueError:
+        # leaves committed to different devices can't be concatenated into
+        # one probe (multichip tooling); pay one readback per leaf instead
+        for l in leaves:
+            np.asarray(jax.device_get(jnp.ravel(l)[:1]))
+    return len(leaves)
 
 
 def fence_index(index: Any) -> None:
@@ -89,10 +109,22 @@ def fence_overhead() -> float:
     return _FENCE_OVERHEAD_S
 
 
-def _amortize(elapsed: float, iters: int) -> float:
+def _amortize(elapsed: float, iters: int, fenced: bool = True) -> float:
     """Per-iteration seconds with the single fence round-trip removed
-    (floored: the correction must never produce zero/negative time)."""
-    return max(elapsed - fence_overhead(), elapsed * 0.1) / iters
+    (floored: the correction must never produce zero/negative time).
+    Also records whether this measurement is noise-bound (``last_info``).
+    A loop that fenced nothing (pure-host algos: numpy in/out, no device
+    arrays) paid no readback, so nothing is subtracted — otherwise the
+    correction would inflate exactly the CPU-baseline QPS it exists to
+    keep honest."""
+    if not fenced:
+        last_info["rtt_bound"] = False
+        last_info["fence_overhead_frac"] = 0.0
+        return elapsed / iters
+    oh = fence_overhead()
+    last_info["rtt_bound"] = bool(elapsed < 5 * oh)
+    last_info["fence_overhead_frac"] = round(oh / max(elapsed, 1e-12), 4)
+    return max(elapsed - oh, elapsed * 0.1) / iters
 
 
 _MAX_ITERS = 4096
@@ -131,15 +163,18 @@ def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
     one fence at the end (throughput mode — the chip stays saturated by
     in-flight work, matching the reference's thread-pool throughput mode,
     raft_ann_benchmarks.md:154)."""
-    fence_overhead()  # calibrate OUTSIDE the timed region
+    # RTT calibration happens lazily in _amortize/_scaled_iters (fenced
+    # loops only) — an eager fence_overhead() here would force device
+    # backend init even for pure-host loops, and on a dead tunnel that
+    # hangs a baselines-only run in make_c_api_client.
     for _ in range(warmup):
         fence(dispatch())
     while True:
         t0 = time.perf_counter()
         outs = [dispatch() for _ in range(iters)]
-        fence(outs)
+        fenced = fence(outs) > 0
         elapsed = time.perf_counter() - t0
-        nxt = _scaled_iters(elapsed, iters)
+        nxt = _scaled_iters(elapsed, iters) if fenced else None
         if nxt is not None:
             # every retained result stays alive on device until the fence:
             # cap in-flight growth so scaled loops can't exhaust HBM
@@ -149,7 +184,7 @@ def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
                 if isinstance(l, jax.Array)) or 1
             nxt = min(nxt, max(iters, (1 << 30) // out_bytes))
         if nxt is None or nxt <= iters:
-            return _amortize(elapsed, iters)
+            return _amortize(elapsed, iters, fenced)
         iters = nxt  # RTT-dominated: amortize over more dispatches
 
 
@@ -159,18 +194,17 @@ def time_latency_chained(step: Callable[[Any], Any], x0: Any,
     input depends on the previous call's output (caller encodes the
     dependency, e.g. via :func:`chain_perturb`), so executions serialize
     on-device; the fence round-trip is paid once and amortized."""
-    fence_overhead()  # calibrate OUTSIDE the timed region
-    fence(step(x0))  # warm / compile
+    fence(step(x0))  # warm / compile (calibration is lazy — see above)
     while True:
         t0 = time.perf_counter()
         out = x0
         for _ in range(iters):
             out = step(out)
-        fence(out)
+        fenced = fence(out) > 0
         elapsed = time.perf_counter() - t0
-        nxt = _scaled_iters(elapsed, iters)
+        nxt = _scaled_iters(elapsed, iters) if fenced else None
         if nxt is None:
-            return _amortize(elapsed, iters)
+            return _amortize(elapsed, iters, fenced)
         iters = nxt  # RTT-dominated: chain more calls
 
 

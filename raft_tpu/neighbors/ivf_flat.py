@@ -345,7 +345,8 @@ def _overflow_scan(qt, qf, o_scan, o_norms, o_ok_base, overflow_indices,
     od = jnp.where(ok[None, :], od, bad_fill)
     oi = jnp.broadcast_to(overflow_indices[None, :],
                           (qt.shape[0], overflow_indices.shape[0]))
-    return od, oi
+    o_ok = jnp.broadcast_to(ok[None, :], od.shape)
+    return od, oi, o_ok
 
 
 def _search_core(queries, centers, list_data, list_indices, list_sizes,
@@ -460,12 +461,15 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
         n_cand = n_probes * list_pad
         flat_d = d.reshape(qt.shape[0], n_cand)
         flat_i = g_idx.reshape(qt.shape[0], n_cand)
+        flat_ok = ok.reshape(qt.shape[0], n_cand)
         if has_overflow:
-            od, oi = _overflow_scan(qt, qf, o_scan, o_norms, o_ok_base,
-                                    overflow_indices, filter_words, metric,
-                                    has_filter, fast_scan, bad_fill)
+            od, oi, o_ok = _overflow_scan(qt, qf, o_scan, o_norms, o_ok_base,
+                                          overflow_indices, filter_words,
+                                          metric, has_filter, fast_scan,
+                                          bad_fill)
             flat_d = jnp.concatenate([flat_d, od], axis=1)
             flat_i = jnp.concatenate([flat_i, oi], axis=1)
+            flat_ok = jnp.concatenate([flat_ok, o_ok], axis=1)
             n_cand += od.shape[1]
         kk = min(k, n_cand)
         if fast_scan:
@@ -477,8 +481,12 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
             # candidates, exact fp32 re-rank orders them.
             k_ref = min(max(refine_mult * k, k + 8), n_cand)
             _, sel = _sel(flat_d, k_ref, minimize)
-            cand_d = jnp.take_along_axis(flat_d, sel, axis=1)
             cand_i = jnp.take_along_axis(flat_i, sel, axis=1)
+            # re-mask from the real validity bits (pad + filter), the way
+            # brute_force's re-rank does — screened-distance isfinite would
+            # silently flip if a valid distance were ±inf or bad_fill ever
+            # became finite
+            cand_ok = jnp.take_along_axis(flat_ok, sel, axis=1)
             n_main = n_probes * list_pad
             sel_p = jnp.minimum(sel // list_pad, n_probes - 1)
             sel_s = sel % list_pad
@@ -491,7 +499,7 @@ def _search_core(queries, centers, list_data, list_indices, list_sizes,
             else:
                 cand_vecs = main_vecs
             exact = gathered_distances(qf, cand_vecs, metric)
-            exact = jnp.where(jnp.isfinite(cand_d), exact, bad_fill)
+            exact = jnp.where(cand_ok, exact, bad_fill)
             v, sel2 = select_k(exact, kk, select_min=minimize)
             i_out = jnp.take_along_axis(cand_i, sel2, axis=1)
         else:
