@@ -36,6 +36,7 @@ class SelectAlgo(enum.Enum):
     TWO_PHASE = "two_phase"  # per-tile top-k, then merge (wide rows)
     PALLAS = "pallas"  # streaming k-extraction kernel (small k, wide rows)
     APPROX = "approx"  # TPU PartialReduce (lax.approx_min_k), recall<1
+    SCREEN = "screen"  # exact: certified threshold + exhaustive extraction
 
 
 _TILE = 16384
@@ -96,19 +97,33 @@ def set_auto_table(platform: str, crossovers: Optional[dict]) -> None:
     _auto_table_cache = tables
 
 
-def _resolve_auto(n: int, k: int) -> "SelectAlgo":
-    tables = _load_auto_table()
-    platform = jax.default_backend()
-    table = tables.get(platform, tables["default"])
-    # smallest k-band that covers k
-    band = None
+def _band(table: dict, k: int):
+    """Width threshold of the smallest k-band covering ``k`` (None: never)."""
     for k_max, width in sorted(
             ((float(km) if km != "inf" else float("inf"), w)
              for km, w in table.items())):
         if k <= k_max:
-            band = width
-            break
-    if band is None or n < band or k * 4 > n:
+            return width
+    return None
+
+
+def _resolve_auto(n: int, k: int, floating: bool = True) -> "SelectAlgo":
+    tables = _load_auto_table()
+    platform = jax.default_backend()
+    table = tables.get(platform, tables["default"])
+    # nested form: {"two_phase": {k-bands}, "screen": {k-bands}};
+    # flat {k-bands} = two_phase-only (pre-r4 artifacts)
+    nested = "screen" in table or "two_phase" in table
+    screen_tab = table.get("screen")
+    tp_tab = table.get("two_phase", {}) if nested else table
+    if k * 4 > n:
+        return SelectAlgo.DIRECT
+    if screen_tab and floating:
+        band = _band(screen_tab, k)
+        if band is not None and n >= band:
+            return SelectAlgo.SCREEN
+    band = _band(tp_tab, k)
+    if band is None or n < band:
         return SelectAlgo.DIRECT
     return SelectAlgo.TWO_PHASE
 
@@ -131,6 +146,71 @@ def _approx(values: jax.Array, k: int, select_min: bool,
     back sorted like DIRECT's."""
     fn = jax.lax.approx_min_k if select_min else jax.lax.approx_max_k
     return fn(values, k, recall_target=recall_target)
+
+
+def _screen(values: jax.Array, k: int, select_min: bool):
+    """Exact selection via a certified threshold + exhaustive extraction —
+    the TPU answer to the reference's one-pass radix select
+    (detail/select_radix.cuh:54-67). lax.top_k on TPU runs at a few GB/s
+    effective at IVF shapes (SELECT_K_TABLE_tpu.json: 112 ms for
+    [2048, 4096] k=10 on v5e) because it sorts; this path replaces the
+    sort over the full width with memory-bound passes plus a tiny sort:
+
+    1. τ := kth-smallest of ``lax.approx_min_k(x, m)``'s output, m ≈ 2k.
+       The approx result is m actual elements at distinct positions, and
+       the kth order statistic of ANY k+ distinct elements is ≥ the row's
+       true kth value — so τ ≥ τ* holds REGARDLESS of approx recall; the
+       PartialReduce only has to be fast, never right.
+    2. mask := x ≤ τ (⊇ the true top-k since every winner is ≤ τ* ≤ τ);
+       candidate positions recovered exhaustively from cumsum(mask) by
+       binary search (first index where the running count reaches j) —
+       log₂(n) vectorized gathers, no scatter (TPU scatter serializes).
+    3. The ≤ m_buf survivors get one stable [batch, m_buf] sort (ties
+       break by position, matching top_k) and a [:, :k] slice.
+
+    Rows where count(x ≤ τ) overflows m_buf (heavy value ties, or rows of
+    pure +inf padding) divert the WHOLE batch to DIRECT via lax.cond —
+    exactness never depends on the screen being tight. Expected count is
+    ~k/recall ≈ 1.05k, so m_buf = 2k+64 makes the fallback a rare-tail
+    event on real distance data.
+    """
+    if not select_min:
+        v, i = _screen(-values, k, True)
+        return -v, i
+    x = values
+    batch, n = x.shape
+    m = min(n, max(2 * k, k + 16))
+    m_buf = min(n, max(2 * k + 64, m))
+    # Never-selectable entries (+inf IVF pad tails / bitset-filtered
+    # candidates, NaN — but NOT -inf, which min-selection must keep) are
+    # clamped to finfo.max for the threshold pass: a row whose valid
+    # candidates are sparse but still ≥ k then gets a FINITE certified τ
+    # and takes the fast path — with τ = +inf such rows would divert the
+    # whole batch to DIRECT on every call (e.g. under a 95%-removed
+    # filter). Only rows with fewer than k selectable values (τ = FMAX)
+    # or a pathological approx miss still hit the fallback.
+    fmax = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    xc = jnp.where(x <= fmax, x, fmax)  # False for +inf and NaN only
+    av, _ = jax.lax.approx_min_k(xc, m)  # sorted ascending, distinct pos
+    tau = av[:, k - 1]
+    mask = xc <= tau[:, None]
+    cs = jnp.cumsum(mask.astype(jnp.int32), axis=1)
+    c = cs[:, -1]
+
+    def extract(_):
+        targets = jnp.arange(1, m_buf + 1, dtype=cs.dtype)
+        pos = jax.vmap(
+            lambda row: jnp.searchsorted(row, targets, side="left"))(cs)
+        posc = jnp.minimum(pos, n - 1).astype(jnp.int32)
+        vals = jnp.take_along_axis(x, posc, axis=1)
+        valid = targets[None, :] <= c[:, None]
+        vals = jnp.where(valid, vals, jnp.inf)
+        sv, si = jax.lax.sort((vals, posc), dimension=1, is_stable=True,
+                              num_keys=1)
+        return sv[:, :k], si[:, :k]
+
+    return jax.lax.cond(jnp.all(c <= m_buf), extract,
+                        lambda _: _direct(x, k, True), operand=None)
 
 
 def _two_phase(values: jax.Array, k: int, select_min: bool):
@@ -166,6 +246,11 @@ def _select_k_jit(values, k, select_min, algo, recall=0.95):
                                interpret=jax.default_backend() != "tpu")
     if algo == SelectAlgo.APPROX:
         return _approx(values, k, select_min, recall)
+    if algo == SelectAlgo.SCREEN:
+        # int rows can't ride approx_min_k / inf-padding; they take DIRECT
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            return _screen(values, k, select_min)
+        return _direct(values, k, select_min)
     if algo == SelectAlgo.DIRECT:
         return _direct(values, k, select_min)
     return _two_phase(values, k, select_min)
@@ -209,7 +294,8 @@ def select_k(
         # apply to fresh calls instead of being baked into a cached AUTO
         # trace. (AUTO never picks PALLAS — its extraction is O(k) serial
         # rounds, wrong for the IVF k=64-256 band.)
-        algo = _resolve_auto(values.shape[-1], int(k))
+        algo = _resolve_auto(values.shape[-1], int(k),
+                             jnp.issubdtype(values.dtype, jnp.floating))
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo,
                                  float(recall_target))
     if indices is not None:

@@ -54,7 +54,8 @@ def main():
     platform = jax.devices()[0].platform
     rng = np.random.default_rng(0)
     grid = []
-    algos = [SelectAlgo.DIRECT, SelectAlgo.TWO_PHASE]
+    algos = [SelectAlgo.DIRECT, SelectAlgo.TWO_PHASE, SelectAlgo.SCREEN,
+             SelectAlgo.APPROX]
     if args.pallas:
         algos.append(SelectAlgo.PALLAS)
 
@@ -74,32 +75,53 @@ def main():
             grid.append(row)
             print(row, flush=True)
 
-    # per-k crossover: smallest width where TWO_PHASE beats DIRECT and
-    # keeps beating it for every larger measured width
-    crossover_by_k = {}
-    for k in args.ks:
-        rows = [r for r in grid if r["k"] == k and "two_phase_ms" in r]
-        cross = None
-        for r in sorted(rows, key=lambda r: r["n"]):
-            wins = r["two_phase_ms"] < r["direct_ms"]
-            if wins and cross is None:
-                cross = r["n"]
-            if not wins:
-                cross = None  # must win from here up
-        crossover_by_k[k] = cross
-    # band the per-k crossovers into the AUTO-table format (k_max -> width)
-    bands = {}
-    small = [c for k, c in crossover_by_k.items() if k <= 32 and c]
-    mid = [c for k, c in crossover_by_k.items() if 32 < k <= 256 and c]
-    if small:
-        bands["32"] = min(small)
-    if mid:
-        bands["256"] = min(mid)
-    bands["inf"] = max([c for c in crossover_by_k.values() if c],
-                       default=1 << 62)
+    def sticky_crossover(col):
+        """Per-k smallest width where ``col`` beats DIRECT and keeps
+        beating it at every larger measured width."""
+        by_k = {}
+        for k in args.ks:
+            rows = [r for r in grid if r["k"] == k and col in r]
+            cross = None
+            for r in sorted(rows, key=lambda r: r["n"]):
+                wins = r[col] < r["direct_ms"]
+                if wins and cross is None:
+                    cross = r["n"]
+                if not wins:
+                    cross = None  # must win from here up
+            by_k[k] = cross
+        return by_k
+
+    def band(by_k):
+        """Band per-k crossovers into the AUTO-table format
+        (k_max -> width), or None when the algo never wins. The "inf"
+        band is emitted only when the LARGEST measured k won — a win at
+        small k must not extend into k-bands the sweep measured as
+        losses (or never measured at all)."""
+        out = {}
+        small = [c for k, c in by_k.items() if k <= 32 and c]
+        mid = [c for k, c in by_k.items() if 32 < k <= 256 and c]
+        if small:
+            out["32"] = min(small)
+        if mid:
+            out["256"] = min(mid)
+        k_top = max(by_k)
+        if by_k.get(k_top):
+            out["inf"] = by_k[k_top]
+        return out or None
+
+    crossover_by_k = sticky_crossover("two_phase_ms")
+    screen_by_k = sticky_crossover("screen_ms")
+    tp_bands = band(crossover_by_k) or {"inf": 1 << 62}
+    screen_bands = band(screen_by_k)
+    # nested AUTO-table form (select_k._resolve_auto): SCREEN is checked
+    # first, TWO_PHASE second, DIRECT the fallback
+    bands = dict(tp_bands)
+    if screen_bands:
+        bands = {"two_phase": tp_bands, "screen": screen_bands}
 
     art = {"platform": platform, "batch": args.batch, "grid": grid,
-           "crossover_by_k": crossover_by_k, "crossovers": bands,
+           "crossover_by_k": crossover_by_k,
+           "screen_crossover_by_k": screen_by_k, "crossovers": bands,
            "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
