@@ -31,6 +31,11 @@ def _cmd_run(args) -> int:
 
     with open(args.conf) as f:
         config = json.load(f)
+    if args.scale:
+        target = {"chip": 4_000_000, "smoke": 100_000}.get(args.scale)
+        if target is None:
+            target = int(args.scale)
+        config = runner.scale_config(config, target)
     rows = runner.run_benchmark(config, k=args.k, batch_size=args.batch_size,
                                 search_iters=args.iters, out_path=args.out)
     for r in rows:
@@ -109,6 +114,12 @@ def main(argv=None):
 
     pr = sub.add_parser("run", help="run a benchmark config")
     pr.add_argument("--conf", required=True, help="run config JSON path")
+    pr.add_argument("--scale", default=None,
+                    help="shrink the config to run at reduced scale: "
+                         "'chip' (4M rows, single v5e), 'smoke' (100k), "
+                         "or an explicit row count; cluster counts scale "
+                         "with the row factor and a synthetic clustered "
+                         "dataset stands in for missing files")
     pr.add_argument("--k", type=int, default=10)
     pr.add_argument("--batch-size", type=int, default=None)
     pr.add_argument("--iters", type=int, default=3)

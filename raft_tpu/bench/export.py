@@ -46,13 +46,21 @@ def export_csv(rows: List[Dict], path: str,
         rows = [p for rs in by_algo.values() for p in pareto_frontier(rs)]
     if not rows:
         return
-    keys = ["dataset", "name", "algo", "k", "batch_size", "qps",
-            "latency_ms", "recall", "build_time", "search_param"]
+    # leading columns use the reference data_export names (index_name /
+    # recall / throughput / latency, data_export/__main__.py:159-162) so
+    # its downstream plotting tooling reads our CSVs unchanged; the
+    # richer native fields follow
+    keys = ["index_name", "recall", "throughput", "latency",
+            "dataset", "name", "algo", "k", "batch_size", "qps",
+            "latency_ms", "build_time", "search_param"]
     with open(path, "w", newline="") as f:
         w = csv.DictWriter(f, fieldnames=keys, extrasaction="ignore")
         w.writeheader()
         for r in rows:
             r = dict(r)
+            r["index_name"] = r.get("name", r.get("algo", "?"))
+            r["throughput"] = r.get("qps")
+            r["latency"] = (r.get("latency_ms", 0.0) or 0.0) / 1e3
             r["search_param"] = json.dumps(r.get("search_param", {}))
             w.writerow(r)
 

@@ -322,9 +322,53 @@ class ScipyKDTree(AnnAlgo):
         return (d.astype(np.float32) ** 2), i.astype(np.int32)
 
 
+class HnswCpu(AnnAlgo):
+    """The hnswlib competitor row (the role of bench/ann/src/hnswlib/
+    hnswlib_wrapper.h — no hnswlib wheel exists on this image): a CAGRA
+    graph searched by the native C++ ef-search, which is hnswlib's
+    layer-0 searchBaseLayerST algorithm over the same on-disk format
+    neighbors/hnsw.py exports. Rival pareto points come from a genuinely
+    different (CPU, latency-oriented, sequential-walk) execution model.
+
+    build_param: M (hnswlib meaning; graph_degree = 2*M like maxM0).
+    search_param: ef.
+    """
+
+    name = "hnsw_cpu"
+    wants_host_queries = True
+
+    def build(self, dataset, build_param, metric, res):
+        from raft_tpu.neighbors import cagra
+
+        if metric not in ("sqeuclidean", "euclidean"):
+            raise ValueError(f"hnsw_cpu: unsupported metric {metric}")
+        m = int(build_param.get("M", 16))
+        idx = cagra.build(
+            np.asarray(dataset),
+            cagra.IndexParams(
+                graph_degree=2 * m,
+                intermediate_graph_degree=max(3 * m, 2 * m + 16)),
+            res=res)
+        return (np.asarray(idx.dataset), np.asarray(idx.graph))
+
+    def search(self, index, queries, k, search_param, res):
+        from raft_tpu import native
+
+        data, graph = index
+        d, i = native.graph_greedy_search(
+            data, graph, np.asarray(queries), k,
+            ef=int(search_param.get("ef", max(2 * k, 64))))
+        return d, i
+
+    def save(self, index, path):
+        from raft_tpu import native
+
+        native.hnswlib_write(path, index[0], index[1])
+
+
 ALGOS: Dict[str, Callable[[], AnnAlgo]] = {
     a.name: a for a in (BruteForce, IvfFlat, IvfPq, Cagra,
-                        SklearnBruteForce, ScipyKDTree)
+                        SklearnBruteForce, ScipyKDTree, HnswCpu)
 }
 
 
@@ -395,6 +439,63 @@ def split_groundtruth(gt_path: str, out_neighbors: str,
             "(big-ann block layout)")
     native.write_bin(out_neighbors, neigh.reshape(n, k).astype(np.int32))
     native.write_bin(out_distances, dist.reshape(n, k))
+
+
+def scale_config(config: Dict[str, Any], target_rows: int,
+                 data_dir: str = "/tmp/raft_tpu_scaled") -> Dict[str, Any]:
+    """Shrink a full-scale run config (e.g. deep-100M) to ``target_rows``
+    so it is runnable on one chip / this box: cluster counts scale with
+    the row factor (bounded below at 256), and when the config's dataset
+    files don't exist locally (offline image), a synthetic clustered
+    stand-in of the right shape is generated and cached under
+    ``data_dir``. Search/index param STRUCTURE is untouched — the point
+    is to smoke the exact sweep the reference runs, at chip scale."""
+    import copy
+
+    from raft_tpu import native
+    from raft_tpu.bench.datagen import low_rank_clusters
+
+    conf = copy.deepcopy(config)
+    ds = conf["dataset"]
+    full_rows = int(ds.get("subset_size") or 0)
+    if not full_rows:
+        n, _ = native.read_bin_header(ds["base_file"])
+        full_rows = n
+    factor = target_rows / max(full_rows, 1)
+    for entry in conf["index"]:
+        bp = entry.get("build_param", {})
+        if "nlist" in bp:
+            bp["nlist"] = max(256, int(round(bp["nlist"] * factor)))
+    if not os.path.exists(ds["base_file"]):
+        # dataset dim: the real query file when present, else the
+        # ann-benchmarks name convention ("sift-128-euclidean"), else 96
+        if os.path.exists(ds.get("query_file", "")):
+            _, dim = native.read_bin_header(ds["query_file"])
+            dim = int(dim)
+        else:
+            digits = [int(t) for t in ds["name"].split("-") if t.isdigit()]
+            dim = digits[0] if digits else 96
+        os.makedirs(data_dir, exist_ok=True)
+        base_path = os.path.join(data_dir,
+                                 f"{ds['name']}-{target_rows}.fbin")
+        q_path = os.path.join(data_dir, f"{ds['name']}-q.fbin")
+        if not os.path.exists(base_path):
+            rng = np.random.default_rng(0)
+            native.write_bin(base_path,
+                             low_rank_clusters(rng, target_rows, dim,
+                                               n_centers=1024))
+            qi = rng.integers(0, target_rows, 10_000)
+            b = native.read_bin(base_path)
+            native.write_bin(q_path,
+                             b[qi] + rng.standard_normal(
+                                 (10_000, dim)).astype(np.float32) * 0.01)
+        ds["base_file"], ds["query_file"] = base_path, q_path
+    # a full-scale groundtruth is wrong for ANY subset (its neighbor ids
+    # point at rows outside the shrunk base) — always regenerate
+    ds.pop("groundtruth_neighbors_file", None)
+    ds["subset_size"] = target_rows
+    ds["name"] = f"{ds['name']}-scaled-{target_rows}"
+    return conf
 
 
 def run_benchmark(

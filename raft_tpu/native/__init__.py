@@ -30,6 +30,7 @@ _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
 _has_prefetch = False
+_has_graph_search = False
 
 
 def ensure_built(force: bool = False) -> bool:
@@ -109,6 +110,18 @@ def _get_lib():
             _has_prefetch = True
         except AttributeError:
             _has_prefetch = False
+        global _has_graph_search
+        try:
+            lib.graph_greedy_search.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+            lib.graph_greedy_search.restype = ctypes.c_int
+            _has_graph_search = True
+        except AttributeError:
+            _has_graph_search = False
         _lib = lib
         return _lib
 
@@ -301,6 +314,87 @@ def _hnswlib_write_py(path: str, dataset: np.ndarray, graph: np.ndarray,
             buf[size_links0 + data_size :] = struct.pack("<Q", i)
             f.write(bytes(buf))
         f.write(b"\x00\x00\x00\x00" * n)
+
+
+def graph_greedy_search(dataset: np.ndarray, graph: np.ndarray,
+                        queries: np.ndarray, k: int, ef: int = 128,
+                        entry: int = 0, n_threads: int = 0):
+    """CPU ef-search over a fixed-degree graph — hnswlib's layer-0
+    searchBaseLayerST algorithm, searching exactly the indexes
+    :func:`hnswlib_write` emits (entry point 0). The external-competitor
+    row of the bench harness (hnswlib wrapper role, bench/ann/src/
+    hnswlib/hnswlib_wrapper.h); no hnswlib wheel exists on this image.
+
+    Returns (distances [nq, k] squared-L2, ids [nq, k]); -1/inf pads when
+    a query's reachable component is smaller than k.
+    """
+    dataset = np.ascontiguousarray(dataset, np.float32)
+    graph = np.ascontiguousarray(graph, np.int32)
+    queries = np.ascontiguousarray(queries, np.float32)
+    n, dim = dataset.shape
+    nq = queries.shape[0]
+    ef = max(int(ef), int(k))
+    lib = _get_lib()
+    if lib is None or not _has_graph_search:
+        return _graph_greedy_search_py(dataset, graph, queries, k, ef,
+                                       entry)
+    out_i = np.empty((nq, k), np.int32)
+    out_d = np.empty((nq, k), np.float32)
+    rc = lib.graph_greedy_search(
+        dataset.ctypes.data_as(ctypes.c_void_p), n, dim,
+        graph.ctypes.data_as(ctypes.c_void_p), graph.shape[1],
+        queries.ctypes.data_as(ctypes.c_void_p), nq,
+        int(k), ef, int(entry),
+        out_i.ctypes.data_as(ctypes.c_void_p),
+        out_d.ctypes.data_as(ctypes.c_void_p), int(n_threads))
+    if rc != 0:
+        raise ValueError(f"graph_greedy_search failed rc={rc}")
+    return out_d, out_i
+
+
+def _graph_greedy_search_py(dataset, graph, queries, k, ef, entry):
+    """Reference-rate numpy fallback (same algorithm, one query at a
+    time) — correctness seam for CI boxes without the .so."""
+    import heapq
+
+    n, dim = dataset.shape
+    nq = queries.shape[0]
+    out_i = np.full((nq, k), -1, np.int32)
+    out_d = np.full((nq, k), np.inf, np.float32)
+    for qi in range(nq):
+        q = queries[qi]
+        d0 = float(((q - dataset[entry]) ** 2).sum())
+        visited = {entry}
+        cand = [(d0, entry)]  # min-heap frontier
+        res = [(-d0, entry)]  # max-heap of top-ef (negated)
+        while cand:
+            d, c = heapq.heappop(cand)
+            if d > -res[0][0] and len(res) >= ef:
+                break
+            nbrs = graph[c]
+            nbrs = nbrs[nbrs >= 0]
+            # dedupe while filtering: a row may repeat an id, and a
+            # double-push would put the node in the result heap twice
+            new = []
+            for b in nbrs:
+                b = int(b)
+                if b not in visited:
+                    visited.add(b)
+                    new.append(b)
+            if not new:
+                continue
+            dists = ((queries[qi][None] - dataset[new]) ** 2).sum(1)
+            for b, db in zip(new, dists):
+                db = float(db)
+                if len(res) < ef or db < -res[0][0]:
+                    heapq.heappush(cand, (db, int(b)))
+                    heapq.heappush(res, (-db, int(b)))
+                    if len(res) > ef:
+                        heapq.heappop(res)
+        top = sorted((-d, i) for d, i in res)[:k]
+        for j, (d, i) in enumerate(top):
+            out_d[qi, j], out_i[qi, j] = d, i
+    return out_d, out_i
 
 
 # --------------------------------------------------- agglomerative labeling

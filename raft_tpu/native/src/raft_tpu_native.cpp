@@ -26,8 +26,10 @@
 #include <unistd.h>
 #include <vector>
 #include <algorithm>
+#include <limits>
 #include <numeric>
 #include <thread>
+#include <utility>
 #include <mutex>
 #include <condition_variable>
 
@@ -394,6 +396,104 @@ void prefetch_close(void* handle) {
   if (p->worker.joinable()) p->worker.join();
   close(p->fd);
   delete p;
+}
+
+// --------------------------------------------- graph ef-search (hnsw role)
+//
+// CPU greedy beam search over a fixed-degree neighbor graph — hnswlib's
+// layer-0 searchBaseLayerST algorithm (candidate min-heap + bounded
+// result max-heap + visited stamps), run from a fixed entry point, which
+// is exactly how the base-layer-only indexes hnswlib_write() emits are
+// searched. This is the external-competitor row of the bench harness
+// (the hnswlib wrapper role, cpp/bench/ann/src/hnswlib/
+// hnswlib_wrapper.h): no hnswlib wheel exists on this image, so the
+// algorithm itself provides the CPU rival pareto points.
+int graph_greedy_search(const float* data, int64_t n, int64_t dim,
+                        const int32_t* graph, int64_t degree,
+                        const float* queries, int64_t nq,
+                        int64_t k, int64_t ef, int64_t entry,
+                        int32_t* out_ids, float* out_dists,
+                        int64_t n_threads) {
+  if (n <= 0 || k <= 0 || ef < k || entry < 0 || entry >= n) return -1;
+  if (nq <= 0) return 0;  // empty batch: nothing to do (and the thread
+                          // clamp below would otherwise divide by zero)
+  if (n_threads <= 0)
+    n_threads = (int64_t)std::thread::hardware_concurrency();
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > nq) n_threads = nq;
+
+  auto worker = [&](int64_t q_lo, int64_t q_hi) {
+    std::vector<uint32_t> visited(n, 0);
+    uint32_t epoch = 0;
+    // (dist, id) heaps: cand = min-first frontier, res = max-first top-ef
+    using Entry = std::pair<float, int32_t>;
+    std::vector<Entry> cand, res;
+    for (int64_t qi = q_lo; qi < q_hi; ++qi) {
+      const float* q = queries + qi * dim;
+      ++epoch;
+      cand.clear();
+      res.clear();
+      auto l2 = [&](int64_t row) {
+        const float* v = data + row * dim;
+        float s = 0.f;
+        for (int64_t d = 0; d < dim; ++d) {
+          float t = q[d] - v[d];
+          s += t * t;
+        }
+        return s;
+      };
+      float d0 = l2(entry);
+      cand.push_back({-d0, (int32_t)entry});  // negate: max-heap = nearest
+      res.push_back({d0, (int32_t)entry});
+      visited[entry] = epoch;
+      float worst = d0;
+      while (!cand.empty()) {
+        std::pop_heap(cand.begin(), cand.end());
+        Entry c = cand.back();
+        cand.pop_back();
+        if (-c.first > worst && (int64_t)res.size() >= ef) break;
+        const int32_t* row = graph + (int64_t)c.second * degree;
+        for (int64_t j = 0; j < degree; ++j) {
+          int32_t nb = row[j];
+          if (nb < 0 || nb >= n || visited[nb] == epoch) continue;
+          visited[nb] = epoch;
+          float d = l2(nb);
+          if ((int64_t)res.size() < ef || d < worst) {
+            cand.push_back({-d, nb});
+            std::push_heap(cand.begin(), cand.end());
+            res.push_back({d, nb});
+            std::push_heap(res.begin(), res.end());
+            if ((int64_t)res.size() > ef) {
+              std::pop_heap(res.begin(), res.end());
+              res.pop_back();
+            }
+            worst = res.front().first;
+          }
+        }
+      }
+      std::sort(res.begin(), res.end());
+      for (int64_t j = 0; j < k; ++j) {
+        bool have = j < (int64_t)res.size();
+        out_ids[qi * k + j] = have ? res[j].second : -1;
+        out_dists[qi * k + j] = have ? res[j].first
+                                     : std::numeric_limits<float>::infinity();
+      }
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, nq);
+  } else {
+    std::vector<std::thread> pool;
+    int64_t chunk = (nq + n_threads - 1) / n_threads;
+    for (int64_t t = 0; t < n_threads; ++t) {
+      int64_t lo = t * chunk, hi = std::min(nq, lo + chunk);
+      if (lo >= hi) break;
+      pool.emplace_back(worker, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -398,13 +398,36 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
     # buffer-resident flags are a complete visited set.
     rows = jnp.arange(nq)[:, None]
 
+    # The per-iteration merge is THE cost of the TPU beam walk (r3 on-chip:
+    # sort-class primitives run at a few GB/s effective). The old body paid
+    # three of them per hop — top_k(parent pick), argsort-by-id (dedup),
+    # top_k(merge). This body keeps the buffer SORTED BY DISTANCE as a loop
+    # invariant (merge_topk_dedup_flagged establishes it at init), so:
+    # - parent pick is an argmin (width=1) or a tiny top_k over itopk;
+    # - dedup happens BEFORE the merge with two small membership compares
+    #   (targets vs buffer, targets vs earlier targets) — valid because
+    #   the buffer is dup-free by induction, so post-concat adjacency
+    #   tricks aren't needed;
+    # - the merge is ONE lax.sort of the [itopk + W·D] concat, sliced back
+    #   to itopk. Same semantics as merge_topk_dedup_flagged (a target
+    #   equal to a buffer entry is dropped, keeping the buffer copy's
+    #   expanded flag — the OR of the copies' flags, since target copies
+    #   are never flagged).
+    wd = width * degree
+
     def body(state):
         it, buf_ids, buf_d, buf_fl, done = state
         # pickup_next_parents: best `width` unexpanded buffer entries
         cand_d = jnp.where(buf_fl | (buf_ids < 0), bad, buf_d)
-        p_d, p_sel = jax.lax.top_k(-cand_d, width)
+        if width == 1:
+            p_sel = jnp.argmin(cand_d, axis=1)[:, None]
+            valid_p = jnp.isfinite(
+                jnp.take_along_axis(cand_d, p_sel, axis=1))
+        else:
+            p_d, p_sel = jax.lax.top_k(-cand_d, width)
+            valid_p = jnp.isfinite(-p_d)
         parents = jnp.take_along_axis(buf_ids, p_sel, axis=1)  # [nq, W]
-        valid_p = jnp.isfinite(-p_d) & (parents >= 0) & ~done[:, None]
+        valid_p = valid_p & (parents >= 0) & ~done[:, None]
         has_parent = valid_p[:, 0]
         newly_done = ~has_parent
         parents = jnp.where(valid_p, parents, -1)
@@ -414,22 +437,31 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
         buf_fl = buf_fl | mark
 
         # expand: gather graph rows of parents
-        targets = graph[jnp.maximum(parents, 0)].reshape(-1, width * degree)
+        targets = graph[jnp.maximum(parents, 0)].reshape(-1, wd)
         targets = jnp.where(
             jnp.repeat(parents < 0, degree, axis=1), -1, targets)
+        # drop targets already in the buffer (the visited-set test) and
+        # copies among the targets themselves (parents sharing neighbors)
+        in_buf = jnp.any(targets[:, :, None] == buf_ids[:, None, :], axis=2)
+        if wd > 1:
+            earlier = jnp.tril(jnp.ones((wd, wd), bool), -1)
+            dup_t = jnp.any((targets[:, :, None] == targets[:, None, :])
+                            & earlier[None], axis=2)
+            in_buf = in_buf | dup_t
+        targets = jnp.where(in_buf, -1, targets)
         t_d = dists_to(targets)
-        t_fl = jnp.zeros_like(targets, dtype=bool)
 
-        new_ids = jnp.concatenate([buf_ids, targets], axis=1)
         new_d = jnp.concatenate([buf_d, t_d], axis=1)
-        new_fl = jnp.concatenate([buf_fl, t_fl], axis=1)
-        nb_ids, nb_d, nb_fl = merge_topk_dedup_flagged(
-            new_ids, new_d, new_fl, itopk)
+        new_ids = jnp.concatenate([buf_ids, targets], axis=1)
+        new_fl = jnp.concatenate(
+            [buf_fl, jnp.zeros_like(targets, dtype=bool)], axis=1)
+        sd, si, sf = jax.lax.sort((new_d, new_ids, new_fl), dimension=1,
+                                  num_keys=1)
         # frozen queries keep their state
         keep = done[:, None]
-        buf_ids = jnp.where(keep, buf_ids, nb_ids)
-        buf_d = jnp.where(keep, buf_d, nb_d)
-        buf_fl = jnp.where(keep, buf_fl, nb_fl)
+        buf_ids = jnp.where(keep, buf_ids, si[:, :itopk])
+        buf_d = jnp.where(keep, buf_d, sd[:, :itopk])
+        buf_fl = jnp.where(keep, buf_fl, sf[:, :itopk])
         done = done | newly_done
         return it + 1, buf_ids, buf_d, buf_fl, done
 

@@ -91,6 +91,21 @@ def test_competitor_wrappers_comparative_run(dataset_files, tmp_path):
         assert r["latency_ms"] > 0
 
 
+def test_hnsw_cpu_competitor(dataset_files):
+    """The hnswlib-role rival (native C++ layer-0 ef-search over a CAGRA
+    graph, hnswlib_wrapper.h analog): higher ef must trade QPS for
+    recall, and big-ef recall must be near-exact on a tiny set."""
+    config = _config(dataset_files, [
+        {"name": "hnsw", "algo": "hnsw_cpu", "build_param": {"M": 8},
+         "search_params": [{"ef": 10}, {"ef": 200}]},
+    ])
+    rows = runner.run_benchmark(config, k=10, search_iters=1)
+    assert len(rows) == 2
+    lo, hi = rows
+    assert hi["recall"] >= 0.95, hi
+    assert hi["recall"] >= lo["recall"]
+
+
 @pytest.mark.slow
 def test_run_all_algos(dataset_files, tmp_path):
     config = _config(dataset_files, [

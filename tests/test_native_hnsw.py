@@ -180,3 +180,44 @@ def test_prefetch_iterator_matches_sync(tmp_path):
     for (s0, b0), (s1, b1) in zip(sync, pre):
         assert s0 == s1
         np.testing.assert_array_equal(b0, b1)
+
+
+def test_graph_greedy_search_exact_on_full_graph(rng):
+    """ef-search on a COMPLETE graph must be exhaustive: every node is one
+    hop from the entry, so top-k equals brute force exactly."""
+    from raft_tpu import native
+
+    n, dim = 200, 16
+    db = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((5, dim)).astype(np.float32)
+    full = np.broadcast_to(np.arange(n, dtype=np.int32), (n, n)).copy()
+    d, i = native.graph_greedy_search(db, full, q, 10, ef=n)
+    exact = ((q[:, None, :] - db[None]) ** 2).sum(-1)
+    np.testing.assert_array_equal(i, np.argsort(exact, 1)[:, :10])
+    np.testing.assert_allclose(d, np.sort(exact, 1)[:, :10], rtol=1e-5)
+
+
+def test_graph_greedy_search_cpp_matches_python(rng):
+    from raft_tpu import native
+
+    n, dim, deg = 500, 8, 12
+    db = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((20, dim)).astype(np.float32)
+    graph = rng.integers(0, n, (n, deg)).astype(np.int32)
+    graph[::7, -1] = -1  # ragged rows
+    d1, i1 = native.graph_greedy_search(db, graph, q, 5, ef=32)
+    d2, i2 = native._graph_greedy_search_py(db, graph, q, 5, 32, 0)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_allclose(d1, d2, rtol=1e-5)
+
+
+def test_graph_greedy_search_disconnected_pads(rng):
+    """Unreachable components yield -1/inf pads, not garbage."""
+    from raft_tpu import native
+
+    db = rng.standard_normal((10, 4)).astype(np.float32)
+    graph = np.full((10, 2), -1, np.int32)
+    graph[0] = [1, 2]  # entry's component = {0, 1, 2}
+    d, i = native.graph_greedy_search(db, graph, db[:1], 5, ef=8)
+    assert set(i[0][:3]) == {0, 1, 2}
+    assert (i[0][3:] == -1).all() and np.isinf(d[0][3:]).all()

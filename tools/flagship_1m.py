@@ -46,6 +46,12 @@ def main():
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--skip-cagra", action="store_true")
     ap.add_argument("--data", default="/tmp/flagship_1m.fbin")
+    # DEEP-100M shape dials (VERDICT r3 #4: 10M needs nlist 16384 to smoke
+    # the assembly/probe-gather path within 3x of the reference's 50k
+    # lists, deep-100M.json:252-340)
+    ap.add_argument("--nlist", type=int, default=1024)
+    ap.add_argument("--train-rows", type=int, default=200_000)
+    ap.add_argument("--nprobes", type=int, default=64)
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
@@ -93,11 +99,13 @@ def main():
 
     # ---- sharded streamed IVF-PQ build + SPMD LUT search
     comms = comms_mod.init_comms(axis="flagship")
-    params = ivf_pq.IndexParams(n_lists=1024, pq_dim=max(args.dim // 2, 8))
+    params = ivf_pq.IndexParams(n_lists=args.nlist,
+                                pq_dim=max(args.dim // 2, 8))
+    art["n_lists"] = args.nlist
     t0 = time.monotonic()
     idx = sharded.build_ivf_pq_from_file(
         comms, args.data, params, res=Resources(seed=0),
-        scan_mode="lut", max_train_rows=200_000)
+        scan_mode="lut", max_train_rows=args.train_rows)
     _fence(idx.list_codes)
     art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
     art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])
@@ -117,7 +125,7 @@ def main():
     # q stays a host array: the sharded search shards it over the mesh
     # itself, and a device-0-committed input would fight that placement
     # (384 KB upload noise is negligible at this scale)
-    sp = ivf_pq.SearchParams(n_probes=64, scan_mode="lut")
+    sp = ivf_pq.SearchParams(n_probes=args.nprobes, scan_mode="lut")
     d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
     _fence((d, i))
     t0 = time.monotonic()

@@ -93,17 +93,19 @@ def main():
 
     def band(by_k):
         """Band per-k crossovers into the AUTO-table format
-        (k_max -> width), or None when the algo never wins. The "inf"
-        band is emitted only when the LARGEST measured k won — a win at
-        small k must not extend into k-bands the sweep measured as
-        losses (or never measured at all)."""
+        (k_max -> width), or None when the algo never wins. A band is
+        emitted only when EVERY measured k inside it won, at the widest
+        (most conservative) of their crossovers — a win at one k must
+        not extend to a k the sweep measured as a loss (or never
+        measured): the "inf" band therefore needs the largest measured
+        k to have won."""
         out = {}
-        small = [c for k, c in by_k.items() if k <= 32 and c]
-        mid = [c for k, c in by_k.items() if 32 < k <= 256 and c]
-        if small:
-            out["32"] = min(small)
-        if mid:
-            out["256"] = min(mid)
+        small = [c for k, c in by_k.items() if k <= 32]
+        mid = [c for k, c in by_k.items() if 32 < k <= 256]
+        if small and all(small):
+            out["32"] = max(small)
+        if mid and all(mid):
+            out["256"] = max(mid)
         k_top = max(by_k)
         if by_k.get(k_top):
             out["inf"] = by_k[k_top]

@@ -1,9 +1,13 @@
 #!/bin/bash
-# Unattended on-chip benchmark queue (round 3). Waits for the axon tunnel
+# Unattended on-chip benchmark queue (round 4). Waits for the axon tunnel
 # (probed by /tmp/tpu_watch.sh -> /tmp/tpu_up), then runs the pending
 # hardware jobs sequentially (ONE TPU process at a time), each with its
 # own log + artifact. Survives tunnel drops: every step re-probes first
 # and a failed step doesn't block later ones on the next window.
+#
+# Round-4 ordering (VERDICT r3): highest-value artifacts first so a short
+# window still lands (1) an on-chip test gate, (2) the headline number,
+# (3) the select_k SCREEN measurement that decides the round's perf fix.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
@@ -29,15 +33,47 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
   fi
 }
 
-run_step cagra  /tmp/q_cagra.done  timeout 2400 python tools/bench_ann.py cagra 100000
-run_step bench  /tmp/q_bench.done  timeout 1200 python bench.py
+# 1. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
+#    failure classes the CPU suite provably cannot see
+run_step tputests /tmp/q_tputests.done timeout 2700 \
+  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
+
+# 2. headline benchmark on chip (the BENCH_r04 dress rehearsal)
+run_step bench  /tmp/q_bench.done  timeout 1800 python bench.py
+
+# 3. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
+#    top perf fix; feeds AUTO via the nested crossovers table)
+run_step selectk /tmp/q_selectk.done timeout 3600 \
+  python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json
+
+# 4. headline again with the measured table active: if SCREEN wins, this
+#    is the number that should become the committed default
+run_step bench_screen /tmp/q_bench_screen.done \
+  env RAFT_TPU_SELECTK_TABLE=/root/repo/SELECT_K_TABLE_tpu.json \
+  timeout 1800 python bench.py
+
+# 5. batch-1/10 latency decomposition (dispatch vs on-chip; VERDICT #6)
+run_step latency /tmp/q_latency.done timeout 2400 \
+  python tools/latency_profile.py --out LATENCY_TPU.json
+
+# 6. cagra sweep at recall 0.95 operating points (VERDICT #3)
+run_step cagra  /tmp/q_cagra.done  timeout 3600 \
+  python tools/bench_ann.py cagra 100000
+
+# 7. sift-1M pareto (fp32/bf16/fp8 LUTs + approx + screen points)
 run_step pareto /tmp/q_pareto.done timeout 5400 python -m raft_tpu.bench run \
   --conf raft_tpu/bench/conf/sift-128-euclidean.json \
   --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
+
+# 8. chip-scale baseline targets (BASELINE.md rows at single-chip shapes)
 run_step targets /tmp/q_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
+
+# 9/10. decide the Pallas + AOT stories with on-chip data (VERDICT #8)
 run_step pallas /tmp/q_pallas.done timeout 1800 python tools/pallas_probe.py
 run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
+
+# 11. 1M-row sharded-build flagship on chip
 run_step flagship /tmp/q_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json
 state "queue complete"
