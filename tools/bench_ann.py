@@ -12,6 +12,12 @@ import sys
 import time
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon sitecustomize pre-sets jax_platforms="axon,cpu" at
+    # interpreter startup, overriding the env var — honor an explicit
+    # cpu request so CPU runs can't hang on a dead tunnel
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -90,17 +96,24 @@ def main(which="all", n=100_000):
             graph_degree=32, intermediate_graph_degree=64))
         fence(idx.graph)
         bt = time.perf_counter() - t0
-        for itopk in (32, 64):
-            for scan in ("fp32", "bf16"):
-                csp = cagra.SearchParams(
-                    itopk_size=itopk,
-                    scan_dtype="bfloat16" if scan == "bf16" else None)
-                dt, (d, i) = timeit(lambda: cagra.search(idx, q, k, csp))
-                rec = float(neighborhood_recall(np.asarray(i), gt_i))
-                print(json.dumps(
-                    {"algo": "cagra", "build_s": round(bt, 2),
-                     "itopk": itopk, "scan": scan, "qps": round(nq/dt, 1),
-                     "recall": round(rec, 4)}), flush=True)
+        # recall-0.95 operating points, not recall-1.0 over-search
+        # (VERDICT r3 #3: itopk 128 at k=10 was massively over-searching;
+        # the goal is CAGRA >= ivf_flat QPS at matched recall ~0.95)
+        for itopk in (16, 32, 64):
+            for width in (1, 2):
+                for scan in ("fp32", "bf16"):
+                    csp = cagra.SearchParams(
+                        itopk_size=itopk, search_width=width,
+                        num_random_samplings=2,
+                        scan_dtype="bfloat16" if scan == "bf16" else None)
+                    dt, (d, i) = timeit(
+                        lambda: cagra.search(idx, q, k, csp))
+                    rec = float(neighborhood_recall(np.asarray(i), gt_i))
+                    print(json.dumps(
+                        {"algo": "cagra", "build_s": round(bt, 2),
+                         "itopk": itopk, "width": width, "scan": scan,
+                         "qps": round(nq/dt, 1),
+                         "recall": round(rec, 4)}), flush=True)
 
 
 if __name__ == "__main__":

@@ -76,4 +76,11 @@ run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
 # 11. 1M-row sharded-build flagship on chip
 run_step flagship /tmp/q_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json
+
+# 12. 10M-row flagship at nlist 16384 (VERDICT r3 #4) — minutes on chip,
+#     hours on this 1-core host; the queue runs it on hardware when a
+#     window allows
+run_step flagship10m /tmp/q_flagship10m.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 9000 python tools/flagship_1m.py --rows 10000000 --nlist 16384 \
+  --train-rows 800000 --data /tmp/flagship_10m.fbin --out FLAGSHIP_10M_tpu.json
 state "queue complete"
