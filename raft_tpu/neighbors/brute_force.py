@@ -40,7 +40,8 @@ from raft_tpu.ops.distance import (
 )
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
-from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
+from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
+                                  query_bucket)
 
 
 class Index:
@@ -249,7 +250,9 @@ def search(index: Index, queries, k: int, filter=None,
     ranking is exact except for candidates the bf16 screen misses
     (recall ≥ 0.999 at refine_ratio=4 in practice)."""
     res = ensure_resources(res)
-    queries = jnp.asarray(queries, index.dataset.dtype)
+    # host inputs stay host-side: the jit call transfers the padded
+    # batch in ONE dispatch
+    queries = as_query_array(queries, dtype=index.dataset.dtype)
     if queries.shape[1] != index.dim:
         raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
     k = int(min(k, index.size))

@@ -47,7 +47,8 @@ from raft_tpu.ops.distance import (
     resolve_metric,
 )
 from raft_tpu.ops.select_k import merge_topk_dedup, merge_topk_dedup_flagged
-from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
+from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
+                                  query_bucket)
 
 
 class BuildAlgo(enum.IntEnum):
@@ -510,9 +511,9 @@ def search(
     candidate buffer, as in the reference's filtered search)."""
     params = params or SearchParams()
     res = ensure_resources(res)
-    queries = jnp.asarray(queries)
-    if queries.ndim == 1:
-        queries = queries[None]
+    queries = as_query_array(queries)  # host inputs stay host-side: the
+    if queries.ndim == 1:              # jit call transfers the padded
+        queries = queries[None]        # batch in ONE dispatch
     if queries.shape[1] != index.dim:
         raise ValueError(
             f"query dim {queries.shape[1]} != index dim {index.dim}")

@@ -46,7 +46,8 @@ from raft_tpu.ops.distance import (DistanceType, gathered_distances,
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
 from raft_tpu.ops import rng as rrng
-from raft_tpu.utils.shape import cdiv, pad_rows, query_bucket
+from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
+                                  query_bucket)
 
 
 @dataclasses.dataclass
@@ -546,9 +547,10 @@ def search(
     res = ensure_resources(res)
     if index.list_data is None:
         raise ValueError("index has no data; call extend() first")
-    queries = jnp.asarray(queries)
-    if queries.shape[1] != index.dim:
-        raise ValueError(f"query dim {queries.shape[1]} != index dim {index.dim}")
+    queries = as_query_array(queries)  # host inputs stay host-side: the
+    if queries.shape[1] != index.dim:  # jit call transfers the padded
+        raise ValueError(              # batch in ONE dispatch
+            f"query dim {queries.shape[1]} != index dim {index.dim}")
     nq = queries.shape[0]
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     n_probes = int(min(params.n_probes, index.n_lists))

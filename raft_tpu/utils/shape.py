@@ -3,7 +3,9 @@ padding helpers used by the IVF list layouts and Pallas kernels."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 LANES = 128  # TPU lane count: last-dim tiling unit
 SUBLANES_F32 = 8
@@ -18,12 +20,33 @@ def round_up_to(n: int, multiple: int) -> int:
 
 
 def pad_rows(x, target_rows: int, fill=0):
-    """Pad a [n, ...] array to [target_rows, ...]."""
+    """Pad a [n, ...] array to [target_rows, ...]. Host arrays pad on the
+    host (numpy) so serving wrappers don't pay an eager device dispatch
+    per call — the padded batch then rides the jit call's single
+    transfer; device arrays pad on device as before."""
     n = x.shape[0]
     if n == target_rows:
         return x
     pad_widths = [(0, target_rows - n)] + [(0, 0)] * (x.ndim - 1)
+    if isinstance(x, np.ndarray):
+        return np.pad(x, pad_widths, constant_values=fill)
     return jnp.pad(x, pad_widths, constant_values=fill)
+
+
+def as_query_array(queries, dtype=None):
+    """Wrapper-side query normalization that KEEPS host inputs on the
+    host: lists/numpy become a numpy array (validated/shaped for free),
+    device arrays pass through; ``dtype`` casts on whichever side the
+    data lives. The device transfer then happens once, inside the
+    search's jit call, instead of as an eager ``jnp.asarray`` dispatch
+    (+ a second eager pad) per serving call — on a tunnel-attached TPU
+    each eager op is a separate runtime enqueue."""
+    if isinstance(queries, jax.Array):
+        return queries if dtype is None else queries.astype(dtype)
+    queries = np.asarray(queries)
+    if dtype is not None:
+        queries = queries.astype(dtype, copy=False)
+    return queries
 
 
 def query_bucket(nq: int, max_bucket: int = 256) -> int:
