@@ -113,6 +113,23 @@ def main():
         warmup=0)
     qps = n_q / dt
 
+    # which select algorithm the winning variant's scan actually used:
+    # APPROX when the variant opted in via select_recall, else what AUTO
+    # resolves at the scan's true select width (db_tile, not n_db) —
+    # records whether a measured SELECT_K_TABLE artifact flipped the
+    # exact default (SCREEN vs DIRECT) in this run
+    if chosen.get("select_recall", 1.0) < 1.0:
+        sel_algo = "approx"
+    else:
+        from raft_tpu.neighbors.brute_force import _choose_tiles
+        from raft_tpu.ops.select_k import _resolve_auto
+        from raft_tpu.core.resources import ensure_resources
+
+        _, db_tile = _choose_tiles(
+            n_q, n_db, dim, k,
+            ensure_resources(None).workspace_limit_bytes)
+        sel_algo = _resolve_auto(db_tile, k).value
+
     row = {
         "metric": "brute_force_knn_qps_sift10k_k10",
         "value": round(qps, 1),
@@ -120,6 +137,7 @@ def main():
         "vs_baseline": 1.0,
         "recall": round(recall, 5),
         "scan": label,
+        "select_algo": sel_algo,
         "platform": platform,
     }
 
