@@ -1015,3 +1015,196 @@ def search_ivf_flat(
     q = comms.shard(queries, P(None, None))
     return jax.jit(fn)(q, index.centers, index.list_data, index.list_indices,
                        index.list_sizes)
+
+
+# ------------------------------------------------------------- persistence
+#
+# Checkpoint/resume for sharded indexes (the raft-dask role of per-worker
+# local serialization): ONE file per controller process, containing that
+# process's addressable shard blocks. Single-controller runs produce one
+# file holding every shard; multi-controller runs produce one per process
+# (same prefix), and deserialization collects whichever rank files carry
+# the shards this process can address — a multi-hour from-file build no
+# longer has to be rebuilt to be searched again.
+
+_SHARD_SERIAL_VERSION = 1
+
+
+def _local_shard_blocks(arr) -> dict:
+    """{global shard rank r: np block} for this process's addressable
+    shards of a ``P(axis, None, ...)``-sharded ``[S, ...]`` array."""
+    out = {}
+    for s in arr.addressable_shards:
+        r = s.index[0].start or 0
+        out[r] = np.asarray(s.data)[0]
+    return out
+
+
+def _write_field(w, block: np.ndarray) -> None:
+    """bf16 has no stable .npy representation — store a uint16 view with
+    a dtype flag."""
+    is_bf16 = block.dtype == jnp.bfloat16
+    w.scalar(1 if is_bf16 else 0, "<i4")
+    w.array(block.view(np.uint16) if is_bf16 else block)
+
+
+def _read_field(r) -> np.ndarray:
+    is_bf16 = bool(r.scalar())
+    a = r.array()
+    return a.view(jnp.bfloat16) if is_bf16 else a
+
+
+def _serialize_sharded(prefix: str, kind: str, scalars, fields) -> None:
+    """``scalars``: [(value, dtype)], ``fields``: [arr or None] — every
+    process writes its addressable shard blocks to ``prefix.rank<i>``."""
+    from raft_tpu.core import serialize as ser
+
+    present = [a is not None for a in fields]
+    blocks = [(_local_shard_blocks(a) if p else None)
+              for a, p in zip(fields, present)]
+    local_ranks = sorted(next(b for b, p in zip(blocks, present) if p))
+    path = f"{prefix}.rank{jax.process_index()}"
+    with open(path, "wb") as stream:
+        w = ser.IndexWriter(stream, kind, _SHARD_SERIAL_VERSION)
+        for value, dtype in scalars:
+            w.scalar(value, dtype)
+        w.scalar(len(present), "<i4")
+        for p in present:
+            w.scalar(1 if p else 0, "<i4")
+        w.scalar(len(local_ranks), "<i4")
+        for r in local_ranks:
+            w.scalar(r, "<i4")
+            for b, p in zip(blocks, present):
+                if p:
+                    _write_field(w, b[r])
+
+
+def _addressable_ranks(comms: Comms) -> set:
+    """Shard ranks whose devices this process can address."""
+    me = jax.process_index()
+    return {r for r in range(comms.size)
+            if _shard_device(comms, r).process_index == me}
+
+
+def _deserialize_sharded(prefix: str, kind: str, n_scalars: int,
+                         want_ranks=None):
+    """Read every ``prefix.rank*`` file; returns (scalars, parts) where
+    ``parts`` is a list of {r: np block} per field (None = absent).
+
+    Only ranks in ``want_ranks`` are RETAINED (non-addressable shards are
+    read file-at-a-time and dropped, bounding host RAM at roughly one
+    rank file instead of the whole index), but EVERY rank seen is
+    validated: a rank appearing twice means stale rank files from a
+    previous run with a different process layout are mixed in, and the
+    union must cover exactly range(size) — both raise instead of
+    silently corrupting the restored index."""
+    import glob as _glob
+
+    from raft_tpu.core import serialize as ser
+
+    paths = sorted(_glob.glob(_glob.escape(prefix) + ".rank*"))
+    if not paths:
+        raise FileNotFoundError(f"no shard files match {prefix}.rank*")
+    scalars = None
+    parts = None
+    seen: dict = {}  # rank -> path
+    for path in paths:
+        with open(path, "rb") as stream:
+            r = ser.IndexReader(stream, kind, _SHARD_SERIAL_VERSION)
+            s = [r.scalar() for _ in range(n_scalars)]
+            n_fields = r.scalar()
+            present = [bool(r.scalar()) for _ in range(n_fields)]
+            if scalars is None:
+                scalars = s
+                parts = [({} if p else None) for p in present]
+            elif s != scalars:
+                raise ValueError(
+                    f"{path}: header disagrees with other rank files")
+            n_local = r.scalar()
+            for _ in range(n_local):
+                rank = int(r.scalar())
+                if rank in seen:
+                    raise ValueError(
+                        f"shard rank {rank} appears in both {seen[rank]} "
+                        f"and {path} — stale rank files from a previous "
+                        f"run? Remove outdated {prefix}.rank* files")
+                seen[rank] = path
+                keep = want_ranks is None or rank in want_ranks
+                for f, p in zip(parts, present):
+                    if p:
+                        block = _read_field(r)
+                        if keep:
+                            f[rank] = block
+    return scalars, parts, seen
+
+
+def _check_rank_coverage(seen: dict, size: int, prefix: str) -> None:
+    missing = sorted(set(range(size)) - set(seen))
+    if missing:
+        raise ValueError(
+            f"{prefix}.rank* files cover only {sorted(seen)} of "
+            f"{size} shard ranks; missing {missing} (partial checkpoint?)")
+
+
+def serialize_ivf_pq(index: ShardedIvfPq, prefix: str) -> None:
+    """Persist a sharded IVF-PQ index (either engine) as rank files."""
+    engine = 1 if index.list_codes is not None else 0
+    scalars = [
+        (int(index.metric), "<i4"), (index.n_rows, "<i8"),
+        (index.comms.size, "<i4"), (index.pq_dim, "<i4"),
+        (index.pq_bits, "<i4"), (1 if index.per_cluster else 0, "<i4"),
+        (engine, "<i4"),
+    ]
+    fields = [index.centers, index.rotation, index.list_indices,
+              index.list_sizes, index.list_decoded, index.decoded_norms,
+              index.codebooks, index.list_codes, index.overflow_decoded,
+              index.overflow_norms, index.overflow_indices]
+    _serialize_sharded(prefix, "sharded_ivf_pq", scalars, fields)
+
+
+def deserialize_ivf_pq(prefix: str, comms: Comms) -> ShardedIvfPq:
+    scalars, parts, seen = _deserialize_sharded(
+        prefix, "sharded_ivf_pq", 7, want_ranks=_addressable_ranks(comms))
+    metric, n_rows, size, pq_dim, pq_bits, per_cluster, _engine = scalars
+    if size != comms.size:
+        raise ValueError(
+            f"index was sharded over {size} devices, comms has {comms.size}")
+    _check_rank_coverage(seen, int(size), prefix)
+    arrs = [(_stack_sharded(comms, p) if p is not None else None)
+            for p in parts]
+    (centers, rotation, list_indices, list_sizes, list_decoded,
+     decoded_norms, codebooks, list_codes, overflow_decoded,
+     overflow_norms, overflow_indices) = arrs
+    return ShardedIvfPq(
+        comms, centers, rotation, list_indices, list_sizes,
+        DistanceType(metric), int(n_rows), list_decoded=list_decoded,
+        decoded_norms=decoded_norms, codebooks=codebooks,
+        list_codes=list_codes, per_cluster=bool(per_cluster),
+        pq_dim=int(pq_dim), pq_bits=int(pq_bits),
+        overflow_decoded=overflow_decoded, overflow_norms=overflow_norms,
+        overflow_indices=overflow_indices)
+
+
+def serialize_ivf_flat(index: ShardedIvfFlat, prefix: str) -> None:
+    """Persist a sharded IVF-Flat index as rank files."""
+    scalars = [(int(index.metric), "<i4"), (index.n_rows, "<i8"),
+               (index.comms.size, "<i4")]
+    fields = [index.centers, index.list_data, index.list_indices,
+              index.list_sizes, index.overflow_data, index.overflow_indices]
+    _serialize_sharded(prefix, "sharded_ivf_flat", scalars, fields)
+
+
+def deserialize_ivf_flat(prefix: str, comms: Comms) -> ShardedIvfFlat:
+    scalars, parts, seen = _deserialize_sharded(
+        prefix, "sharded_ivf_flat", 3, want_ranks=_addressable_ranks(comms))
+    metric, n_rows, size = scalars
+    if size != comms.size:
+        raise ValueError(
+            f"index was sharded over {size} devices, comms has {comms.size}")
+    _check_rank_coverage(seen, int(size), prefix)
+    arrs = [(_stack_sharded(comms, p) if p is not None else None)
+            for p in parts]
+    centers, list_data, list_indices, list_sizes, o_data, o_ids = arrs
+    return ShardedIvfFlat(comms, centers, list_data, list_indices,
+                          list_sizes, DistanceType(metric), int(n_rows),
+                          overflow_data=o_data, overflow_indices=o_ids)

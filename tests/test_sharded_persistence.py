@@ -1,0 +1,95 @@
+"""Sharded index checkpoint/resume (the raft-dask per-worker persistence
+role): rank files round-trip both engines bit-exactly on the virtual
+8-device mesh."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.parallel import comms as comms_mod
+from raft_tpu.parallel import sharded
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(5)
+    centers = (rng.standard_normal((32, 32)) * 4).astype(np.float32)
+    x = (centers[rng.integers(0, 32, 4096)]
+         + rng.standard_normal((4096, 32))).astype(np.float32)
+    q = (centers[rng.integers(0, 32, 32)]
+         + rng.standard_normal((32, 32))).astype(np.float32)
+    return x, q
+
+
+@pytest.mark.parametrize("scan_mode", ["lut", "cache"])
+def test_sharded_ivf_pq_roundtrip(tmp_path, data, scan_mode):
+    x, q = data
+    comms = comms_mod.init_comms(axis="persist_pq_" + scan_mode)
+    idx = sharded.build_ivf_pq(
+        comms, x, ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                     kmeans_n_iters=3),
+        res=Resources(seed=0), scan_mode=scan_mode)
+    d0, i0 = sharded.search_ivf_pq(idx, q, 10,
+                                   ivf_pq.SearchParams(n_probes=8))
+    prefix = str(tmp_path / f"pq_{scan_mode}")
+    sharded.serialize_ivf_pq(idx, prefix)
+    idx2 = sharded.deserialize_ivf_pq(prefix, comms)
+    d1, i1 = sharded.search_ivf_pq(idx2, q, 10,
+                                   ivf_pq.SearchParams(n_probes=8))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_sharded_ivf_flat_roundtrip(tmp_path, data):
+    x, q = data
+    comms = comms_mod.init_comms(axis="persist_flat")
+    idx = sharded.build_ivf_flat(
+        comms, x, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=3),
+        res=Resources(seed=0))
+    d0, i0 = sharded.search_ivf_flat(idx, q, 10,
+                                     ivf_flat.SearchParams(n_probes=8))
+    prefix = str(tmp_path / "flat")
+    sharded.serialize_ivf_flat(idx, prefix)
+    idx2 = sharded.deserialize_ivf_flat(prefix, comms)
+    d1, i1 = sharded.search_ivf_flat(idx2, q, 10,
+                                     ivf_flat.SearchParams(n_probes=8))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_sharded_deserialize_validation(tmp_path, data):
+    import shutil
+
+    import jax
+
+    x, _ = data
+    comms = comms_mod.init_comms(axis="persist_mismatch")
+    idx = sharded.build_ivf_flat(
+        comms, x, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2),
+        res=Resources(seed=0))
+    prefix = str(tmp_path / "mm")
+    sharded.serialize_ivf_flat(idx, prefix)
+
+    # a comms of a different size must be rejected
+    comms4 = comms_mod.init_comms(jax.devices()[:4], axis="persist_mm4")
+    with pytest.raises(ValueError, match="sharded over"):
+        sharded.deserialize_ivf_flat(prefix, comms4)
+
+    # a stale rank file from a previous layout (duplicate shard ranks)
+    # must be rejected rather than silently merged
+    shutil.copy(prefix + ".rank0", prefix + ".rank1")
+    with pytest.raises(ValueError, match="stale rank files"):
+        sharded.deserialize_ivf_flat(prefix, comms)
+
+    # a partial checkpoint (missing shard ranks) must name the gap
+    with pytest.raises(ValueError, match=r"missing \[1, 3\]"):
+        sharded._check_rank_coverage({0: "f", 2: "f"}, 4, "p")
+
+    # and absent files fail loudly
+    import os
+
+    os.remove(prefix + ".rank1")
+    os.remove(prefix + ".rank0")
+    with pytest.raises(FileNotFoundError):
+        sharded.deserialize_ivf_flat(prefix, comms)
