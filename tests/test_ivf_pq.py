@@ -407,3 +407,27 @@ def test_scan_mode_auto_is_memory_aware(data):
     ivf_pq.search(index, q[:8], 5, ivf_pq.SearchParams(n_probes=4),
                   res=roomy)
     assert index.list_decoded is not None
+
+
+@pytest.mark.slow
+def test_pq_bits5_end_to_end_both_engines(rng):
+    """The DEEP-100M build shape (pq_bits=5, pq_dim=96 → 60 packed
+    bytes/row) must build and search on both scan engines with sane
+    recall — 5-bit packing is exercised beyond the pack/unpack
+    roundtrip (deep-100M.json:252-340 is the chip pareto config)."""
+    from raft_tpu.stats import neighborhood_recall
+
+    c = (rng.standard_normal((32, 96)) * 4).astype(np.float32)
+    db = (c[rng.integers(0, 32, 20000)]
+          + rng.standard_normal((20000, 96))).astype(np.float32)
+    q = (c[rng.integers(0, 32, 100)]
+         + rng.standard_normal((100, 96))).astype(np.float32)
+    gt = np.argsort(((q[:, None, :] - db[None]) ** 2).sum(-1), 1)[:, :10]
+    idx = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=64, pq_dim=96,
+                                              pq_bits=5))
+    for mode in ("lut", "cache"):
+        _, i = ivf_pq.search(idx, q, 10,
+                             ivf_pq.SearchParams(n_probes=16,
+                                                 scan_mode=mode))
+        r = float(neighborhood_recall(np.asarray(i), gt))
+        assert r > 0.7, (mode, r)
