@@ -33,24 +33,26 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
   fi
 }
 
-# 1. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
-#    failure classes the CPU suite provably cannot see
-run_step tputests /tmp/q_tputests.done timeout 2700 \
-  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
-
-# 2. headline benchmark on chip (the BENCH_r04 dress rehearsal)
+# 1. headline benchmark on chip (the BENCH_r04 dress rehearsal) — FIRST:
+#    a short late window must land the driver-visible number before
+#    anything long runs
 run_step bench  /tmp/q_bench.done  timeout 1800 python bench.py
 
-# 3. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
+# 2. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
 #    top perf fix; feeds AUTO via the nested crossovers table)
 run_step selectk /tmp/q_selectk.done timeout 3600 \
   python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json
 
-# 4. headline again with the measured table active: if SCREEN wins, this
+# 3. headline again with the measured table active: if SCREEN wins, this
 #    is the number that should become the committed default
 run_step bench_screen /tmp/q_bench_screen.done \
   env RAFT_TPU_SELECTK_TABLE=/root/repo/SELECT_K_TABLE_tpu.json \
   timeout 1800 python bench.py
+
+# 4. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
+#    failure classes the CPU suite provably cannot see
+run_step tputests /tmp/q_tputests.done timeout 2700 \
+  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
 
 # 5. batch-1/10 latency decomposition (dispatch vs on-chip; VERDICT #6)
 run_step latency /tmp/q_latency.done timeout 2400 \
