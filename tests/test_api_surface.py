@@ -127,3 +127,17 @@ def test_comms_t_surface():
                  "shift", "device_send_recv", "device_multicast_sendrecv",
                  "comm_split", "sync", "rank", "size", "run", "shard"):
         assert hasattr(Comms, name), name
+
+
+def test_round4_surface_names():
+    """Round-4 additions stay public: SCREEN select, sharded
+    checkpoint/resume, the native hnsw-role ef-search, config scaling."""
+    from raft_tpu.bench.runner import scale_config  # noqa: F401
+    from raft_tpu.native import graph_greedy_search  # noqa: F401
+    from raft_tpu.ops.select_k import SelectAlgo
+    from raft_tpu.parallel.sharded import (  # noqa: F401
+        deserialize_ivf_flat, deserialize_ivf_pq, serialize_ivf_flat,
+        serialize_ivf_pq)
+    from raft_tpu.utils.shape import as_query_array  # noqa: F401
+
+    assert SelectAlgo.SCREEN.value == "screen"
