@@ -72,15 +72,36 @@ def search(
     k: int,
     ef: int = 64,
     space: str = "l2",
+    engine: str = "xla",
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Search the loaded base-layer graph. With hnswlib installed this would
-    delegate to it (reference behavior); here we reuse the CAGRA greedy
-    searcher over the same graph — identical algorithm family (hnswlib's
-    base-layer search IS greedy beam search with ef as itopk).
+    """Search the loaded base-layer graph.
+
+    ``engine="xla"`` (default) reuses the CAGRA greedy searcher over the
+    same graph — identical algorithm family (hnswlib's base-layer search
+    IS greedy beam search with ef as itopk), batched on the accelerator.
+    ``engine="cpu"`` runs the native C++ layer-0 ef-search
+    (``native.graph_greedy_search`` — hnswlib's searchBaseLayerST
+    algorithm exactly, entry point 0 like the exported files; l2 only) —
+    what delegating to hnswlib itself would execute, latency-oriented.
 
     ``space`` must match the space the index was exported with ('l2'|'ip') —
     the hnswlib file format does not record it (hnswlib keeps the space at
     wrapper level), same contract as hnswlib's own load."""
+    if engine == "cpu":
+        if space != "l2":
+            raise ValueError("engine='cpu' supports space='l2' only")
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        if q.shape[1] != index.dataset.shape[1]:
+            raise ValueError(f"query dim {q.shape[1]} != index dim "
+                             f"{index.dataset.shape[1]}")
+        d, i = native.graph_greedy_search(
+            np.asarray(index.dataset), np.asarray(index.graph), q, k,
+            ef=ef)
+        return d, i
+    if engine != "xla":
+        raise ValueError(f"unknown engine {engine!r}; use 'xla' or 'cpu'")
     from raft_tpu.neighbors import cagra
 
     metric = {"l2": DistanceType.L2Expanded,

@@ -221,3 +221,26 @@ def test_graph_greedy_search_disconnected_pads(rng):
     d, i = native.graph_greedy_search(db, graph, db[:1], 5, ef=8)
     assert set(i[0][:3]) == {0, 1, 2}
     assert (i[0][3:] == -1).all() and np.isinf(d[0][3:]).all()
+
+
+def test_hnsw_cpu_engine_roundtrip(tmp_path, rng):
+    """from_cagra -> load -> search(engine='cpu') runs hnswlib's own
+    layer-0 algorithm over the exported file and must agree with the
+    xla engine's recall on the same graph."""
+    from raft_tpu.neighbors import cagra, hnsw
+
+    db = rng.standard_normal((3000, 24)).astype(np.float32)
+    q = rng.standard_normal((30, 24)).astype(np.float32)
+    cg = cagra.build(db, cagra.IndexParams(graph_degree=16))
+    path = str(tmp_path / "ix.hnsw")
+    hnsw.from_cagra(cg, path)
+    ix = hnsw.load(path)
+    d_c, i_c = hnsw.search(ix, q, 5, ef=128, engine="cpu")
+    d_x, i_x = hnsw.search(ix, q, 5, ef=128, engine="xla")
+    exact = np.argsort(((q[:, None, :] - db[None]) ** 2).sum(-1), 1)[:, :5]
+    rec_c = np.mean([len(set(r) & set(g)) / 5 for r, g in zip(i_c, exact)])
+    rec_x = np.mean([len(set(r) & set(g)) / 5 for r, g in zip(i_x, exact)])
+    assert rec_c >= 0.85, rec_c
+    assert abs(rec_c - rec_x) < 0.2
+    with pytest.raises(ValueError, match="l2"):
+        hnsw.search(ix, q, 5, engine="cpu", space="ip")
