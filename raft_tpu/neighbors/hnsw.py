@@ -87,6 +87,8 @@ def search(
     ``space`` must match the space the index was exported with ('l2'|'ip') —
     the hnswlib file format does not record it (hnswlib keeps the space at
     wrapper level), same contract as hnswlib's own load."""
+    if space not in ("l2", "ip"):
+        raise ValueError(f"unknown space {space!r}; use 'l2' or 'ip'")
     if engine == "cpu":
         if space != "l2":
             raise ValueError("engine='cpu' supports space='l2' only")

@@ -52,6 +52,12 @@ def main():
     ap.add_argument("--nlist", type=int, default=1024)
     ap.add_argument("--train-rows", type=int, default=200_000)
     ap.add_argument("--nprobes", type=int, default=64)
+    ap.add_argument("--kmeans-iters", type=int, default=20)
+    ap.add_argument("--sweep", action="store_true",
+                    help="time nprobe {64,256,512,1024} plus --nprobes "
+                         "(capped at nlist) instead of the single "
+                         "--nprobes point (each point re-times the "
+                         "search; minutes per point on CPU)")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
@@ -100,7 +106,8 @@ def main():
     # ---- sharded streamed IVF-PQ build + SPMD LUT search
     comms = comms_mod.init_comms(axis="flagship")
     params = ivf_pq.IndexParams(n_lists=args.nlist,
-                                pq_dim=max(args.dim // 2, 8))
+                                pq_dim=max(args.dim // 2, 8),
+                                kmeans_n_iters=args.kmeans_iters)
     art["n_lists"] = args.nlist
     t0 = time.monotonic()
     idx = sharded.build_ivf_pq_from_file(
@@ -122,21 +129,44 @@ def main():
           f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
           flush=True)
 
+    # checkpoint the build BEFORE searching: at 10M/16k-list scale the
+    # build is hours on this host — a bad search config must not cost a
+    # rebuild (sharded.serialize_ivf_pq, the r4 persistence path)
+    ckpt = args.data + ".ckpt"
+    try:
+        sharded.serialize_ivf_pq(idx, ckpt)
+        art["checkpoint"] = ckpt
+        print(f"checkpointed -> {ckpt}.rank*", flush=True)
+    except Exception as e:  # non-fatal: the run continues
+        art["checkpoint_error"] = repr(e)[:200]
+
     # q stays a host array: the sharded search shards it over the mesh
     # itself, and a device-0-committed input would fight that placement
-    # (384 KB upload noise is negligible at this scale)
-    sp = ivf_pq.SearchParams(n_probes=args.nprobes, scan_mode="lut")
-    d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
-    _fence((d, i))
-    t0 = time.monotonic()
-    d, i = sharded.search_ivf_pq(idx, q, args.k, sp)
-    _fence((d, i))
-    dt = time.monotonic() - t0
-    art["ivf_pq_sharded_qps"] = round(args.queries / dt, 1)
-    art["ivf_pq_sharded_recall"] = round(
-        float(neighborhood_recall(np.asarray(i), gt)), 4)
-    print(f"sharded lut search qps={art['ivf_pq_sharded_qps']} "
-          f"recall={art['ivf_pq_sharded_recall']}", flush=True)
+    # (384 KB upload noise is negligible at this scale).
+    # nprobe sweep: at nlist≥16k a single point can't show the
+    # recall/QPS relationship (nprobe 64/16384 probes 0.4% of lists)
+    probes = (sorted({args.nprobes, 64, 256, 512, 1024})
+              if args.sweep else [args.nprobes])
+    # values above nlist clamp inside the search to identical configs —
+    # don't burn timed passes re-measuring the same point
+    probes = [p for p in probes if p <= args.nlist] or [args.nlist]
+    art["ivf_pq_sweep"] = []
+    for npr in probes:
+        sp = ivf_pq.SearchParams(n_probes=npr, scan_mode="lut")
+        d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
+        _fence((d, i))
+        t0 = time.monotonic()
+        d, i = sharded.search_ivf_pq(idx, q, args.k, sp)
+        _fence((d, i))
+        dt = time.monotonic() - t0
+        row = {"nprobe": npr, "qps": round(args.queries / dt, 1),
+               "recall": round(
+                   float(neighborhood_recall(np.asarray(i), gt)), 4)}
+        art["ivf_pq_sweep"].append(row)
+        print(f"sharded lut search {row}", flush=True)
+    best = max(art["ivf_pq_sweep"], key=lambda r: r["recall"])
+    art["ivf_pq_sharded_qps"] = best["qps"]
+    art["ivf_pq_sharded_recall"] = best["recall"]
 
     # ---- CAGRA build at 1M (device-resident ivf_pq graph path)
     if not args.skip_cagra:
