@@ -40,8 +40,8 @@ run_step bench  /tmp/q_bench.done  timeout 1800 python bench.py
 
 # 2. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
 #    top perf fix; feeds AUTO via the nested crossovers table)
-run_step selectk /tmp/q_selectk.done timeout 3600 \
-  python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json
+run_step selectk /tmp/q_selectk.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 3600 python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json
 
 # 3. headline again with the measured table active: if SCREEN wins, this
 #    is the number that should become the committed default
