@@ -72,36 +72,43 @@ _BUILTIN_TABLES = {
 _auto_table_cache: Optional[dict] = None
 
 
+def _scan_artifacts(tables: dict, prefix: str, env_var: str, extract):
+    """Fill ``tables`` (platform -> table) from measured artifacts:
+    ``<prefix>_*.json`` at the repo root (anchored via __file__, so the
+    choice can't depend on launch directory) and in cwd — these self-arm
+    with no env plumbing (the benchmark queue drops them during a
+    hardware window; the driver's bench.py run then picks the measured
+    behavior). Malformed ambient artifacts are skipped; the ``env_var``
+    override is loaded LAST and OUTSIDE the try (explicit requests fail
+    loudly and win over ambient artifacts)."""
+    import glob
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    paths = sorted(
+        set(glob.glob(os.path.join(repo_root, prefix + "_*.json")))
+        | set(glob.glob(prefix + "_*.json")))
+    for path in paths:
+        try:
+            with open(path) as f:
+                art = json.load(f)
+            tables[art["platform"]] = extract(art)
+        except (OSError, KeyError, ValueError, TypeError):
+            pass  # malformed artifact: keep what we have
+    path = os.environ.get(env_var)
+    if path:
+        with open(path) as f:
+            art = json.load(f)
+        tables[art["platform"]] = extract(art)
+    return tables
+
+
 def _load_auto_table() -> dict:
     global _auto_table_cache
     if _auto_table_cache is None:
-        tables = dict(_BUILTIN_TABLES)
-        # measured artifacts self-arm AUTO (the benchmark queue drops
-        # SELECT_K_TABLE_tpu.json at the repo root during a hardware
-        # window; the driver's bench.py run then picks the measured
-        # algorithm with no env plumbing). Looked up in the repo root
-        # (anchored via __file__, so the choice can't depend on launch
-        # directory) and in cwd (explicit artifact-next-to-run flows).
-        import glob
-
-        repo_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        paths = sorted(
-            set(glob.glob(os.path.join(repo_root, "SELECT_K_TABLE_*.json")))
-            | set(glob.glob("SELECT_K_TABLE_*.json")))
-        for path in paths:
-            try:
-                with open(path) as f:
-                    art = json.load(f)
-                tables[art["platform"]] = art["crossovers"]
-            except (OSError, KeyError, ValueError, TypeError):
-                pass  # malformed artifact: keep builtins
-        path = os.environ.get("RAFT_TPU_SELECTK_TABLE")
-        if path:  # explicit request wins over cwd artifacts
-            with open(path) as f:
-                art = json.load(f)
-            tables[art["platform"]] = art["crossovers"]
-        _auto_table_cache = tables
+        _auto_table_cache = _scan_artifacts(
+            dict(_BUILTIN_TABLES), "SELECT_K_TABLE",
+            "RAFT_TPU_SELECTK_TABLE", lambda art: art["crossovers"])
     return _auto_table_cache
 
 
@@ -127,6 +134,56 @@ def _band(table: dict, k: int):
     return None
 
 
+# ------------------------------------------------------------- k-pad rules
+#
+# XLA:TPU's top_k lowering has pointwise-pathological (n, k) cells: both
+# the r3 and r4 hardware sweeps measured (n=4096, k=10) at 112-120 ms for
+# batch 2048 while k=32 at the SAME width runs in 1.7-2.3 ms and k=10 on
+# wider rows in 1-3 ms. top_k(x, k')[..., :k] is exact for any k' >= k
+# (the output is descending-sorted, ties broken by lower index, and the
+# prefix of a larger selection is the smaller selection), so the fix is a
+# trace-time rewrite of the REQUESTED k. Which cells win is measured by
+# tools/topk_k_probe.py (2x bar) into TOPK_PAD_<platform>.json; rules are
+# matched by exact k and nearby width (x1.5 — pointwise pathologies don't
+# extrapolate, cf. the reference picking select algorithms per shape,
+# detail/select_k-inl.cuh:48).
+_pad_rules_cache: Optional[dict] = None
+
+
+def _load_pad_rules() -> dict:
+    global _pad_rules_cache
+    if _pad_rules_cache is None:
+        _pad_rules_cache = _scan_artifacts(
+            {}, "TOPK_PAD", "RAFT_TPU_TOPK_PAD",
+            lambda art: list(art["pad_rules"]))
+    return _pad_rules_cache
+
+
+def set_pad_rules(platform: str, rules: Optional[list]) -> None:
+    """Install (or with None, drop) measured k-pad rules for a platform:
+    ``[{"n": width, "k": requested_k, "k_pad": padded_k}, ...]``."""
+    tables = _load_pad_rules()
+    if rules is None:
+        tables.pop(platform, None)
+    else:
+        tables[platform] = [dict(r) for r in rules]
+
+
+def _pad_k(n: int, k: int) -> int:
+    """The k top_k should actually be asked for at row width ``n``: the
+    measured pad rule with matching k and width within x1.5 (nearest by
+    width ratio), else k unchanged."""
+    rules = _load_pad_rules().get(jax.default_backend(), [])
+    best = None
+    for r in rules:
+        if r["k"] != k:
+            continue
+        ratio = max(n, r["n"]) / max(1, min(n, r["n"]))
+        if ratio <= 1.5 and (best is None or ratio < best[0]):
+            best = (ratio, r["k_pad"])
+    return min(n, best[1]) if best else k
+
+
 def _resolve_auto(n: int, k: int, floating: bool = True) -> "SelectAlgo":
     tables = _load_auto_table()
     platform = jax.default_backend()
@@ -148,9 +205,16 @@ def _resolve_auto(n: int, k: int, floating: bool = True) -> "SelectAlgo":
     return SelectAlgo.TWO_PHASE
 
 
-def _direct(values: jax.Array, k: int, select_min: bool):
+def _direct(values: jax.Array, k: int, select_min: bool, k_pad: int = 0):
+    # k_pad is resolved OUTSIDE the jit boundary (select_k()) so it is
+    # part of the compile key — installing/dropping pad rules retraces
+    # instead of silently reusing a stale cached decision (the same
+    # pre-jit-resolution rule AUTO follows).
+    k_eff = min(values.shape[-1], max(k, k_pad))
     v = -values if select_min else values
-    top_v, top_i = jax.lax.top_k(v, k)
+    top_v, top_i = jax.lax.top_k(v, k_eff)
+    if k_eff != k:  # exact: the prefix of a larger selection
+        top_v, top_i = top_v[..., :k], top_i[..., :k]
     return (-top_v if select_min else top_v), top_i
 
 
@@ -168,7 +232,7 @@ def _approx(values: jax.Array, k: int, select_min: bool,
     return fn(values, k, recall_target=recall_target)
 
 
-def _screen(values: jax.Array, k: int, select_min: bool):
+def _screen(values: jax.Array, k: int, select_min: bool, k_pad: int = 0):
     """Exact selection via a certified threshold + exhaustive extraction —
     the TPU answer to the reference's one-pass radix select
     (detail/select_radix.cuh:54-67). lax.top_k on TPU runs at a few GB/s
@@ -195,7 +259,7 @@ def _screen(values: jax.Array, k: int, select_min: bool):
     event on real distance data.
     """
     if not select_min:
-        v, i = _screen(-values, k, True)
+        v, i = _screen(-values, k, True, k_pad)
         return -v, i
     x = values
     batch, n = x.shape
@@ -230,7 +294,7 @@ def _screen(values: jax.Array, k: int, select_min: bool):
         return sv[:, :k], si[:, :k]
 
     return jax.lax.cond(jnp.all(c <= m_buf), extract,
-                        lambda _: _direct(x, k, True), operand=None)
+                        lambda _: _direct(x, k, True, k_pad), operand=None)
 
 
 def _two_phase(values: jax.Array, k: int, select_min: bool):
@@ -253,9 +317,9 @@ def _two_phase(values: jax.Array, k: int, select_min: bool):
     return (-mv if select_min else mv), out_i
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("k", "select_min", "algo", "recall"))
-def _select_k_jit(values, k, select_min, algo, recall=0.95):
+@functools.partial(jax.jit, static_argnames=(
+    "k", "select_min", "algo", "recall", "k_pad"))
+def _select_k_jit(values, k, select_min, algo, recall=0.95, k_pad=0):
     assert algo != SelectAlgo.AUTO  # resolved in select_k(), pre-cache
     if algo == SelectAlgo.PALLAS:
         from raft_tpu.ops.pallas_kernels import pallas_select_k
@@ -269,10 +333,10 @@ def _select_k_jit(values, k, select_min, algo, recall=0.95):
     if algo == SelectAlgo.SCREEN:
         # int rows can't ride approx_min_k / inf-padding; they take DIRECT
         if jnp.issubdtype(values.dtype, jnp.floating):
-            return _screen(values, k, select_min)
-        return _direct(values, k, select_min)
+            return _screen(values, k, select_min, k_pad)
+        return _direct(values, k, select_min, k_pad)
     if algo == SelectAlgo.DIRECT:
-        return _direct(values, k, select_min)
+        return _direct(values, k, select_min, k_pad)
     return _two_phase(values, k, select_min)
 
 
@@ -316,8 +380,12 @@ def select_k(
         # rounds, wrong for the IVF k=64-256 band.)
         algo = _resolve_auto(values.shape[-1], int(k),
                              jnp.issubdtype(values.dtype, jnp.floating))
+    # pad rules resolve pre-jit too: the padded k is part of the compile
+    # key, so installing/dropping TOPK_PAD rules retraces fresh calls
+    k_pad = _pad_k(values.shape[-1], int(k)) if algo in (
+        SelectAlgo.DIRECT, SelectAlgo.SCREEN) else 0
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo,
-                                 float(recall_target))
+                                 float(recall_target), k_pad)
     if indices is not None:
         # preserve -1 null markers (PALLAS exhausted-row convention) —
         # take_along_axis would wrap -1 to the last column's real id

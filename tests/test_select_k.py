@@ -226,3 +226,55 @@ def test_auto_nested_screen_table():
         assert sk._resolve_auto(4096, 10) == sk.SelectAlgo.DIRECT
     finally:
         sk.set_auto_table("cpu", {"inf": sk._NEVER})
+
+
+def test_topk_pad_rules():
+    """Measured k-pad rules rewrite DIRECT's requested k at trace time
+    (exact: the prefix of a larger selection IS the smaller selection,
+    ties included); rules match exact k within a x1.5 width window."""
+    import importlib
+
+    import jax
+
+    sk = importlib.import_module("raft_tpu.ops.select_k")
+    plat = jax.default_backend()
+    sk.set_pad_rules(plat, [{"n": 4096, "k": 10, "k_pad": 32}])
+    try:
+        assert sk._pad_k(4096, 10) == 32
+        assert sk._pad_k(5000, 10) == 32      # within x1.5
+        assert sk._pad_k(4096, 11) == 11      # k must match exactly
+        assert sk._pad_k(16384, 10) == 10     # outside the window
+        # nearest-width rule wins; k_pad clamps to the row width
+        sk.set_pad_rules(plat, [{"n": 4096, "k": 10, "k_pad": 32},
+                                {"n": 6144, "k": 10, "k_pad": 16},
+                                {"n": 64, "k": 10, "k_pad": 4096}])
+        assert sk._pad_k(5800, 10) == 16
+        assert sk._pad_k(64, 10) == 64
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((8, 4100)).astype(np.float32)
+        x[:, 50:60] = x[:, 40:50]  # duplicate values: tie behavior
+        # the wiring, not just _pad_k: record the k DIRECT actually asks
+        # lax.top_k for while tracing (k_pad is in the jit key, so this
+        # trace is fresh even if (8, 4100) ran unpadded before)
+        sk.set_pad_rules(plat, [{"n": 4096, "k": 10, "k_pad": 32}])
+        asked = []
+        real_top_k = jax.lax.top_k
+
+        def recording_top_k(operand, kk):
+            asked.append(kk)
+            return real_top_k(operand, kk)
+
+        jax.lax.top_k = recording_top_k
+        try:
+            v, i = select_k(x, 10, algo=SelectAlgo.DIRECT)
+        finally:
+            jax.lax.top_k = real_top_k
+        assert 32 in asked, f"pad rule not applied (asked: {asked})"
+        ref = np.argsort(x, 1, kind="stable")[:, :10]
+        np.testing.assert_array_equal(np.asarray(i), ref)
+        np.testing.assert_array_equal(
+            np.asarray(v), np.take_along_axis(x, ref, 1))
+    finally:
+        sk.set_pad_rules(plat, None)
+    assert sk._pad_k(4096, 10) == 10

@@ -59,6 +59,20 @@ def main():
     if args.pallas:
         algos.append(SelectAlgo.PALLAS)
 
+    def write(partial, **extra):
+        """Write the artifact after every row: a timeout kill mid-sweep
+        keeps the completed rows (~4 min of compiles each on the tunnel).
+        ``crossovers`` (in ``extra``) is only present once the grid is
+        COMPLETE — AUTO self-arms from artifacts at the repo root, and
+        sticky_crossover over a width-truncated grid could claim wins
+        the missing wider rows would refute."""
+        art = {"platform": platform, "batch": args.batch, "grid": grid,
+               "when": time.strftime("%Y-%m-%dT%H:%M:%S%z"), **extra}
+        if partial:
+            art["partial"] = True
+        with open(args.out + (".partial" if partial else ""), "w") as f:
+            json.dump(art, f, indent=1)
+
     for n in args.widths:
         x = jax.numpy.asarray(
             rng.standard_normal((args.batch, n)).astype(np.float32))
@@ -74,6 +88,7 @@ def main():
                 row[algo.value + "_ms"] = round(dt * 1e3, 3)
             grid.append(row)
             print(row, flush=True)
+            write(partial=True)
 
     def sticky_crossover(col):
         """Per-k smallest width where ``col`` beats DIRECT and keeps
@@ -121,12 +136,10 @@ def main():
     if screen_bands:
         bands = {"two_phase": tp_bands, "screen": screen_bands}
 
-    art = {"platform": platform, "batch": args.batch, "grid": grid,
-           "crossover_by_k": crossover_by_k,
-           "screen_crossover_by_k": screen_by_k, "crossovers": bands,
-           "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
-    with open(args.out, "w") as f:
-        json.dump(art, f, indent=1)
+    write(partial=False, crossover_by_k=crossover_by_k,
+          screen_crossover_by_k=screen_by_k, crossovers=bands)
+    if os.path.exists(args.out + ".partial"):
+        os.remove(args.out + ".partial")
     print(f"-> {args.out}\ncrossovers: {bands}")
 
 
