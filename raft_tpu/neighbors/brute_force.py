@@ -40,7 +40,7 @@ from raft_tpu.ops.distance import (
 )
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
-from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
+from raft_tpu.utils.shape import (as_query_array, balanced_tile, cdiv, pad_rows,
                                   query_bucket)
 
 
@@ -85,9 +85,7 @@ def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
     q_tile = min(n_queries, 1024)
     db_budget = max(budget // (4 * max(q_tile, 1) * 4), 1)  # fp32 + headroom
     db_tile = min(n_db, max(db_budget, 4 * k, 1024))
-    if db_tile >= 128:
-        db_tile -= db_tile % 128
-    return q_tile, db_tile
+    return q_tile, balanced_tile(n_db, db_tile, 128)
 
 
 #: metrics eligible for the bf16 fast-scan (their scan is one MXU matmul and

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.resources import Resources, ensure_resources
-from raft_tpu.utils.shape import cdiv
+from raft_tpu.utils.shape import balanced_tile, cdiv
 
 
 class DistanceType(enum.IntEnum):
@@ -294,10 +294,7 @@ def _choose_tile_rows(m: int, n: int, k: int, budget_bytes: int) -> int:
     per_row = max(n * k * 4, 1)
     tile = max(1, budget_bytes // (4 * per_row))  # 4x headroom for fusion temps
     tile = min(tile, m, 4096)
-    # Round down to a multiple of 8 (fp32 sublane) when we can afford it.
-    if tile >= 8:
-        tile -= tile % 8
-    return max(tile, 1)
+    return balanced_tile(m, tile, 8)
 
 
 def _pairwise_tiled(x: jax.Array, y: jax.Array, elem_fn, tile_rows: int) -> jax.Array:

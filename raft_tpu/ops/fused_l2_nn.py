@@ -23,15 +23,13 @@ import jax.numpy as jnp
 
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import l2_expanded, row_norms_sq
-from raft_tpu.utils.shape import cdiv
+from raft_tpu.utils.shape import balanced_tile, cdiv
 
 
 def choose_tile_rows(m: int, n: int, budget_bytes: int) -> int:
     tile = max(1, budget_bytes // (8 * max(n, 1) * 4))
     tile = min(tile, m, 65536)
-    if tile >= 128:
-        tile -= tile % 128
-    return max(tile, 1)
+    return balanced_tile(m, tile, 128)
 
 
 @functools.partial(jax.jit, static_argnames=("sqrt", "tile"))

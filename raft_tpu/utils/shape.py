@@ -19,6 +19,28 @@ def round_up_to(n: int, multiple: int) -> int:
     return cdiv(n, multiple) * multiple
 
 
+def balanced_tile(total: int, tile: int, multiple: int) -> int:
+    """Balance a 1-d tile grid: split ``total`` evenly over the tile count
+    a budget-derived ``tile`` implies, aligned up to ``multiple`` when that
+    stays within the budget.
+
+    Rounding a budget tile DOWN to the alignment multiple (the old
+    pattern) turned total=10000 / tile=10000 into 9984 -> TWO tiles, the
+    second 99.8% padding — double the scan work on the headline shape.
+    Invariants: result <= max(tile, 1) (a [tile, ...] workspace budget is
+    never exceeded — alignment yields to budget when tile < multiple),
+    result * cdiv(total, result) - total < multiple * n_tiles (bounded
+    padding), and total == 0 degrades to 1 (callers produce empty
+    outputs, not a ZeroDivisionError)."""
+    tile = max(tile, 1)
+    if total <= tile:
+        return max(total, 1)
+    n_tiles = cdiv(total, tile)
+    balanced = cdiv(total, n_tiles)
+    aligned = round_up_to(balanced, multiple)
+    return aligned if aligned <= tile else balanced
+
+
 def pad_rows(x, target_rows: int, fill=0):
     """Pad a [n, ...] array to [target_rows, ...]. Host arrays pad on the
     host (numpy) so serving wrappers don't pay an eager device dispatch

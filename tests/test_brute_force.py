@@ -175,3 +175,19 @@ def test_batch_k_query_iterator(rng):
     # exhausting the iterator covers the whole dataset exactly once
     total = 21 + sum(i.shape[1] for _, i in it)
     assert total == 500
+
+
+def test_choose_tiles_balanced():
+    """The tile grid splits the db evenly: rounding down to the lane
+    multiple used to give n_db=10000 a second, 99.8%-padding tile
+    (2x scan work on the headline shape)."""
+    from raft_tpu.neighbors.brute_force import _choose_tiles
+    from raft_tpu.utils.shape import cdiv
+
+    for n_db in (999, 10_000, 131_073, 200_000, 1_000_000):
+        _, db_tile = _choose_tiles(10_000, n_db, 128, 10, 2 << 30)
+        n_tiles = cdiv(n_db, db_tile)
+        assert n_tiles * db_tile - n_db < 128 * n_tiles + 8, \
+            (n_db, db_tile, n_tiles)
+        if n_tiles > 1:
+            assert db_tile % 128 == 0

@@ -225,3 +225,23 @@ def test_device_ndarray_torch_interop():
     a = common.device_ndarray(t)
     assert a.shape == (3, 4)
     np.testing.assert_array_equal(common.to_host(a), t.numpy())
+
+
+def test_balanced_tile():
+    """Tile-grid balancing: even splits, bounded padding, budget never
+    exceeded, empty input degrades to 1 (shape.balanced_tile)."""
+    from raft_tpu.utils.shape import balanced_tile, cdiv
+
+    assert balanced_tile(10_000, 10_000, 128) == 10_000  # single tile
+    assert balanced_tile(0, 4096, 128) == 1
+    assert balanced_tile(5, 3, 8) == 3  # alignment yields to budget
+    # budget tile below the multiple never inflates (workspace invariant)
+    assert balanced_tile(1_000_000, 33, 128) <= 33
+    assert balanced_tile(1_000_000, 1, 8) == 1
+    for total, tile, mult in [(200_000, 131_072, 128), (10_000, 4_096, 8),
+                              (131_073, 65_536, 128), (999, 1024, 128),
+                              (1_000_000, 131_072, 128)]:
+        t = balanced_tile(total, tile, mult)
+        assert 1 <= t <= max(tile, 1)
+        n_tiles = cdiv(total, t)
+        assert n_tiles * t - total < mult * n_tiles + mult, (total, tile, t)

@@ -223,3 +223,33 @@ def test_cagra_recall_on_chip(clustered, gt):
         cagra.SearchParams(itopk_size=64, num_random_samplings=4))
     r = recall(ids, gt)
     assert r >= 0.90, f"cagra recall {r:.4f}"
+
+
+def test_topk_pad_exact_on_chip(rng):
+    """k-pad rules (TOPK_PAD_tpu.json / set_pad_rules) rewrite DIRECT's
+    requested k on the real top_k lowering; the padded prefix must equal
+    the unpadded selection bit-for-bit, at the measured pathological cell
+    (n=4096, k=10: 112-120 ms unpadded vs ~2 ms at k=32 on v5e)."""
+    import importlib
+
+    import jax
+
+    sk = importlib.import_module("raft_tpu.ops.select_k")
+    from raft_tpu.ops.select_k import SelectAlgo, select_k
+
+    x = rng.standard_normal((512, 4096)).astype(np.float32)
+    plat = jax.default_backend()
+    prev = sk._load_pad_rules().get(plat)
+    # baseline must be UNPADDED even when the queue already dropped a
+    # TOPK_PAD artifact at the repo root (else this compares padded to
+    # padded and proves nothing)
+    sk.set_pad_rules(plat, None)
+    v0, i0 = select_k(x, 10, algo=SelectAlgo.DIRECT)
+    v0, i0 = np.asarray(v0), np.asarray(i0)
+    sk.set_pad_rules(plat, [{"n": 4096, "k": 10, "k_pad": 32}])
+    try:
+        v1, i1 = select_k(x, 10, algo=SelectAlgo.DIRECT)
+        np.testing.assert_array_equal(np.asarray(v1), v0)
+        np.testing.assert_array_equal(np.asarray(i1), i0)
+    finally:
+        sk.set_pad_rules(plat, prev)
