@@ -38,7 +38,20 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
 #    anything long runs
 run_step bench  /tmp/q_bench.done  timeout 1800 python bench.py
 
-# 2. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
+# 2. pointwise top_k (n, k) map -> k-pad rules (the (4096, k=10) 50x
+# pathology reproduced in r3+r4; exact fix is top_k(k')[:k], consumed by
+# select_k._direct via TOPK_PAD_tpu.json at the repo root). BEFORE the
+# long selectk sweep: the last window was 21 minutes, and this ~25-min
+# incremental probe directly feeds the headline's select cost.
+run_step kprobe /tmp/q_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 3600 python tools/topk_k_probe.py
+
+# 3. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
+#    failure classes the CPU suite provably cannot see
+run_step tputests /tmp/q_tputests.done timeout 2700 \
+  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
+
+# 4. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
 #    top perf fix; feeds AUTO via the nested crossovers table)
 # (IVF-critical widths first: the artifact now writes incrementally, so
 # a timeout kill keeps the rows that matter; measured ~4 min/row over
@@ -47,24 +60,11 @@ run_step selectk /tmp/q_selectk.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 10800 python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json \
   --widths 16384 32768 4096 65536 131072 262144
 
-# 3. headline again with the measured table active: if SCREEN wins, this
+# 4b. headline again with the measured table active: if SCREEN wins, this
 #    is the number that should become the committed default
 run_step bench_screen /tmp/q_bench_screen.done \
   env RAFT_TPU_SELECTK_TABLE=/root/repo/SELECT_K_TABLE_tpu.json \
   timeout 1800 python bench.py
-
-# 3b. pointwise top_k (n, k) map -> k-pad rules (the (4096, k=10) 50x
-# pathology reproduced in r3+r4; exact fix is top_k(k')[:k], consumed
-# by select_k._direct via TOPK_PAD_tpu.json at the repo root). After
-# bench_screen: the headline-with-measured-table lands first in a
-# short window (the probe writes incrementally, so a kill keeps rows).
-run_step kprobe /tmp/q_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
-  timeout 3600 python tools/topk_k_probe.py
-
-# 4. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
-#    failure classes the CPU suite provably cannot see
-run_step tputests /tmp/q_tputests.done timeout 2700 \
-  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
 
 # 5. batch-1/10 latency decomposition (dispatch vs on-chip; VERDICT #6)
 run_step latency /tmp/q_latency.done timeout 2400 \
