@@ -149,12 +149,22 @@ def _band(table: dict, k: int):
 # detail/select_k-inl.cuh:48).
 _pad_rules_cache: Optional[dict] = None
 
+# The one cell measured pathological in BOTH hardware sessions (r3:
+# 112.4 ms, r4: 119.7 ms for batch 2048 — vs 1.7-2.3 ms at k=32, same
+# width, same sessions). Shipped as a builtin so the fix holds even
+# when no TOPK_PAD artifact has been produced; a measured artifact for
+# the platform replaces this entirely (artifact wins in _scan_artifacts).
+_BUILTIN_PAD_RULES = {
+    "tpu": [{"n": 4096, "k": 10, "k_pad": 32}],
+}
+
 
 def _load_pad_rules() -> dict:
     global _pad_rules_cache
     if _pad_rules_cache is None:
         _pad_rules_cache = _scan_artifacts(
-            {}, "TOPK_PAD", "RAFT_TPU_TOPK_PAD",
+            {k: list(v) for k, v in _BUILTIN_PAD_RULES.items()},
+            "TOPK_PAD", "RAFT_TPU_TOPK_PAD",
             lambda art: list(art["pad_rules"]))
     return _pad_rules_cache
 
