@@ -120,15 +120,18 @@ def main():
     # exact default (SCREEN vs DIRECT) in this run
     if chosen.get("select_recall", 1.0) < 1.0:
         sel_algo = "approx"
+        k_pad = 0
     else:
         from raft_tpu.neighbors.brute_force import _choose_tiles
-        from raft_tpu.ops.select_k import _resolve_auto
+        from raft_tpu.ops.select_k import _pad_k, _resolve_auto
         from raft_tpu.core.resources import ensure_resources
 
         _, db_tile = _choose_tiles(
             n_q, n_db, dim, k,
             ensure_resources(None).workspace_limit_bytes)
         sel_algo = _resolve_auto(db_tile, k).value
+        # whether a measured TOPK_PAD rule rewrote the requested k
+        k_pad = _pad_k(db_tile, k) if sel_algo in ("direct", "screen") else 0
 
     row = {
         "metric": "brute_force_knn_qps_sift10k_k10",
@@ -140,6 +143,8 @@ def main():
         "select_algo": sel_algo,
         "platform": platform,
     }
+    if k_pad and k_pad != k:
+        row["select_k_pad"] = k_pad
 
     # skip the (minutes-long on CPU) extras in the degraded-fallback case —
     # the driver must still get its line well inside any timeout
