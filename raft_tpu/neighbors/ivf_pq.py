@@ -52,7 +52,7 @@ from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
 from raft_tpu.ops.select_k import select_k_maybe_approx
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
-from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
+from raft_tpu.utils.shape import (as_query_array, balanced_tile, cdiv, pad_rows,
                                   query_bucket)
 
 
@@ -436,7 +436,9 @@ def ensure_scan_cache(index: Index, dtype=jnp.bfloat16) -> None:
             and index.list_decoded.dtype == jnp.dtype(dtype)):
         return
     per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
-    list_tile = min(index.n_lists, 128)
+    # balanced grid: n_lists=130 with a flat 128 cap would pay a second,
+    # 98%-padding tile (cf. shape.balanced_tile)
+    list_tile = balanced_tile(index.n_lists, min(index.n_lists, 128), 8)
     # pad list count so tiles divide evenly inside the jit
     index.list_decoded, index.decoded_norms = _decode_lists_jit(
         index.codebooks, index.list_codes, index.pq_dim, index.pq_bits,
@@ -659,7 +661,7 @@ def encode_batch(index: Index, vectors, labels,
     row_tile = int(np.clip(
         res.workspace_limit_bytes //
         max(index.pq_dim * index.pq_book_size * 4 * 4, 1), 8, 4096))
-    row_tile -= row_tile % 8
+    row_tile = balanced_tile(len(vectors), row_tile, 8)
     codes = _encode_jit(jnp.asarray(vectors, jnp.float32),
                         jnp.asarray(labels), index.centers, index.rotation,
                         index.codebooks, per_cluster, max(row_tile, 8))
