@@ -35,8 +35,10 @@ def main():
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--widths", type=int, nargs="*",
                     default=[1024, 2048, 4096, 6144, 8192, 16384, 32768])
+    # 40 = refine_mult(4) x k(10): the IVF fast-scan merge width's k —
+    # rules match k EXACTLY, so the probe must measure the ks searches use
     ap.add_argument("--ks", type=int, nargs="*",
-                    default=[4, 8, 10, 12, 16, 24, 32, 48, 64])
+                    default=[4, 8, 10, 12, 16, 24, 32, 40, 48, 64])
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
