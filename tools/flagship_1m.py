@@ -82,6 +82,14 @@ def main():
     print(f"platform={art['platform']} devices={art['n_devices']}",
           flush=True)
 
+    def save(partial=True):
+        """Incremental artifact write (atomic): a multi-hour build killed
+        at round end must still leave its phase timings + partial sweep."""
+        art["partial"] = partial
+        with open(args.out + ".tmp", "w") as f:
+            json.dump(art, f, indent=1)
+        os.replace(args.out + ".tmp", args.out)
+
     # ---- dataset on disk (chunked write keeps host RAM at one chunk)
     rng = np.random.default_rng(0)
     t0 = time.monotonic()
@@ -102,6 +110,7 @@ def main():
     gt = np.asarray(gt)
     art["oracle_s"] = round(time.monotonic() - t0, 1)
     print(f"oracle {art['oracle_s']}s", flush=True)
+    save()
 
     # ---- sharded streamed IVF-PQ build + SPMD LUT search
     comms = comms_mod.init_comms(axis="flagship")
@@ -128,6 +137,7 @@ def main():
           f"pad={art['ivf_pq_list_pad']} overflow={n_over} "
           f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
           flush=True)
+    save()
 
     # checkpoint the build BEFORE searching: at 10M/16k-list scale the
     # build is hours on this host — a bad search config must not cost a
@@ -163,6 +173,7 @@ def main():
                "recall": round(
                    float(neighborhood_recall(np.asarray(i), gt)), 4)}
         art["ivf_pq_sweep"].append(row)
+        save()
         print(f"sharded lut search {row}", flush=True)
     best = max(art["ivf_pq_sweep"], key=lambda r: r["recall"])
     art["ivf_pq_sharded_qps"] = best["qps"]
@@ -193,8 +204,7 @@ def main():
               flush=True)
 
     art["peak_rss_gb"] = rss_gb()
-    with open(args.out, "w") as f:
-        json.dump(art, f, indent=1)
+    save(partial=False)
     print(f"-> {args.out}", flush=True)
 
 
