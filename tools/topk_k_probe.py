@@ -55,14 +55,23 @@ def main():
     # a complete artifact down to one width. Re-measured widths replace
     # their old rows.
     grid = []
+    done_widths = set()
     try:
         with open(out) as f:
             prev = json.load(f)
         if prev.get("platform") == platform:
-            grid = [r for r in prev.get("grid", [])
-                    if r.get("n") not in set(args.widths)]
+            for r in prev.get("grid", []):
+                wanted = {str(k) for k in args.ks if k * 4 <= r.get("n", 0)}
+                if r.get("n") not in set(args.widths):
+                    grid.append(r)  # width not requested: keep as-is
+                elif wanted <= set(r.get("ms", {})):
+                    # resume: this width already has every requested k —
+                    # don't re-pay its ~per-k compile minutes on the tunnel
+                    grid.append(r)
+                    done_widths.add(r["n"])
             if grid:
-                print(f"seeded {len(grid)} rows from existing {out}")
+                print(f"seeded {len(grid)} rows from existing {out} "
+                      f"(resume skips widths {sorted(done_widths)})")
     except (OSError, ValueError, KeyError, TypeError):
         pass
 
@@ -103,6 +112,8 @@ def main():
         return art
 
     for n in args.widths:
+        if n in done_widths:
+            continue
         x = jax.numpy.asarray(
             rng.standard_normal((args.batch, n)).astype(np.float32))
         row = {"n": n, "ms": {}}
