@@ -82,7 +82,7 @@ def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
                   ) -> Tuple[int, int]:
     """Pick (query_tile, db_tile) so the distance tile fits the workspace
     budget (analog of chooseTileSize, detail/knn_brute_force.cuh:84)."""
-    q_tile = min(n_queries, 1024)
+    q_tile = balanced_tile(n_queries, min(n_queries, 1024), 8)
     db_budget = max(budget // (4 * max(q_tile, 1) * 4), 1)  # fp32 + headroom
     db_tile = min(n_db, max(db_budget, 4 * k, 1024))
     return q_tile, balanced_tile(n_db, db_tile, 128)
