@@ -101,8 +101,10 @@ def _fused_l2_argmin_pallas(x, y, x_norms, y_norms, tm: int, tn: int,
 
 def pallas_enabled() -> bool:
     """Opt-in gate for the Pallas paths (RAFT_TPU_PALLAS=1 on TPU)."""
+    # the axon tunnel registers its backend name as "axon" while the
+    # devices report platform "tpu"; accept both (cf. select_k._platform_key)
     return (os.environ.get("RAFT_TPU_PALLAS") == "1"
-            and jax.default_backend() == "tpu")
+            and jax.default_backend() in ("tpu", "axon"))
 
 
 def fused_l2_argmin(x, y, x_norms=None, y_norms=None, tm: int = 256,

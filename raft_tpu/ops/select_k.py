@@ -124,6 +124,14 @@ def set_auto_table(platform: str, crossovers: Optional[dict]) -> None:
     _auto_table_cache = tables
 
 
+def _platform_key() -> str:
+    """Key for the measured tables. The axon tunnel registers its backend
+    under the name "axon" while the devices report platform "tpu"; both
+    must hit the "tpu" tables — a mismatch would silently arm nothing."""
+    p = jax.default_backend()
+    return "tpu" if p in ("tpu", "axon") else p
+
+
 def _band(table: dict, k: int):
     """Width threshold of the smallest k-band covering ``k`` (None: never)."""
     for k_max, width in sorted(
@@ -183,7 +191,7 @@ def _pad_k(n: int, k: int) -> int:
     """The k top_k should actually be asked for at row width ``n``: the
     measured pad rule with matching k and width within x1.5 (nearest by
     width ratio), else k unchanged."""
-    rules = _load_pad_rules().get(jax.default_backend(), [])
+    rules = _load_pad_rules().get(_platform_key(), [])
     best = None
     for r in rules:
         if r["k"] != k:
@@ -196,8 +204,7 @@ def _pad_k(n: int, k: int) -> int:
 
 def _resolve_auto(n: int, k: int, floating: bool = True) -> "SelectAlgo":
     tables = _load_auto_table()
-    platform = jax.default_backend()
-    table = tables.get(platform, tables["default"])
+    table = tables.get(_platform_key(), tables["default"])
     # nested form: {"two_phase": {k-bands}, "screen": {k-bands}};
     # flat {k-bands} = two_phase-only (pre-r4 artifacts)
     nested = "screen" in table or "two_phase" in table
@@ -337,7 +344,7 @@ def _select_k_jit(values, k, select_min, algo, recall=0.95, k_pad=0):
         # an explicit algo request is the opt-in: hardware path on TPU,
         # Mosaic interpreter elsewhere (CPU CI)
         return pallas_select_k(values, k, select_min,
-                               interpret=jax.default_backend() != "tpu")
+                               interpret=_platform_key() != "tpu")
     if algo == SelectAlgo.APPROX:
         return _approx(values, k, select_min, recall)
     if algo == SelectAlgo.SCREEN:

@@ -278,3 +278,21 @@ def test_topk_pad_rules():
     finally:
         sk.set_pad_rules(plat, None)
     assert sk._pad_k(4096, 10) == 10
+
+
+def test_platform_key_axon_maps_to_tpu(monkeypatch):
+    """The axon tunnel registers backend name "axon" while devices report
+    platform "tpu" — table lookups must treat them as one platform, else
+    every measured tpu table silently fails to arm on chip."""
+    import importlib
+
+    import jax
+
+    sk = importlib.import_module("raft_tpu.ops.select_k")
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    assert sk._platform_key() == "tpu"
+    # builtin tpu pad rule fires under the axon backend name
+    assert sk._pad_k(4096, 10) == 32
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert sk._platform_key() == "cpu"
+    assert sk._pad_k(4096, 10) == 10
