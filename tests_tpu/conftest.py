@@ -38,7 +38,10 @@ def pytest_collection_modifyitems(config, items):
         backend = jax.default_backend()  # initializes; may raise/hang on
     except RuntimeError:                 # a dead tunnel
         backend = "unavailable"
-    if backend != "tpu":
+    # the axon tunnel registers its backend name as "axon" while devices
+    # report platform "tpu" — both ARE the chip; skipping on the name
+    # would silently no-op this whole tier during a hardware window
+    if backend not in ("tpu", "axon"):
         skip = pytest.mark.skip(
             reason=f"requires a real TPU backend (got {backend})")
         for item in items:

@@ -238,7 +238,7 @@ def test_topk_pad_exact_on_chip(rng):
     from raft_tpu.ops.select_k import SelectAlgo, select_k
 
     x = rng.standard_normal((512, 4096)).astype(np.float32)
-    plat = jax.default_backend()
+    plat = sk._platform_key()  # "tpu" under both tpu and axon names
     prev = sk._load_pad_rules().get(plat)
     # baseline must be UNPADDED even when the queue already dropped a
     # TOPK_PAD artifact at the repo root (else this compares padded to
