@@ -19,14 +19,19 @@ import os
 import sys
 
 
-def _cmd_run(args) -> int:
+def _honor_cpu_request() -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the axon sitecustomize pre-sets jax_platforms at interpreter
         # startup, overriding the env var — honor an explicit cpu request
-        # via jax.config so CPU runs can't hang on a dead tunnel
+        # via jax.config so CPU runs can't hang on a dead tunnel. Applies
+        # to every subcommand that touches jax (run, generate-groundtruth).
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def _cmd_run(args) -> int:
+    _honor_cpu_request()
     from raft_tpu.bench import export, runner
 
     with open(args.conf) as f:
@@ -36,14 +41,45 @@ def _cmd_run(args) -> int:
         if target is None:
             target = int(args.scale)
         config = runner.scale_config(config, target)
+    if args.algos:
+        config["index"] = [
+            e for e in config["index"]
+            if any(s in e["name"] or s in e.get("algo", "")
+                   for s in args.algos)]
+        print(f"--algos: running {[e['name'] for e in config['index']]}")
+    if args.resume and args.out and os.path.exists(args.out):
+        # skip entries that already have rows in the out JSONL — the
+        # CPU-baseline rows can be produced off-window and the chip
+        # window then only pays for the accelerator algos
+        done = set()
+        with open(args.out) as f:
+            for line in f:
+                try:
+                    done.add(json.loads(line).get("name"))
+                except ValueError:
+                    pass
+        skipped = [e["name"] for e in config["index"] if e["name"] in done]
+        config["index"] = [e for e in config["index"]
+                           if e["name"] not in done]
+        if skipped:
+            print(f"--resume: skipping completed {skipped}")
+    prior = []
+    if args.resume and args.out and os.path.exists(args.out):
+        with open(args.out) as f:
+            for line in f:
+                try:
+                    prior.append(json.loads(line))
+                except ValueError:
+                    pass
     rows = runner.run_benchmark(config, k=args.k, batch_size=args.batch_size,
                                 search_iters=args.iters, out_path=args.out)
     for r in rows:
         print(json.dumps(r))
+    all_rows = prior + rows  # resumed runs export the full set
     if args.csv:
-        export.export_csv(rows, args.csv, pareto=args.pareto)
+        export.export_csv(all_rows, args.csv, pareto=args.pareto)
     if args.plot:
-        export.plot(rows, args.plot)
+        export.plot(all_rows, args.plot)
     return 0
 
 
@@ -79,6 +115,7 @@ def _cmd_get_dataset(args) -> int:
 
 
 def _cmd_generate_groundtruth(args) -> int:
+    _honor_cpu_request()
     import numpy as np
 
     from raft_tpu import native
@@ -127,6 +164,11 @@ def main(argv=None):
     pr.add_argument("--csv", default=None)
     pr.add_argument("--plot", default=None)
     pr.add_argument("--pareto", action="store_true")
+    pr.add_argument("--algos", nargs="*", default=None,
+                    help="only run index entries whose name/algo contains "
+                         "one of these substrings")
+    pr.add_argument("--resume", action="store_true",
+                    help="skip index entries already present in --out")
     pr.set_defaults(fn=_cmd_run)
 
     pg = sub.add_parser("get-dataset",

@@ -76,10 +76,11 @@ run_step cagra  /tmp/q_cagra.done  timeout 3600 \
 
 # 7. sift-1M pareto (fp32/bf16/fp8 LUTs + approx + screen points)
 # (rows append to the JSONL incrementally, so even a timeout kill keeps
-# the completed points; CPU-side baselines at 1M on the 1-core host are
-# the slow tail, hence the wide budget)
+# the completed points. --resume: the CPU baselines — the slow tail —
+# are pre-run OFF-window into the same JSONL, so window time goes to
+# the accelerator algos only; re-runs after a drop skip finished rows)
 run_step pareto /tmp/q_pareto.done timeout 9000 python -m raft_tpu.bench run \
-  --conf raft_tpu/bench/conf/sift-128-euclidean.json \
+  --conf raft_tpu/bench/conf/sift-128-euclidean.json --resume \
   --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
 
 # 8. chip-scale baseline targets (BASELINE.md rows at single-chip shapes)
