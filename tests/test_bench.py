@@ -229,3 +229,46 @@ def test_cli_get_dataset_and_groundtruth(tmp_path):
     np.testing.assert_array_equal(
         native.read_bin(str(tmp_path / "sp.distances.fbin")),
         np.ones_like(ref, np.float32))
+
+
+def test_cli_algos_filter_and_resume(dataset_files, tmp_path):
+    """--algos restricts entries; --resume skips names already in the out
+    JSONL and exports the merged set (the off-window baseline pre-run
+    contract the queue's pareto step relies on)."""
+    import subprocess
+    import sys
+
+    conf = _config(dataset_files, [
+        {"name": "raft_brute_force", "algo": "raft_brute_force",
+         "build_param": {}, "search_params": [{}]},
+        {"name": "sklearn_brute_force", "algo": "sklearn_brute_force",
+         "build_param": {}, "search_params": [{}]},
+    ])
+    cp = str(tmp_path / "conf.json")
+    with open(cp, "w") as f:
+        json.dump(conf, f)
+    out = str(tmp_path / "rows.jsonl")
+    csv = str(tmp_path / "rows.csv")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "raft_tpu.bench", "run", "--conf", cp,
+             "--out", out, "--csv", csv, "--iters", "1", *extra],
+            capture_output=True, text=True, env=env, timeout=600)
+
+    r1 = run("--algos", "sklearn")
+    assert r1.returncode == 0, r1.stderr[-800:]
+    rows = [json.loads(l) for l in open(out)]
+    assert {r["name"] for r in rows} == {"sklearn_brute_force"}
+
+    r2 = run("--resume")
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "--resume: skipping completed ['sklearn_brute_force']" in r2.stdout
+    rows = [json.loads(l) for l in open(out)]
+    assert {r["name"] for r in rows} == {"raft_brute_force",
+                                         "sklearn_brute_force"}
+    # merged CSV carries both, resumed row included
+    csv_text = open(csv).read()
+    assert "sklearn_brute_force" in csv_text
+    assert "raft_brute_force" in csv_text
