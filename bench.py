@@ -49,6 +49,60 @@ def _probe_platform(timeout_s: int = 540) -> str:
         return "cpu"
 
 
+def _last_measured_tpu(here=None):
+    """Most recent committed on-chip measurement, as a clearly-labeled
+    block for the driver's JSON when this run itself lands on CPU.
+
+    Scans repo-root ``BENCH_TPU_SESSION_r*.json`` session artifacts (banked
+    incrementally during tunnel windows) for a driver-shaped row with
+    ``platform == "tpu"`` under ``bench_py_rerun``/``bench_py_first_run``
+    (the r04+ artifact contract; r03's legacy nested ``bench_py`` shape is
+    intentionally out of scope — r04 supersedes it and is committed).
+    Returns None when no hardware evidence exists.
+    A dead tunnel at round close must not erase the round's hardware
+    record (VERDICT r4 weak #1): the driver's capture reads only this
+    script's stdout, so the evidence has to ride in this line."""
+    import glob
+    import re
+
+    if here is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+    best = None  # (round_number, block)
+    for path in glob.glob(os.path.join(here, "BENCH_TPU_SESSION_r*.json")):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            continue
+        # newest round wins; within an artifact an explicit re-run key is
+        # preferred over the first run (iteration order + break below)
+        for key in ("bench_py_rerun", "bench_py_first_run"):
+            row = doc.get(key)
+            if not isinstance(row, dict) or row.get("platform") != "tpu":
+                continue
+            round_number = int(m.group(1))
+            if best is None or round_number > best[0]:
+                block = {
+                    "note": "most recent committed on-chip measurement "
+                            "(this run itself did not land on TPU)",
+                    "metric": row.get("metric"),
+                    "value": row.get("value"),
+                    "unit": row.get("unit"),
+                    "recall": row.get("recall"),
+                    "scan": row.get("scan"),
+                    "when": doc.get("when"),
+                    "artifact": os.path.basename(path),
+                }
+                if isinstance(row.get("extra"), dict):
+                    block["extra"] = row["extra"]
+                best = (round_number, block)
+            break  # only the preferred key per artifact
+    return best[1] if best else None
+
+
 def main():
     degraded = False
     if _probe_platform() == "cpu":
@@ -150,6 +204,19 @@ def main():
     # the driver must still get its line well inside any timeout
     if os.environ.get("RAFT_TPU_BENCH_EXTRAS", "1") != "0" and not degraded:
         row["extra"] = _index_extras(k)
+
+    # evidence survival: a CPU line still carries the last committed
+    # hardware number, labeled and dated (VERDICT r4 "make hardware
+    # evidence survive a dead tunnel"; ref benchmark JSON emission:
+    # cpp/bench/ann/src/common/benchmark.hpp:379-509)
+    if platform != "tpu":
+        last = _last_measured_tpu()
+        if last is not None:
+            if degraded:
+                last["note"] = ("most recent committed on-chip "
+                                "measurement; this run fell back to CPU "
+                                "(TPU tunnel down)")
+            row["last_measured_tpu"] = last
 
     print(json.dumps(row))
 

@@ -1,0 +1,90 @@
+"""The driver-visible bench line must carry hardware evidence even when
+the TPU tunnel is down at capture time (VERDICT r4 weak #1).
+
+``bench._last_measured_tpu`` scans committed ``BENCH_TPU_SESSION_r*.json``
+artifacts for the newest driver-shaped on-chip row; ``main`` attaches it
+as a labeled ``last_measured_tpu`` block whenever the run lands on CPU.
+Reference analog: the benchmark JSON emission in
+cpp/bench/ann/src/common/benchmark.hpp:379-509 (every run self-describes
+its context in the emitted record)."""
+
+import importlib.util
+import json
+import os
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "bench_headline", os.path.join(_HERE, "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def _write(dirpath, name, doc):
+    with open(os.path.join(dirpath, name), "w") as f:
+        json.dump(doc, f)
+
+
+def test_none_when_no_artifacts(tmp_path):
+    assert bench._last_measured_tpu(str(tmp_path)) is None
+
+
+def test_ignores_cpu_rows(tmp_path):
+    _write(tmp_path, "BENCH_TPU_SESSION_r03.json", {
+        "when": "x", "bench_py_first_run": {
+            "platform": "cpu", "value": 1.0}})
+    assert bench._last_measured_tpu(str(tmp_path)) is None
+
+
+def test_picks_newest_round_and_rerun_over_first(tmp_path):
+    _write(tmp_path, "BENCH_TPU_SESSION_r03.json", {
+        "when": "r3 window", "bench_py_first_run": {
+            "platform": "tpu", "metric": "m", "value": 81420.1,
+            "unit": "QPS", "recall": 1.0, "scan": "bf16+fp32refine"}})
+    _write(tmp_path, "BENCH_TPU_SESSION_r04.json", {
+        "when": "r4 window",
+        "bench_py_first_run": {
+            "platform": "tpu", "metric": "m", "value": 61349.6,
+            "unit": "QPS", "recall": 1.0, "scan": "fp32",
+            "extra": {"ivf_pq_nprobe32": {"qps": 97920.7}}},
+        "bench_py_rerun": {
+            "platform": "tpu", "metric": "m", "value": 70000.0,
+            "unit": "QPS", "recall": 1.0, "scan": "fp32"}})
+    block = bench._last_measured_tpu(str(tmp_path))
+    assert block["value"] == 70000.0          # rerun beats first_run
+    assert block["artifact"] == "BENCH_TPU_SESSION_r04.json"
+    assert block["when"] == "r4 window"
+    assert "on-chip" in block["note"]
+
+
+def test_numeric_round_ordering(tmp_path):
+    # r10 must beat r9 (numeric, not lexicographic, round comparison)
+    _write(tmp_path, "BENCH_TPU_SESSION_r9.json", {
+        "when": "r9", "bench_py_first_run": {
+            "platform": "tpu", "metric": "m", "value": 9.0,
+            "unit": "QPS", "recall": 1.0, "scan": "fp32"}})
+    _write(tmp_path, "BENCH_TPU_SESSION_r10.json", {
+        "when": "r10", "bench_py_first_run": {
+            "platform": "tpu", "metric": "m", "value": 10.0,
+            "unit": "QPS", "recall": 1.0, "scan": "fp32"}})
+    assert bench._last_measured_tpu(str(tmp_path))["value"] == 10.0
+
+
+def test_repo_artifact_resolves():
+    # the real committed artifact must yield a block (the actual
+    # round-close safety net, not just the synthetic fixtures)
+    block = bench._last_measured_tpu(_HERE)
+    assert block is not None
+    assert block["value"] > 0
+    assert block["artifact"].startswith("BENCH_TPU_SESSION_r")
+
+
+def test_malformed_artifact_skipped(tmp_path):
+    with open(os.path.join(tmp_path, "BENCH_TPU_SESSION_r09.json"),
+              "w") as f:
+        f.write("{not json")
+    _write(tmp_path, "BENCH_TPU_SESSION_r04.json", {
+        "when": "w", "bench_py_first_run": {
+            "platform": "tpu", "metric": "m", "value": 5.0,
+            "unit": "QPS", "recall": 1.0, "scan": "fp32"}})
+    block = bench._last_measured_tpu(str(tmp_path))
+    assert block["value"] == 5.0
