@@ -41,36 +41,56 @@ def _cmd_run(args) -> int:
         if target is None:
             target = int(args.scale)
         config = runner.scale_config(config, target)
+    def entry_name(e):
+        # the runner itself tolerates a missing "name" via the same
+        # fallback (runner.run_benchmark row labeling)
+        return e.get("name", e.get("algo", ""))
+
     if args.algos:
         config["index"] = [
             e for e in config["index"]
-            if any(s in e["name"] or s in e.get("algo", "")
+            if any(s in entry_name(e) or s in e.get("algo", "")
                    for s in args.algos)]
-        print(f"--algos: running {[e['name'] for e in config['index']]}")
+        print(f"--algos: running {[entry_name(e) for e in config['index']]}")
+    prior = []
     if args.resume and args.out and os.path.exists(args.out):
-        # skip entries that already have rows in the out JSONL — the
-        # CPU-baseline rows can be produced off-window and the chip
-        # window then only pays for the accelerator algos
+        # skip work already in the out JSONL — the CPU-baseline rows can
+        # be produced off-window and the chip window then only pays for
+        # the accelerator algos. Completion is keyed per
+        # (name, search_param), not per entry: the runner appends one row
+        # per search_param as each completes, so a timeout kill mid-entry
+        # leaves a partial entry whose remaining points must still run on
+        # the next resume (a name-only key would silently drop them from
+        # the pareto front).
         done = set()
         with open(args.out) as f:
             for line in f:
                 try:
-                    done.add(json.loads(line).get("name"))
+                    r = json.loads(line)
                 except ValueError:
-                    pass
-        skipped = [e["name"] for e in config["index"] if e["name"] in done]
-        config["index"] = [e for e in config["index"]
-                           if e["name"] not in done]
+                    continue
+                prior.append(r)
+                done.add((r.get("name"),
+                          json.dumps(r.get("search_param", {}),
+                                     sort_keys=True)))
+        kept, skipped, partial = [], [], []
+        for e in config["index"]:
+            name = entry_name(e)
+            sps = e.get("search_params", [{}])
+            missing = [sp for sp in sps
+                       if (name, json.dumps(sp, sort_keys=True)) not in done]
+            if not missing:
+                skipped.append(name)
+            else:
+                if len(missing) < len(sps):
+                    partial.append(f"{name} ({len(missing)}/{len(sps)} "
+                                   "search params left)")
+                kept.append(dict(e, search_params=missing))
+        config["index"] = kept
         if skipped:
             print(f"--resume: skipping completed {skipped}")
-    prior = []
-    if args.resume and args.out and os.path.exists(args.out):
-        with open(args.out) as f:
-            for line in f:
-                try:
-                    prior.append(json.loads(line))
-                except ValueError:
-                    pass
+        if partial:
+            print(f"--resume: finishing partial {partial}")
     rows = runner.run_benchmark(config, k=args.k, batch_size=args.batch_size,
                                 search_iters=args.iters, out_path=args.out)
     for r in rows:

@@ -152,7 +152,7 @@ def _band(table: dict, k: int):
 # prefix of a larger selection is the smaller selection), so the fix is a
 # trace-time rewrite of the REQUESTED k. Which cells win is measured by
 # tools/topk_k_probe.py (2x bar) into TOPK_PAD_<platform>.json; rules are
-# matched by exact k and nearby width (x1.5 — pointwise pathologies don't
+# matched by exact k and nearby width (x1.25 — pointwise pathologies don't
 # extrapolate, cf. the reference picking select algorithms per shape,
 # detail/select_k-inl.cuh:48).
 _pad_rules_cache: Optional[dict] = None
@@ -189,15 +189,20 @@ def set_pad_rules(platform: str, rules: Optional[list]) -> None:
 
 def _pad_k(n: int, k: int) -> int:
     """The k top_k should actually be asked for at row width ``n``: the
-    measured pad rule with matching k and width within x1.5 (nearest by
-    width ratio), else k unchanged."""
+    measured pad rule with matching k and width within x1.25 (nearest by
+    width ratio), else k unchanged. The top_k pathologies are pointwise
+    in (n, k) and don't extrapolate, so the window is deliberately tight
+    — just wide enough to cover tile widths adjacent to a measured power
+    of two (e.g. a 5000-wide balanced tile under the 4096 rule) until
+    tools/topk_k_probe.py has mapped the neighboring widths on hardware
+    (ADVICE r4)."""
     rules = _load_pad_rules().get(_platform_key(), [])
     best = None
     for r in rules:
         if r["k"] != k:
             continue
         ratio = max(n, r["n"]) / max(1, min(n, r["n"]))
-        if ratio <= 1.5 and (best is None or ratio < best[0]):
+        if ratio <= 1.25 and (best is None or ratio < best[0]):
             best = (ratio, r["k_pad"])
     return min(n, best[1]) if best else k
 

@@ -272,3 +272,75 @@ def test_cli_algos_filter_and_resume(dataset_files, tmp_path):
     csv_text = open(csv).read()
     assert "sklearn_brute_force" in csv_text
     assert "raft_brute_force" in csv_text
+
+
+def test_cli_resume_finishes_partial_entry(dataset_files, tmp_path):
+    """--resume keys completion on (name, search_param), not name: a
+    timeout kill mid-entry leaves some search-param rows missing, and the
+    next resume must run exactly those (ADVICE r4 medium — a name-only
+    key permanently dropped the rest of the pareto front)."""
+    import subprocess
+    import sys
+
+    sps = [{}, {"scan_dtype": "bfloat16"}]
+    conf = _config(dataset_files, [
+        {"name": "bf", "algo": "raft_brute_force",
+         "build_param": {}, "search_params": sps},
+    ])
+    cp = str(tmp_path / "conf.json")
+    with open(cp, "w") as f:
+        json.dump(conf, f)
+    out = str(tmp_path / "rows.jsonl")
+
+    # simulate the killed run: only the first search_param's row landed
+    with open(out, "w") as f:
+        f.write(json.dumps({"name": "bf", "algo": "raft_brute_force",
+                            "qps": 1.0, "recall": 1.0,
+                            "search_param": sps[0]}) + "\n")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.bench", "run", "--conf", cp,
+         "--out", out, "--iters", "1", "--resume"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "finishing partial" in r.stdout
+    rows = [json.loads(l) for l in open(out)]
+    params = [r["search_param"] for r in rows if r["name"] == "bf"]
+    assert params == sps  # old row kept, ONLY the missing one re-run
+
+    # a second resume now skips the entry entirely
+    r2 = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.bench", "run", "--conf", cp,
+         "--out", out, "--iters", "1", "--resume"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=600)
+    assert r2.returncode == 0, r2.stderr[-800:]
+    assert "--resume: skipping completed ['bf']" in r2.stdout
+    assert len([json.loads(l) for l in open(out)]) == 2
+
+
+def test_cli_filters_tolerate_missing_name(dataset_files, tmp_path):
+    """--algos/--resume must not KeyError on an index entry without a
+    "name" key — the runner itself falls back to the algo name
+    (ADVICE r4 low)."""
+    import subprocess
+    import sys
+
+    conf = _config(dataset_files, [
+        {"algo": "raft_brute_force", "build_param": {},
+         "search_params": [{}]},
+    ])
+    cp = str(tmp_path / "conf.json")
+    with open(cp, "w") as f:
+        json.dump(conf, f)
+    out = str(tmp_path / "rows.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.bench", "run", "--conf", cp,
+         "--out", out, "--iters", "1", "--resume",
+         "--algos", "brute"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    rows = [json.loads(l) for l in open(out)]
+    assert rows and rows[0]["name"] == "raft_brute_force"

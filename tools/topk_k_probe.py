@@ -39,6 +39,12 @@ def main():
     # rules match k EXACTLY, so the probe must measure the ks searches use
     ap.add_argument("--ks", type=int, nargs="*",
                     default=[4, 8, 10, 12, 16, 24, 32, 40, 48, 64])
+    ap.add_argument("--remeasure", action="store_true",
+                    help="re-measure requested widths even for (n, k) "
+                         "cells already in the artifact (the default "
+                         "merge keeps prior cells, so a measurement "
+                         "polluted by host contention would otherwise "
+                         "be permanent)")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
@@ -50,24 +56,27 @@ def main():
     platform = jax.devices()[0].platform
     out = args.out or f"TOPK_PAD_{platform}.json"
     rng = np.random.default_rng(0)
-    # Seed from an existing artifact: rows for widths NOT being re-measured
-    # survive, so an early-killed rerun (wiped /tmp markers) can't clobber
-    # a complete artifact down to one width. Re-measured widths replace
-    # their old rows.
+    # Seed from an existing artifact: every prior row survives in `grid`
+    # from the start — including a requested width with an INCOMPLETE k
+    # set (ADVICE r4: dropping it meant a rerun killed before reaching
+    # that width clobbered its old partial measurements on the next
+    # incremental write). Incomplete widths keep their measured ks and
+    # only the missing ks are measured (merged in place).
     grid = []
     done_widths = set()
+    requested = set(args.widths)
     try:
         with open(out) as f:
             prev = json.load(f)
         if prev.get("platform") == platform:
             for r in prev.get("grid", []):
+                if args.remeasure and r.get("n") in requested:
+                    r = {"n": r["n"], "ms": {}}
+                grid.append(r)
                 wanted = {str(k) for k in args.ks if k * 4 <= r.get("n", 0)}
-                if r.get("n") not in set(args.widths):
-                    grid.append(r)  # width not requested: keep as-is
-                elif wanted <= set(r.get("ms", {})):
+                if r.get("n") in requested and wanted <= set(r.get("ms", {})):
                     # resume: this width already has every requested k —
                     # don't re-pay its ~per-k compile minutes on the tunnel
-                    grid.append(r)
                     done_widths.add(r["n"])
             if grid:
                 print(f"seeded {len(grid)} rows from existing {out} "
@@ -116,16 +125,20 @@ def main():
             continue
         x = jax.numpy.asarray(
             rng.standard_normal((args.batch, n)).astype(np.float32))
-        row = {"n": n, "ms": {}}
+        row = next((r for r in grid if r.get("n") == n), None)
+        if row is None:
+            row = {"n": n, "ms": {}}
+            grid.append(row)
         for k in args.ks:
             if k * 4 > n:
                 continue
+            if str(k) in row["ms"]:
+                continue  # measured by a prior partial run: merge, not redo
             f = jax.jit(lambda v, kk=k: jax.lax.top_k(v, kk))
             dt = time_dispatches(lambda: f(x), iters=args.iters)
             row["ms"][str(k)] = round(dt * 1e3, 3)
-        grid.append(row)
+            write(partial=True)  # per-k: a kill keeps every measured cell
         print(row, flush=True)
-        write(partial=True)
 
     art = write(partial=False)
     print(f"-> {out}\nrules: {art['pad_rules']}")
