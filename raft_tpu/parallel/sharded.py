@@ -815,6 +815,46 @@ def _assemble_sharded_ivf_pq(comms: Comms, subs, params, n: int,
         pq_dim=first.pq_dim, pq_bits=first.pq_bits)
 
 
+def _resolve_pq_scan_mode(params, list_decoded, list_codes) -> str:
+    """Scan-engine resolution shared by the mesh and elastic searches —
+    "auto" follows the engine the index was built with."""
+    if params.scan_mode not in ("auto", "cache", "lut"):
+        raise ValueError(f"unknown scan_mode: {params.scan_mode!r}")
+    mode = params.scan_mode
+    if mode == "auto":
+        mode = "cache" if list_decoded is not None else "lut"
+    if mode == "cache" and list_decoded is None:
+        raise ValueError(
+            'index holds no decoded cache (built scan_mode="lut"); '
+            'search with scan_mode="lut"/"auto" or rebuild')
+    if mode == "lut" and list_codes is None:
+        raise ValueError(
+            'index holds no packed codes (built scan_mode="cache"); '
+            'search with scan_mode="cache"/"auto" or rebuild')
+    return mode
+
+
+def _pq_q_tile(mode: str, n_probes: int, res: Resources, list_decoded,
+               list_codes, pq_dim: int, pq_bits: int) -> int:
+    """Workspace-bounded query-tile size, shared by the mesh and elastic
+    searches so single-chip serving tiles can't desync from mesh tiles.
+    Shapes are [..., pad, last] with any number of leading axes."""
+    if mode == "cache":
+        list_pad = list_decoded.shape[-2]
+        rot = list_decoded.shape[-1]
+        per_q = n_probes * list_pad * (rot * 2 + 12)
+        cap = 1024
+    else:
+        list_pad = list_codes.shape[-2]
+        book = 1 << pq_bits
+        per_q = n_probes * (pq_dim * book * 4 + list_pad * (pq_dim * 4 + 16))
+        cap = 256
+    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, cap))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    return q_tile
+
+
 def search_ivf_pq(
     index: ShardedIvfPq,
     queries,
@@ -836,19 +876,8 @@ def search_ivf_pq(
     n_lists = index.centers.shape[1]
     n_probes = int(min(params.n_probes, n_lists))
     select_recall = float(getattr(params, "select_recall", 1.0))
-    if params.scan_mode not in ("auto", "cache", "lut"):
-        raise ValueError(f"unknown scan_mode: {params.scan_mode!r}")
-    mode = params.scan_mode
-    if mode == "auto":
-        mode = "cache" if index.list_decoded is not None else "lut"
-    if mode == "cache" and index.list_decoded is None:
-        raise ValueError(
-            'sharded index holds no decoded cache (built scan_mode="lut"); '
-            'search with scan_mode="lut"/"auto" or rebuild')
-    if mode == "lut" and index.list_codes is None:
-        raise ValueError(
-            'sharded index holds no packed codes (built scan_mode="cache"); '
-            'search with scan_mode="cache"/"auto" or rebuild')
+    mode = _resolve_pq_scan_mode(params, index.list_decoded,
+                                 index.list_codes)
     empty_filter = jnp.zeros((0,), jnp.uint32)
     ax = comms.axis
 
@@ -874,13 +903,8 @@ def search_ivf_pq(
                     overflow_indices=oi[0], has_overflow=True)
 
     if mode == "cache":
-        list_pad = index.list_decoded.shape[2]
-        rot = index.list_decoded.shape[3]
-        per_q = n_probes * list_pad * (rot * 2 + 12)
-        q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1),
-                             1, 1024))
-        if q_tile >= 8:
-            q_tile -= q_tile % 8
+        q_tile = _pq_q_tile("cache", n_probes, res, index.list_decoded,
+                            index.list_codes, index.pq_dim, index.pq_bits)
 
         def local(q_rep, c, ro, ld, dn, li, ls, *over):
             v, i = ivf_pq._search_cache_core(
@@ -901,13 +925,8 @@ def search_ivf_pq(
                            index.list_indices, index.list_sizes, *over_ops)
 
     # LUT engine: packed codes only (the DEEP-100M/8 memory-lean shape)
-    list_pad = index.list_codes.shape[2]
-    book = 1 << index.pq_bits
-    per_q = n_probes * (index.pq_dim * book * 4
-                        + list_pad * (index.pq_dim * 4 + 16))
-    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 256))
-    if q_tile >= 8:
-        q_tile -= q_tile % 8
+    q_tile = _pq_q_tile("lut", n_probes, res, index.list_decoded,
+                        index.list_codes, index.pq_dim, index.pq_bits)
     lut_dtype = jnp.dtype(params.lut_dtype).name
     dist_dtype = jnp.dtype(params.internal_distance_dtype).name
 
@@ -1177,6 +1196,195 @@ def deserialize_ivf_pq(prefix: str, comms: Comms) -> ShardedIvfPq:
      overflow_norms, overflow_indices) = arrs
     return ShardedIvfPq(
         comms, centers, rotation, list_indices, list_sizes,
+        DistanceType(metric), int(n_rows), list_decoded=list_decoded,
+        decoded_norms=decoded_norms, codebooks=codebooks,
+        list_codes=list_codes, per_cluster=bool(per_cluster),
+        pq_dim=int(pq_dim), pq_bits=int(pq_bits),
+        overflow_decoded=overflow_decoded, overflow_norms=overflow_norms,
+        overflow_indices=overflow_indices)
+
+
+# -------------------------------------------------------- elastic restore
+#
+# A sharded checkpoint normally restores only onto a mesh of the SAME size
+# it was built on (deserialize_ivf_pq raises otherwise). Elastic restore
+# lifts that: the shard blocks are stacked [S, ...] as plain arrays on the
+# default device and searched by running the per-shard core sequentially
+# (lax.map) inside one jitted program, then merging with one select_k —
+# numerically identical to the mesh search (same cores, same merge). This
+# is the single-chip serving story for a multi-shard build: an 8-virtual-
+# device CPU-built DEEP-scale index searches on the one real TPU without a
+# rebuild. (The reference's raft-dask analog requires re-creating the
+# cluster at the original worker count — raft_dask/common/comms.py;
+# per-worker local models in cuML's kNN.)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "metric", "k", "n_probes", "q_tile", "per_cluster", "pq_dim", "pq_bits",
+    "lut_dtype", "dist_dtype", "select_recall", "has_overflow"))
+def _elastic_lut_search(queries, centers, rotation, codebooks, list_codes,
+                        list_indices, list_sizes, overflow_decoded,
+                        overflow_norms, overflow_indices, *, metric, k,
+                        n_probes, q_tile, per_cluster, pq_dim, pq_bits,
+                        lut_dtype, dist_dtype, select_recall, has_overflow):
+    from raft_tpu.neighbors import ivf_pq
+
+    empty_filter = jnp.zeros((0,), jnp.uint32)
+    minimize = metric != DistanceType.InnerProduct
+
+    def per_shard(blocks):
+        c, ro, cb, lc, li, ls, od, on, oi = blocks
+        kw = (dict(overflow_decoded=od, overflow_norms=on,
+                   overflow_indices=oi, has_overflow=True)
+              if has_overflow else {})
+        return ivf_pq._search_lut_core(
+            queries, c, ro, cb, lc, li, ls, empty_filter, metric, k,
+            n_probes, q_tile, per_cluster, pq_dim, pq_bits, False,
+            lut_dtype, dist_dtype, select_recall=select_recall, **kw)
+
+    v, i = jax.lax.map(per_shard, (centers, rotation, codebooks, list_codes,
+                                   list_indices, list_sizes,
+                                   overflow_decoded, overflow_norms,
+                                   overflow_indices))
+    return _elastic_merge(v, i, queries.shape[0], k, minimize)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "metric", "k", "n_probes", "q_tile", "select_recall", "has_overflow"))
+def _elastic_cache_search(queries, centers, rotation, list_decoded,
+                          decoded_norms, list_indices, list_sizes,
+                          overflow_decoded, overflow_norms, overflow_indices,
+                          *, metric, k, n_probes, q_tile, select_recall,
+                          has_overflow):
+    from raft_tpu.neighbors import ivf_pq
+
+    empty_filter = jnp.zeros((0,), jnp.uint32)
+    minimize = metric != DistanceType.InnerProduct
+
+    def per_shard(blocks):
+        c, ro, ld, dn, li, ls, od, on, oi = blocks
+        kw = (dict(overflow_decoded=od, overflow_norms=on,
+                   overflow_indices=oi, has_overflow=True)
+              if has_overflow else {})
+        return ivf_pq._search_cache_core(
+            queries, c, ro, ld, dn, li, ls, empty_filter, metric, k,
+            n_probes, q_tile, False, select_recall=select_recall, **kw)
+
+    v, i = jax.lax.map(per_shard, (centers, rotation, list_decoded,
+                                   decoded_norms, list_indices, list_sizes,
+                                   overflow_decoded, overflow_norms,
+                                   overflow_indices))
+    return _elastic_merge(v, i, queries.shape[0], k, minimize)
+
+
+def _elastic_merge(v, i, nq: int, k: int, minimize: bool):
+    """[S, nq, k] per-shard candidates → [nq, k] global top-k (the
+    knn_merge_parts-across-ranks step, without the all_gather — everything
+    already lives on one device)."""
+    v = jnp.swapaxes(v, 0, 1).reshape(nq, -1)
+    i = jnp.swapaxes(i, 0, 1).reshape(nq, -1)
+    v = jnp.where(i < 0, jnp.inf if minimize else -jnp.inf, v)
+    vm, sel = select_k(v, k, select_min=minimize)
+    return vm, jnp.take_along_axis(i, sel, axis=1)
+
+
+class ElasticIvfPq:
+    """A sharded IVF-PQ checkpoint restored WITHOUT the original mesh —
+    shard blocks live stacked [S, ...] on the default device; ``search``
+    matches ``sharded.search_ivf_pq`` exactly (same per-shard cores, same
+    merge)."""
+
+    def __init__(self, n_shards, centers, rotation, list_indices,
+                 list_sizes, metric, n_rows, list_decoded=None,
+                 decoded_norms=None, codebooks=None, list_codes=None,
+                 per_cluster=False, pq_dim=0, pq_bits=8,
+                 overflow_decoded=None, overflow_norms=None,
+                 overflow_indices=None):
+        self.n_shards = int(n_shards)
+        self.centers = centers  # [S, nlist, dim]
+        self.rotation = rotation  # [S, rot, dim]
+        self.list_indices = list_indices  # [S, nlist, pad] global ids
+        self.list_sizes = list_sizes  # [S, nlist]
+        self.metric = metric
+        self.n_rows = int(n_rows)
+        self.list_decoded = list_decoded
+        self.decoded_norms = decoded_norms
+        self.codebooks = codebooks
+        self.list_codes = list_codes
+        self.per_cluster = bool(per_cluster)
+        self.pq_dim = int(pq_dim)
+        self.pq_bits = int(pq_bits)
+        self.overflow_decoded = overflow_decoded
+        self.overflow_norms = overflow_norms
+        self.overflow_indices = overflow_indices
+
+    def search(self, queries, k: int, params=None,
+               res: Optional[Resources] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+        from raft_tpu.neighbors import ivf_pq
+
+        res = ensure_resources(res)
+        params = params or ivf_pq.SearchParams()
+        queries = jnp.asarray(queries)
+        n_lists = self.centers.shape[1]
+        n_probes = int(min(params.n_probes, n_lists))
+        select_recall = float(getattr(params, "select_recall", 1.0))
+        mode = _resolve_pq_scan_mode(params, self.list_decoded,
+                                     self.list_codes)
+        has_overflow = self.overflow_decoded is not None
+        if has_overflow:
+            over = (self.overflow_decoded, self.overflow_norms,
+                    self.overflow_indices)
+        else:
+            # stable zero-size placeholders keep the jit signature uniform
+            s = self.n_shards
+            rot = self.rotation.shape[1]
+            over = (jnp.zeros((s, 0, rot), jnp.bfloat16),
+                    jnp.zeros((s, 0), jnp.float32),
+                    jnp.zeros((s, 0), jnp.int32))
+
+        q_tile = _pq_q_tile(mode, n_probes, res, self.list_decoded,
+                            self.list_codes, self.pq_dim, self.pq_bits)
+        if mode == "cache":
+            return _elastic_cache_search(
+                queries, self.centers, self.rotation, self.list_decoded,
+                self.decoded_norms, self.list_indices, self.list_sizes,
+                *over, metric=self.metric, k=int(k), n_probes=n_probes,
+                q_tile=q_tile, select_recall=select_recall,
+                has_overflow=has_overflow)
+
+        return _elastic_lut_search(
+            queries, self.centers, self.rotation, self.codebooks,
+            self.list_codes, self.list_indices, self.list_sizes, *over,
+            metric=self.metric, k=int(k), n_probes=n_probes, q_tile=q_tile,
+            per_cluster=self.per_cluster, pq_dim=self.pq_dim,
+            pq_bits=self.pq_bits,
+            lut_dtype=jnp.dtype(params.lut_dtype).name,
+            dist_dtype=jnp.dtype(params.internal_distance_dtype).name,
+            select_recall=select_recall, has_overflow=has_overflow)
+
+
+def deserialize_ivf_pq_elastic(prefix: str) -> ElasticIvfPq:
+    """Restore a sharded IVF-PQ checkpoint on ANY device count (vs
+    ``deserialize_ivf_pq``, which requires the original mesh size). All
+    rank files are read and every shard is retained on the default
+    device."""
+    scalars, parts, seen = _deserialize_sharded(
+        prefix, "sharded_ivf_pq", 7, want_ranks=None)
+    metric, n_rows, size, pq_dim, pq_bits, per_cluster, _engine = scalars
+    size = int(size)
+    _check_rank_coverage(seen, size, prefix)
+
+    def stk(p):
+        if p is None:
+            return None
+        return jnp.asarray(np.stack([p[r] for r in range(size)]))
+
+    (centers, rotation, list_indices, list_sizes, list_decoded,
+     decoded_norms, codebooks, list_codes, overflow_decoded,
+     overflow_norms, overflow_indices) = [stk(p) for p in parts]
+    return ElasticIvfPq(
+        size, centers, rotation, list_indices, list_sizes,
         DistanceType(metric), int(n_rows), list_decoded=list_decoded,
         decoded_norms=decoded_norms, codebooks=codebooks,
         list_codes=list_codes, per_cluster=bool(per_cluster),

@@ -93,3 +93,73 @@ def test_sharded_deserialize_validation(tmp_path, data):
     os.remove(prefix + ".rank0")
     with pytest.raises(FileNotFoundError):
         sharded.deserialize_ivf_flat(prefix, comms)
+
+
+def _assert_same_neighbors(d0, i0, d1, i1, rtol=1e-4):
+    """Mesh and elastic searches run the same cores compiled differently
+    (shard_map vs lax.map), so distances agree only to fp tolerance and a
+    near-tie at the k-th cut may legitimately flip ids. Assert distance
+    closeness plus per-row id agreement allowing one boundary flip."""
+    d0, i0 = np.asarray(d0), np.asarray(i0)
+    d1, i1 = np.asarray(d1), np.asarray(i1)
+    np.testing.assert_allclose(d0, d1, rtol=rtol)
+    k = i0.shape[1]
+    for r, (a, b) in enumerate(zip(i0, i1)):
+        assert len(set(a) & set(b)) >= k - 1, (r, a, b)
+
+
+@pytest.mark.parametrize("scan_mode", ["lut", "cache"])
+def test_elastic_restore_matches_mesh_search(tmp_path, data, scan_mode):
+    """Elastic restore (any device count) returns the same neighbors as
+    the mesh search it was checkpointed from (distances to fp tolerance —
+    same cores, same merge, different compiled program), no mesh required (the single-chip serving path for a multi-shard
+    build)."""
+    x, q = data
+    comms = comms_mod.init_comms(axis="elastic_pq_" + scan_mode)
+    idx = sharded.build_ivf_pq(
+        comms, x, ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                     kmeans_n_iters=3),
+        res=Resources(seed=0), scan_mode=scan_mode)
+    sp = ivf_pq.SearchParams(n_probes=8)
+    d0, i0 = sharded.search_ivf_pq(idx, q, 10, sp)
+    prefix = str(tmp_path / f"el_{scan_mode}")
+    sharded.serialize_ivf_pq(idx, prefix)
+
+    el = sharded.deserialize_ivf_pq_elastic(prefix)
+    assert el.n_shards == comms.size
+    d1, i1 = el.search(q, 10, sp)
+    _assert_same_neighbors(d0, i0, d1, i1)
+
+    # recall floor vs the exact oracle (not just self-consistency)
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.stats import neighborhood_recall
+
+    _, gt = brute_force.knn(q, x, k=10, metric="sqeuclidean")
+    rec = float(neighborhood_recall(np.asarray(i1), np.asarray(gt)))
+    assert rec >= 0.8, rec
+
+
+def test_elastic_restore_with_overflow(tmp_path):
+    """Spilled rows (overflow blocks) survive elastic restore: force tiny
+    padded lists so some rows overflow, and require the elastic search to
+    still find them."""
+    rng = np.random.default_rng(9)
+    # one heavy cluster: most rows land in few lists -> list_pad caps and
+    # rows spill to the overflow block
+    x = np.concatenate([
+        rng.standard_normal((3000, 16)).astype(np.float32) * 0.05,
+        rng.standard_normal((1096, 16)).astype(np.float32) + 8.0,
+    ])
+    q = x[:24] + rng.standard_normal((24, 16)).astype(np.float32) * 0.01
+    comms = comms_mod.init_comms(axis="elastic_over")
+    idx = sharded.build_ivf_pq(
+        comms, x, ivf_pq.IndexParams(n_lists=32, pq_dim=8,
+                                     kmeans_n_iters=3),
+        res=Resources(seed=0), scan_mode="lut")
+    sp = ivf_pq.SearchParams(n_probes=32)
+    d0, i0 = sharded.search_ivf_pq(idx, q, 10, sp)
+    prefix = str(tmp_path / "el_over")
+    sharded.serialize_ivf_pq(idx, prefix)
+    el = sharded.deserialize_ivf_pq_elastic(prefix)
+    d1, i1 = el.search(q, 10, sp)
+    _assert_same_neighbors(d0, i0, d1, i1)

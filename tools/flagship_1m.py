@@ -1,19 +1,25 @@
-"""Flagship-scale smoke: 1M-row builds + sharded search (VERDICT r2 #4).
+"""Flagship-scale runs: 1M–100M-row builds + sharded search (VERDICT r2 #4).
 
-Nothing ≥1M rows had ever been executed before round 3 — this runs the
-DEEP-100M pipeline shape at 1/100 scale on whatever backend is active
-(CPU here; re-run on TPU via tools/TPU_RUNBOOK.md):
+Runs the DEEP-100M pipeline shape at configurable scale on whatever
+backend is active (CPU virtual mesh here; single real chip via the queue):
 
-  1. 1M×96 clustered fbin dataset written to disk,
+  1. clustered fbin dataset written to disk (reused across runs),
   2. streamed sharded IVF-PQ build (``build_ivf_pq_from_file``,
-     scan_mode="lut" — the DEEP-100M memory-lean engine) over an 8-device
-     mesh + SPMD LUT search, recall vs an exact oracle,
-  3. CAGRA build at 1M (ivf_pq graph path — fully device-resident since
-     r3) + search recall,
-with wall-clock and peak-RSS recorded into an artifact JSON.
+     scan_mode="lut" — the DEEP-100M memory-lean engine) over the device
+     mesh + SPMD LUT search — or, with ``--from-ckpt``, an ELASTIC restore
+     of a previous build's checkpoint on any device count
+     (``sharded.deserialize_ivf_pq_elastic``),
+  3. an nprobe sweep with optional exact host-gather refine
+     (``--refine-ratio``), reporting QPS@recall>=0.95 — the BASELINE.json
+     metric semantics (ref sweep: run/conf/deep-100M.json:252-340),
+  4. CAGRA build + search recall (skippable),
+with wall-clock and peak-RSS recorded incrementally into an artifact JSON.
 
-Usage: python tools/flagship_1m.py [--out FLAGSHIP_1M_cpu.json]
-       [--rows 1000000] [--skip-cagra]
+DEEP-100M per-chip slice (VERDICT r4 #4 — the dryrun-predicted shape):
+  python tools/flagship_1m.py --rows 12500000 --dim 96 --nlist 6250 \
+      --pq-dim 64 --pq-bits 5 --train-rows 1000000 --refine-ratio 4 \
+      --probes 20 50 100 200 --skip-cagra --data /tmp/deep_slice.fbin \
+      --out DEEP100M_SLICE_tpu.json
 """
 
 import argparse
@@ -48,8 +54,12 @@ def main():
     ap.add_argument("--data", default="/tmp/flagship_1m.fbin")
     # DEEP-100M shape dials (VERDICT r3 #4: 10M needs nlist 16384 to smoke
     # the assembly/probe-gather path within 3x of the reference's 50k
-    # lists, deep-100M.json:252-340)
+    # lists, deep-100M.json:252-340). NOTE --nlist is PER SHARD.
     ap.add_argument("--nlist", type=int, default=1024)
+    ap.add_argument("--pq-dim", type=int, default=0,
+                    help="PQ subspace count (0 -> dim/2; DEEP config: 64)")
+    ap.add_argument("--pq-bits", type=int, default=8,
+                    help="bits per code (DEEP config: 5)")
     ap.add_argument("--train-rows", type=int, default=200_000)
     ap.add_argument("--nprobes", type=int, default=64)
     ap.add_argument("--kmeans-iters", type=int, default=20)
@@ -58,6 +68,19 @@ def main():
                          "(capped at nlist) instead of the single "
                          "--nprobes point (each point re-times the "
                          "search; minutes per point on CPU)")
+    ap.add_argument("--probes", type=int, nargs="*", default=None,
+                    help="explicit nprobe sweep list (overrides "
+                         "--nprobes/--sweep)")
+    ap.add_argument("--refine-ratio", type=float, default=1.0,
+                    help=">1: exact re-rank of ceil(ratio*k) candidates "
+                         "per query, vectors host-gathered from the fbin "
+                         "(the DEEP-100M refine step; readback+gather "
+                         "cost is inside the timed region)")
+    ap.add_argument("--from-ckpt", default=None,
+                    help="skip the build: elastic-restore this sharded "
+                         "checkpoint prefix (works on any device count, "
+                         "e.g. an 8-virtual-shard CPU build on the one "
+                         "real chip) and run the sweep")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
@@ -90,7 +113,7 @@ def main():
             json.dump(art, f, indent=1)
         os.replace(args.out + ".tmp", args.out)
 
-    # ---- dataset on disk (chunked write keeps host RAM at one chunk)
+    # ---- dataset on disk
     rng = np.random.default_rng(0)
     t0 = time.monotonic()
     if not os.path.exists(args.data):
@@ -98,86 +121,173 @@ def main():
         native.write_bin(args.data, db)
     else:
         db = native.read_bin(args.data, 0, args.rows)
-    q = (db[rng.integers(0, args.rows, args.queries)]
-         + rng.standard_normal(
+    # queries use their OWN rng stream: drawing from the datagen rng made
+    # q depend on whether datagen ran (a rerun against an existing file
+    # skipped the datagen draws and silently produced different queries —
+    # fatal once the oracle is cached)
+    qrng = np.random.default_rng(1)
+    q = (db[qrng.integers(0, args.rows, args.queries)]
+         + qrng.standard_normal(
              (args.queries, args.dim)).astype(np.float32) * 0.01)
     art["datagen_s"] = round(time.monotonic() - t0, 1)
     print(f"datagen {art['datagen_s']}s rss={rss_gb()}GB", flush=True)
 
-    # ---- exact oracle
+    # ---- exact oracle (cached next to the dataset: q is deterministic
+    # given the data file + seeds, so a chip window never re-pays the
+    # CPU-priced oracle)
+    gt_cache = f"{args.data}.gt_r{args.rows}_k{args.k}_q{args.queries}.npy"
     t0 = time.monotonic()
-    _, gt = brute_force.knn(q, db, k=args.k, metric="sqeuclidean")
-    gt = np.asarray(gt)
-    art["oracle_s"] = round(time.monotonic() - t0, 1)
-    print(f"oracle {art['oracle_s']}s", flush=True)
-    save()
-
-    # ---- sharded streamed IVF-PQ build + SPMD LUT search
-    comms = comms_mod.init_comms(axis="flagship")
-    params = ivf_pq.IndexParams(n_lists=args.nlist,
-                                pq_dim=max(args.dim // 2, 8),
-                                kmeans_n_iters=args.kmeans_iters)
-    art["n_lists"] = args.nlist
-    t0 = time.monotonic()
-    idx = sharded.build_ivf_pq_from_file(
-        comms, args.data, params, res=Resources(seed=0),
-        scan_mode="lut", max_train_rows=args.train_rows)
-    _fence(idx.list_codes)
-    art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
-    art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])
-    n_over = (int(np.asarray(idx.overflow_indices >= 0).sum())
-              if idx.overflow_indices is not None else 0)
-    art["ivf_pq_overflow_rows"] = n_over
-    padded_slots = (idx.list_codes.shape[1] * idx.list_codes.shape[2]
-                    * comms.size
-                    + (idx.overflow_indices.shape[1] * comms.size
-                       if idx.overflow_indices is not None else 0))
-    art["padded_slots_over_raw"] = round(padded_slots / args.rows, 3)
-    print(f"sharded pq build {art['ivf_pq_sharded_build_s']}s "
-          f"pad={art['ivf_pq_list_pad']} overflow={n_over} "
-          f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
+    if os.path.exists(gt_cache):
+        gt = np.load(gt_cache)
+        art["oracle_s"] = 0.0
+        art["oracle_cached"] = True
+    else:
+        _, gt = brute_force.knn(q, db, k=args.k, metric="sqeuclidean")
+        gt = np.asarray(gt)
+        np.save(gt_cache, gt)
+        art["oracle_s"] = round(time.monotonic() - t0, 1)
+    print(f"oracle {art['oracle_s']}s (cached={art.get('oracle_cached', False)})",
           flush=True)
     save()
 
-    # checkpoint the build BEFORE searching: at 10M/16k-list scale the
-    # build is hours on this host — a bad search config must not cost a
-    # rebuild (sharded.serialize_ivf_pq, the r4 persistence path)
-    ckpt = args.data + ".ckpt"
-    try:
-        sharded.serialize_ivf_pq(idx, ckpt)
-        art["checkpoint"] = ckpt
-        print(f"checkpointed -> {ckpt}.rank*", flush=True)
-    except Exception as e:  # non-fatal: the run continues
-        art["checkpoint_error"] = repr(e)[:200]
+    # ---- index: elastic checkpoint restore OR sharded streamed build
+    if args.from_ckpt:
+        t0 = time.monotonic()
+        idx = sharded.deserialize_ivf_pq_elastic(args.from_ckpt)
+        if idx.n_rows != args.rows or idx.centers.shape[2] != args.dim:
+            raise SystemExit(
+                f"--from-ckpt {args.from_ckpt}: checkpoint is "
+                f"{idx.n_rows} rows x dim {idx.centers.shape[2]}, but "
+                f"--rows {args.rows} --dim {args.dim} — the oracle/refine "
+                f"would silently score against the wrong dataset slice; "
+                f"pass the checkpoint's own --rows/--dim")
+        _fence(idx.list_codes if idx.list_codes is not None
+               else idx.list_decoded)
+        art["restore_s"] = round(time.monotonic() - t0, 1)
+        art["from_ckpt"] = args.from_ckpt
+        art["ckpt_shards"] = idx.n_shards
+        art["n_lists"] = int(idx.centers.shape[1])
+        art["total_lists"] = int(idx.centers.shape[1]) * idx.n_shards
+        search_index = idx
+        search_fn = idx.search
+        print(f"elastic restore {art['restore_s']}s "
+              f"({idx.n_shards} shards x {art['n_lists']} lists) "
+              f"rss={rss_gb()}GB", flush=True)
+        save()
+    else:
+        comms = comms_mod.init_comms(axis="flagship")
+        params = ivf_pq.IndexParams(
+            n_lists=args.nlist,
+            pq_dim=args.pq_dim or max(args.dim // 2, 8),
+            pq_bits=args.pq_bits,
+            kmeans_n_iters=args.kmeans_iters)
+        art["n_lists"] = args.nlist
+        art["total_lists"] = args.nlist * comms.size
+        art["pq_dim"] = params.pq_dim
+        art["pq_bits"] = params.pq_bits
+        t0 = time.monotonic()
+        idx = sharded.build_ivf_pq_from_file(
+            comms, args.data, params, res=Resources(seed=0),
+            scan_mode="lut", max_train_rows=args.train_rows)
+        _fence(idx.list_codes)
+        art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
+        art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])
+        n_over = (int(np.asarray(idx.overflow_indices >= 0).sum())
+                  if idx.overflow_indices is not None else 0)
+        art["ivf_pq_overflow_rows"] = n_over
+        padded_slots = (idx.list_codes.shape[1] * idx.list_codes.shape[2]
+                        * comms.size
+                        + (idx.overflow_indices.shape[1] * comms.size
+                           if idx.overflow_indices is not None else 0))
+        art["padded_slots_over_raw"] = round(padded_slots / args.rows, 3)
+        print(f"sharded pq build {art['ivf_pq_sharded_build_s']}s "
+              f"pad={art['ivf_pq_list_pad']} overflow={n_over} "
+              f"slots/raw={art['padded_slots_over_raw']} rss={rss_gb()}GB",
+              flush=True)
+        save()
 
-    # q stays a host array: the sharded search shards it over the mesh
-    # itself, and a device-0-committed input would fight that placement
-    # (384 KB upload noise is negligible at this scale).
-    # nprobe sweep: at nlist≥16k a single point can't show the
-    # recall/QPS relationship (nprobe 64/16384 probes 0.4% of lists)
-    probes = (sorted({args.nprobes, 64, 256, 512, 1024})
-              if args.sweep else [args.nprobes])
-    # values above nlist clamp inside the search to identical configs —
-    # don't burn timed passes re-measuring the same point
-    probes = [p for p in probes if p <= args.nlist] or [args.nlist]
+        # checkpoint the build BEFORE searching: at 10M/16k-list scale the
+        # build is hours on this host — a bad search config must not cost a
+        # rebuild (sharded.serialize_ivf_pq, the r4 persistence path)
+        ckpt = args.data + ".ckpt"
+        try:
+            sharded.serialize_ivf_pq(idx, ckpt)
+            art["checkpoint"] = ckpt
+            print(f"checkpointed -> {ckpt}.rank*", flush=True)
+        except Exception as e:  # non-fatal: the run continues
+            art["checkpoint_error"] = repr(e)[:200]
+        search_index = idx
+
+        def search_fn(queries, k, sp):
+            return sharded.search_ivf_pq(search_index, queries, k, sp)
+
+    # ---- nprobe sweep (q stays a host array: the sharded search shards
+    # it over the mesh itself). At nlist>=16k a single point can't show
+    # the recall/QPS relationship (nprobe 64/16384 probes 0.4% of lists).
+    n_lists_cap = int(art["n_lists"])
+    if args.probes:
+        probes = sorted(set(args.probes))
+    elif args.sweep:
+        probes = sorted({args.nprobes, 64, 256, 512, 1024})
+    else:
+        probes = [args.nprobes]
+    # values above per-shard nlist clamp inside the search to identical
+    # configs — don't burn timed passes re-measuring the same point
+    probes = [p for p in probes if p <= n_lists_cap] or [n_lists_cap]
+
+    rr = float(args.refine_ratio)
+    k_search = int(np.ceil(args.k * rr)) if rr > 1.0 else args.k
+    data_mm = None
+    if rr > 1.0:
+        # host-gather refine source: the fbin body (8-byte header)
+        data_mm = np.memmap(args.data, np.float32, mode="r", offset=8,
+                            shape=(args.rows, args.dim))
+        art["refine_ratio"] = rr
+
+    def host_refine(cand: np.ndarray):
+        """Exact re-rank of [nq, k_search] candidate ids against the
+        memmapped vectors (the reference's refine step,
+        neighbors/refine-inl.cuh:70-100, host path refine_host-inl.hpp —
+        at 1000x40 candidates this is numpy-cheap even on 1 core)."""
+        safe = np.maximum(cand, 0)
+        vecs = data_mm[safe.ravel()].reshape(
+            cand.shape[0], cand.shape[1], args.dim)
+        d = ((q[:, None, :] - vecs) ** 2).sum(-1)
+        d[cand < 0] = np.inf
+        order = np.argsort(d, axis=1, kind="stable")[:, :args.k]
+        return np.take_along_axis(cand, order, axis=1)
+
     art["ivf_pq_sweep"] = []
     for npr in probes:
-        sp = ivf_pq.SearchParams(n_probes=npr, scan_mode="lut")
-        d, i = sharded.search_ivf_pq(idx, q, args.k, sp)  # compile + warm
+        # "auto" follows whichever engine the index holds (a cache-built
+        # checkpoint restored via --from-ckpt must not crash the sweep)
+        sp = ivf_pq.SearchParams(n_probes=npr, scan_mode="auto")
+        d, i = search_fn(q, k_search, sp)  # compile + warm
         _fence((d, i))
         t0 = time.monotonic()
-        d, i = sharded.search_ivf_pq(idx, q, args.k, sp)
-        _fence((d, i))
+        d, i = search_fn(q, k_search, sp)
+        if rr > 1.0:
+            ids = host_refine(np.asarray(i))
+        else:
+            _fence((d, i))
+            ids = np.asarray(i)
         dt = time.monotonic() - t0
         row = {"nprobe": npr, "qps": round(args.queries / dt, 1),
                "recall": round(
-                   float(neighborhood_recall(np.asarray(i), gt)), 4)}
+                   float(neighborhood_recall(ids[:, :args.k], gt)), 4)}
+        if rr > 1.0:
+            row["refine_ratio"] = rr
         art["ivf_pq_sweep"].append(row)
         save()
         print(f"sharded lut search {row}", flush=True)
     best = max(art["ivf_pq_sweep"], key=lambda r: r["recall"])
     art["ivf_pq_sharded_qps"] = best["qps"]
     art["ivf_pq_sharded_recall"] = best["recall"]
+    # the BASELINE.json operating point: fastest sweep row at recall>=0.95
+    at95 = [r for r in art["ivf_pq_sweep"] if r["recall"] >= 0.95]
+    art["qps_at_recall_0_95"] = (max(r["qps"] for r in at95)
+                                 if at95 else None)
+    save()
 
     # ---- CAGRA build at 1M (device-resident ivf_pq graph path)
     if not args.skip_cagra:
