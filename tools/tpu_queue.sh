@@ -1,13 +1,16 @@
 #!/bin/bash
-# Unattended on-chip benchmark queue (round 4). Waits for the axon tunnel
-# (probed by /tmp/tpu_watch.sh -> /tmp/tpu_up), then runs the pending
-# hardware jobs sequentially (ONE TPU process at a time), each with its
-# own log + artifact. Survives tunnel drops: every step re-probes first
-# and a failed step doesn't block later ones on the next window.
+# Unattended on-chip benchmark queue (round 5). Waits for the axon tunnel
+# (self-probed), then runs the pending hardware jobs sequentially (ONE TPU
+# process at a time), each with its own log + artifact. Survives tunnel
+# drops: every step re-probes first and a failed step doesn't block later
+# ones on the next window.
 #
-# Round-4 ordering (VERDICT r3): highest-value artifacts first so a short
-# window still lands (1) an on-chip test gate, (2) the headline number,
-# (3) the select_k SCREEN measurement that decides the round's perf fix.
+# Round-5 ordering (VERDICT r4 "Next round: do this"): convert
+# built-and-queued into measured-on-chip. A short window must land, in
+# order: the post-fix headline (driver-visible), the on-chip gate tier,
+# the k-pad map, then the round's headline deliverable — TPU rows for the
+# sift-1M pareto — before the long sweeps. Every long step writes its
+# artifact incrementally, so timeout kills keep completed rows.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
@@ -33,72 +36,82 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
   fi
 }
 
-# 1. headline benchmark on chip (the BENCH_r04 dress rehearsal) — FIRST:
-#    a short late window must land the driver-visible number before
-#    anything long runs
-run_step bench  /tmp/q_bench.done  timeout 1800 python bench.py
+# 1. headline benchmark on chip — FIRST: the driver-visible number, now
+#    with the tile-balance fix + k-pad builtin it has never been measured
+#    with (r4 on-chip 61.3k predates both; CPU A/B measured 1.8x)
+run_step bench  /tmp/q5_bench.done  timeout 1800 python bench.py
 
-# 2. pointwise top_k (n, k) map -> k-pad rules (the (4096, k=10) 50x
-# pathology reproduced in r3+r4; exact fix is top_k(k')[:k], consumed by
-# select_k._direct via TOPK_PAD_tpu.json at the repo root). BEFORE the
-# long selectk sweep: the last window was 21 minutes, and this ~25-min
-# incremental probe directly feeds the headline's select cost.
-run_step kprobe /tmp/q_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
-  timeout 3600 python tools/topk_k_probe.py
-
-# 3. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
-#    failure classes the CPU suite provably cannot see
-run_step tputests /tmp/q_tputests.done timeout 2700 \
+# 2. on-chip recall/numerics gates (tests_tpu/): the bf16/fp8/approx
+#    failure classes the CPU suite provably cannot see (VERDICT weak #6)
+run_step tputests /tmp/q5_tputests.done timeout 2700 \
   python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
 
-# 4. select_k crossover sweep incl. SCREEN + APPROX (decides the round's
-#    top perf fix; feeds AUTO via the nested crossovers table)
-# (IVF-critical widths first: the artifact now writes incrementally, so
-# a timeout kill keeps the rows that matter; measured ~4 min/row over
-# the tunnel -> 30 rows ~ 2 h, hence the 3 h budget with upload headroom)
-run_step selectk /tmp/q_selectk.done env RAFT_TPU_BENCH_PLATFORM=default \
+# 3. pointwise top_k (n, k) map -> measured k-pad rules, incremental per
+#    cell (ADVICE r4: partial widths now merge instead of re-measuring)
+run_step kprobe /tmp/q5_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 3600 python tools/topk_k_probe.py
+
+# 4. sift-1M pareto — THE round-5 headline (VERDICT #1): TPU rows at 1M
+#    against the banked CPU rivals. Rows append incrementally; --resume
+#    keys on (name, search_param) so a killed entry finishes its missing
+#    points on the next window. CPU rivals are pre-run off-window.
+run_step pareto /tmp/q5_pareto.done timeout 9000 python -m raft_tpu.bench run \
+  --conf raft_tpu/bench/conf/sift-128-euclidean.json --resume \
+  --algos raft \
+  --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
+
+# 5. select_k crossover sweep incl. SCREEN + APPROX (VERDICT #3: only a
+#    COMPLETE grid emits the crossovers key that lets AUTO pick SCREEN)
+run_step selectk /tmp/q5_selectk.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 10800 python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json \
   --widths 16384 32768 4096 65536 131072 262144
 
-# 4b. headline again with the measured table active: if SCREEN wins, this
+# 5b. headline again with the measured table active: if SCREEN wins, this
 #    is the number that should become the committed default
-run_step bench_screen /tmp/q_bench_screen.done \
+run_step bench_screen /tmp/q5_bench_screen.done \
   env RAFT_TPU_SELECTK_TABLE=/root/repo/SELECT_K_TABLE_tpu.json \
   timeout 1800 python bench.py
 
-# 5. batch-1/10 latency decomposition (dispatch vs on-chip; VERDICT #6)
-run_step latency /tmp/q_latency.done timeout 2400 \
+# 6. DEEP-100M per-chip slice (VERDICT #4): 12.5M x 96, pq_bits=5,
+#    nlist=6250 — the dryrun-predicted single-chip share of the north
+#    star. Dataset + oracle are pre-built off-window; the window pays
+#    build + sweep only. Artifact written incrementally per phase.
+run_step deepslice /tmp/q5_deepslice.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 7200 python tools/flagship_1m.py --rows 12500000 --dim 96 \
+  --nlist 6250 --pq-dim 64 --pq-bits 5 --train-rows 1000000 \
+  --refine-ratio 4 --probes 20 50 100 200 500 1000 --skip-cagra \
+  --data /tmp/deep_slice.fbin --out DEEP100M_SLICE_tpu.json
+
+# 7. batch-1/10 latency decomposition (dispatch vs on-chip; VERDICT #8)
+run_step latency /tmp/q5_latency.done timeout 2400 \
   python tools/latency_profile.py --out LATENCY_TPU.json
 
-# 6. cagra sweep at recall 0.95 operating points (VERDICT #3)
-run_step cagra  /tmp/q_cagra.done  timeout 3600 \
+# 8. cagra sweep at recall-0.95 operating points (VERDICT #5: close the
+#    3.5x gap to ivf_pq or prove it structural; verifies the width>1
+#    "sort:compare inverts on TPU" bet)
+run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
   python tools/bench_ann.py cagra 100000
 
-# 7. sift-1M pareto (fp32/bf16/fp8 LUTs + approx + screen points)
-# (rows append to the JSONL incrementally, so even a timeout kill keeps
-# the completed points. --resume: the CPU baselines — the slow tail —
-# are pre-run OFF-window into the same JSONL, so window time goes to
-# the accelerator algos only; re-runs after a drop skip finished rows)
-run_step pareto /tmp/q_pareto.done timeout 9000 python -m raft_tpu.bench run \
-  --conf raft_tpu/bench/conf/sift-128-euclidean.json --resume \
-  --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
+# 9. 10M flagship at the 0.95 operating point (VERDICT #9): elastic
+#    restore of the committed 8-shard CPU build on the one chip (no
+#    rebuild), nprobe sweep + exact refine; GT cache pre-built off-window
+run_step flagship10m /tmp/q5_flagship10m.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/flagship_1m.py --rows 10000000 --dim 96 \
+  --data /tmp/flagship_10m.fbin --from-ckpt /tmp/flagship_10m.fbin.ckpt \
+  --refine-ratio 4 --probes 32 64 128 256 512 1024 --skip-cagra \
+  --out FLAGSHIP_10M_tpu.json
 
-# 8. chip-scale baseline targets (BASELINE.md rows at single-chip shapes)
-run_step targets /tmp/q_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
+# 10. chip-scale baseline targets (BASELINE.md rows at single-chip shapes)
+run_step targets /tmp/q5_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
   timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
 
-# 9/10. decide the Pallas + AOT stories with on-chip data (VERDICT #8)
-run_step pallas /tmp/q_pallas.done timeout 1800 python tools/pallas_probe.py
-run_step aot /tmp/q_aot.done timeout 1800 python tools/aot_cache_probe.py
+# 11/12. decide the Pallas + AOT stories with on-chip data (VERDICT #7:
+#    two rounds is enough — flip a default or delete with the number)
+run_step pallas /tmp/q5_pallas.done timeout 1800 python tools/pallas_probe.py
+run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
-# 11. 1M-row sharded-build flagship on chip
-run_step flagship /tmp/q_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
-  timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json
-
-# 12. 10M-row flagship at nlist 16384 (VERDICT r3 #4) — minutes on chip,
-#     hours on this 1-core host; the queue runs it on hardware when a
-#     window allows
-run_step flagship10m /tmp/q_flagship10m.done env RAFT_TPU_BENCH_PLATFORM=default \
-  timeout 9000 python tools/flagship_1m.py --rows 10000000 --nlist 16384 \
-  --train-rows 800000 --data /tmp/flagship_10m.fbin --out FLAGSHIP_10M_tpu.json
+# 13. 1M-row sharded-build flagship on chip (build_s at 1M on hardware)
+run_step flagship /tmp/q5_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json \
+  --data /tmp/flagship_1m.fbin
 state "queue complete"
