@@ -1,0 +1,93 @@
+#!/bin/bash
+# Round-5 queue, reordered mid-round (session 1): the first window landed
+# bench + tputests + kprobe + (pareto in flight). Remaining steps run
+# short/high-value/non-resumable first; the multi-hour resumable select_k
+# sweep moves last so a dying window can't starve the unique artifacts
+# (DEEP-100M slice, latency decomposition, cagra sweep, pallas/aot
+# verdicts). Markers are shared with tpu_queue.sh v1.
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:${PYTHONPATH:-}
+LOG=/tmp/tpu_queue.log
+state() { date -u +"%H:%M:%SZ $*" >> "$LOG"; }
+
+probe() { timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+
+wait_up() {
+  while ! probe; do state "tunnel down; sleeping"; sleep 300; done
+  state "tunnel up"
+}
+
+run_step() {  # run_step <name> <done-marker-file> <cmd...>
+  local name=$1 marker=$2; shift 2
+  [ -f "$marker" ] && return 0
+  wait_up
+  state "start $name"
+  if "$@" > "/tmp/q_$name.log" 2>&1; then
+    touch "$marker"; state "done $name"
+  else
+    state "FAIL $name (rc=$?)"
+  fi
+}
+
+# v3: pareto resumes FIRST (LUT params pulled after 2x TPU worker crash)
+run_step bench  /tmp/q5_bench.done  timeout 1800 python bench.py
+run_step tputests /tmp/q5_tputests.done timeout 2700 \
+  python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
+run_step kprobe /tmp/q5_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 3600 python tools/topk_k_probe.py
+
+# sift-1M pareto — the round-5 headline; --resume completes missing points
+run_step pareto /tmp/q5_pareto.done timeout 9000 python -m raft_tpu.bench run \
+  --conf raft_tpu/bench/conf/sift-128-euclidean.json --resume \
+  --algos raft \
+  --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
+
+# DEEP-100M per-chip slice (VERDICT #4) — unique, can't be recovered from
+# a partial run as cheaply as the sweeps; data pre-generated off-window
+run_step deepslice /tmp/q5_deepslice.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 7200 python tools/flagship_1m.py --rows 12500000 --dim 96 \
+  --nlist 6250 --pq-dim 64 --pq-bits 5 --train-rows 1000000 \
+  --refine-ratio 4 --probes 20 50 100 200 500 1000 --skip-cagra \
+  --data /tmp/deep_slice.fbin --out DEEP100M_SLICE_tpu.json
+
+# batch-1/10 latency decomposition (VERDICT #8) — quick
+run_step latency /tmp/q5_latency.done timeout 2400 \
+  python tools/latency_profile.py --out LATENCY_TPU.json
+
+# cagra sweep at recall-0.95 operating points (VERDICT #5) — quick-ish
+run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
+  python tools/bench_ann.py cagra 100000
+
+# pallas + aot verdicts (VERDICT #7) — quick, settles two-round limbo
+run_step pallas /tmp/q5_pallas.done timeout 1800 python tools/pallas_probe.py
+run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
+
+# 10M flagship at 0.95 (VERDICT #9): restart-lost checkpoint -> fresh
+# single-chip build from the pre-generated fbin (minutes on chip)
+run_step flagship10m2 /tmp/q5_flagship10m2.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 7200 python tools/flagship_1m.py --rows 10000000 --dim 96 \
+  --nlist 16384 --train-rows 1000000 --data /tmp/flagship_10m.fbin \
+  --refine-ratio 4 --probes 32 64 128 256 512 1024 --skip-cagra \
+  --out FLAGSHIP_10M_tpu.json
+
+# chip-scale baseline targets (BASELINE.md rows)
+run_step targets /tmp/q5_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/baseline_targets.py --scale chip --out BENCH_TARGETS_tpu.json
+
+# select_k crossover sweep — LONG but fully resumable (incremental rows);
+# only a COMPLETE grid emits the crossovers that let AUTO pick SCREEN
+run_step selectk /tmp/q5_selectk.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 10800 python tools/select_k_bench.py --out SELECT_K_TABLE_tpu.json \
+  --widths 16384 32768 4096 65536 131072 262144
+
+# headline re-run with measured tables active (clean host, no datagen)
+run_step bench_screen /tmp/q5_bench_screen.done \
+  env RAFT_TPU_SELECTK_TABLE=/root/repo/SELECT_K_TABLE_tpu.json \
+  timeout 1800 python bench.py
+
+# 1M-row sharded-build flagship on chip
+run_step flagship /tmp/q5_flagship.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 5400 python tools/flagship_1m.py --out FLAGSHIP_1M_tpu.json \
+  --data /tmp/flagship_1m.fbin
+state "queue complete"
