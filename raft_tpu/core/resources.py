@@ -157,6 +157,46 @@ class Resources:
             jax.effects_barrier()
 
 
+def solve_joint_tiles(
+    budget_bytes: int,
+    bytes_per_cell: int,
+    inner_max: int,
+    outer_cap: int = 256,
+    outer_multiple: int = 8,
+) -> tuple:
+    """Jointly size an (outer_tile, inner_tile) loop nest so the peak live
+    set ``outer_tile * inner_tile * bytes_per_cell`` stays within
+    ``budget_bytes`` (the workspace analog of the reference's
+    limiting_memory_resource sizing batch loops).
+
+    ``bytes_per_cell`` is the caller's accounting of the TRUE peak live
+    set per (outer, inner) cell — every simultaneously-live intermediate,
+    not just the largest named array. The solve prefers the full inner
+    extent (no inner loop) with the largest outer tile; when even a
+    minimal outer tile cannot hold the full inner extent it shrinks the
+    inner tile instead, and degrades to (1, 1) only when a single cell
+    exceeds the budget (the loop still runs; past that point the budget
+    is a target, not a guarantee).
+
+    Returns ``(outer_tile, inner_tile)`` with ``outer_tile`` a multiple of
+    ``outer_multiple`` (when >= it) capped at ``outer_cap``, and
+    ``1 <= inner_tile <= inner_max``.
+    """
+    budget = max(int(budget_bytes), 1)
+    cell = max(int(bytes_per_cell), 1)
+    inner_max = max(int(inner_max), 1)
+    outer = budget // (cell * inner_max)
+    if outer >= outer_multiple:
+        outer = min(outer, outer_cap)
+        outer -= outer % outer_multiple
+        return outer, inner_max
+    # the full inner extent does not fit even a lane-aligned outer tile:
+    # tile the inner loop so the peak is [outer, inner_tile, ...]
+    outer = outer_multiple if budget // (outer_multiple * cell) >= 1 else 1
+    inner = int(np.clip(budget // (outer * cell), 1, inner_max))
+    return outer, inner
+
+
 _default_resources: Optional[Resources] = None
 _default_lock = threading.Lock()
 

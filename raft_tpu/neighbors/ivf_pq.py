@@ -45,7 +45,8 @@ import numpy as np
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.bitset import filter_mask as bitset_filter_mask
-from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.resources import (Resources, ensure_resources,
+                                     solve_joint_tiles)
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
@@ -928,14 +929,27 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
                      has_filter: bool, lut_dtype, dist_dtype,
                      overflow_decoded=None, overflow_norms=None,
                      overflow_indices=None, has_overflow: bool = False,
-                 select_recall: float = 1.0):
+                 select_recall: float = 1.0, probe_tile: int = 0):
     """LUT-engine scan over packed codes (traceable core — also runs inside
-    ``shard_map`` for the memory-lean sharded search, parallel/sharded.py)."""
+    ``shard_map`` for the memory-lean sharded search, parallel/sharded.py).
+
+    ``probe_tile`` bounds the peak scan intermediate: 0 or >= ``n_probes``
+    scans all probed lists of a query tile in one pass (the original
+    shape, peak [q_tile, n_probes, list_pad, …]); otherwise probes are
+    processed in ``probe_tile``-wide chunks under ``lax.scan`` with a
+    running top-k carry merged through the existing ``select_k`` machinery
+    (the TPU analog of the GPU kernel's per-CTA probe loop), so the peak
+    is [q_tile, probe_tile, list_pad, …] regardless of n_probes. Distance
+    VALUES are bit-identical to the single-pass shape (each candidate's
+    contraction is elementwise the same); only tie ORDER among equal
+    distances can differ, because the running merge re-ranks ties by
+    carry position rather than global flat index."""
     nq, dim = queries.shape
     n_lists, list_pad, _ = list_codes.shape
     pq_len = codebooks.shape[2]
     book = codebooks.shape[1]
     minimize = metric != DistanceType.InnerProduct
+    p_tile = probe_tile if 0 < probe_tile < n_probes else n_probes
 
     def _sel(vals, kk, sel_min):
         return select_k_maybe_approx(vals, kk, sel_min, select_recall)
@@ -973,71 +987,113 @@ def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
             coarse = cn[None, :] - 2.0 * dots_c  # + ||q||² (rank-invariant)
             _, probes = _sel(coarse, n_probes, True)
         # [t, P]
-
-        # ---- LUT per (query, probe): [t, P, pq_dim, book]
-        qr_res = q_rot[:, None, :] - centers_rot[probes]  # [t, P, rot]
-        if metric == DistanceType.InnerProduct:
-            qr_res = jnp.broadcast_to(q_rot[:, None, :], qr_res.shape)
-        sub = qr_res.reshape(qt.shape[0], n_probes, pq_dim, pq_len)
-        if per_cluster:
-            cb_p = codebooks[probes]  # [t, P, book, l]
-            dots = jnp.einsum("tpsl,tpcl->tpsc", sub, cb_p,
-                              preferred_element_type=jnp.float32)
-            cbn = cb_norms[probes][:, :, None, :]  # [t, P, 1, book]
-        else:
-            dots = jnp.einsum("tpsl,scl->tpsc", sub, codebooks,
-                              preferred_element_type=jnp.float32)
-            cbn = cb_norms[None, None, :, :]  # [1, 1, s, book]
-        if metric == DistanceType.InnerProduct:
-            # score = q·center + Σ_s q_sub·cb[code_s]
-            lut = dots
-            base = jnp.take_along_axis(
-                dots_c, probes, axis=1)  # [t, P] — q·center term
-        else:
-            # ||q−center−decode||² = ||q_res||² − 2 q_res·cb + ||cb||²
-            qn = jnp.sum(qr_res * qr_res, -1)  # [t, P]
-            lut = cbn - 2.0 * dots
-            base = qn
-        if str(lut_dtype) in ("float8_e4m3fn", "float8_e5m2"):
-            # fp8 LUT with per-subspace max-abs scaling (the reference's
-            # fp_8bit offset/scale normalization, detail/ivf_pq_fp_8bit.cuh)
-            lut_scale = jnp.maximum(
-                jnp.max(jnp.abs(lut), axis=-1), 1e-30)  # [t, P, s]
-            lut = (lut / lut_scale[..., None]).astype(lut_dtype)
-        else:
-            lut_scale = None
-            lut = lut.astype(lut_dtype)
-
-        # ---- gather probed lists and scan codes
-        g_codes = list_codes[probes]  # [t, P, pad, n_bytes] u8
-        g_idx = list_indices[probes]  # [t, P, pad]
-        g_valid = valid_slot[probes]
-        codes = _unpack_codes(g_codes, pq_dim, pq_bits)  # [t,P,pad,s]
-        # flat-LUT gather: score contribution LUT[t,P,s,code]
-        flat_lut = lut.reshape(qt.shape[0], n_probes, pq_dim * book)
-        gidx = codes + (jnp.arange(pq_dim) * book)[None, None, None, :]
-        gather_dtype = dist_dtype if lut_scale is None else flat_lut.dtype
-        contrib = jnp.take_along_axis(
-            flat_lut[:, :, None, :].astype(gather_dtype),
-            gidx.reshape(qt.shape[0], n_probes, list_pad * pq_dim)[:, :, None, :],
-            axis=-1,
-        ).reshape(qt.shape[0], n_probes, list_pad, pq_dim)
-        if lut_scale is not None:
-            # de-scale fp8 contributions per subspace before accumulating
-            contrib = contrib.astype(dist_dtype) * lut_scale[
-                :, :, None, :].astype(dist_dtype)
-        d = jnp.sum(contrib.astype(dist_dtype), axis=-1).astype(jnp.float32)
-        d = d + base[:, :, None]
-
         bad_fill = jnp.inf if minimize else -jnp.inf
-        ok = g_valid
-        if has_filter:
-            ok = ok & bitset_filter_mask(g_idx, filter_words)
-        d = jnp.where(ok, d, bad_fill)
 
-        n_cand = n_probes * list_pad
-        flat_d = d.reshape(qt.shape[0], n_cand)
-        flat_i = g_idx.reshape(qt.shape[0], n_cand)
+        def probe_block(probes_blk, probe_ok):
+            """LUT build + code scan of one probe chunk ``probes_blk``
+            [t, pt] → (distances [t, pt, pad], ids [t, pt, pad]).
+            ``probe_ok`` masks the scan-padding probes of the last chunk
+            (None when every probe is real)."""
+            pt = probes_blk.shape[1]
+            # ---- LUT per (query, probe): [t, pt, pq_dim, book]
+            qr_res = q_rot[:, None, :] - centers_rot[probes_blk]
+            if metric == DistanceType.InnerProduct:
+                qr_res = jnp.broadcast_to(q_rot[:, None, :], qr_res.shape)
+            sub = qr_res.reshape(qt.shape[0], pt, pq_dim, pq_len)
+            if per_cluster:
+                cb_p = codebooks[probes_blk]  # [t, pt, book, l]
+                dots = jnp.einsum("tpsl,tpcl->tpsc", sub, cb_p,
+                                  preferred_element_type=jnp.float32)
+                cbn = cb_norms[probes_blk][:, :, None, :]
+            else:
+                dots = jnp.einsum("tpsl,scl->tpsc", sub, codebooks,
+                                  preferred_element_type=jnp.float32)
+                cbn = cb_norms[None, None, :, :]  # [1, 1, s, book]
+            if metric == DistanceType.InnerProduct:
+                # score = q·center + Σ_s q_sub·cb[code_s]
+                lut = dots
+                base = jnp.take_along_axis(
+                    dots_c, probes_blk, axis=1)  # [t, pt] — q·center term
+            else:
+                # ||q−center−decode||² = ||q_res||² − 2 q_res·cb + ||cb||²
+                qn = jnp.sum(qr_res * qr_res, -1)  # [t, pt]
+                lut = cbn - 2.0 * dots
+                base = qn
+            if str(lut_dtype) in ("float8_e4m3fn", "float8_e5m2"):
+                # fp8 LUT with per-subspace max-abs scaling (the
+                # reference's fp_8bit offset/scale normalization,
+                # detail/ivf_pq_fp_8bit.cuh)
+                lut_scale = jnp.maximum(
+                    jnp.max(jnp.abs(lut), axis=-1), 1e-30)  # [t, pt, s]
+                lut = (lut / lut_scale[..., None]).astype(lut_dtype)
+            else:
+                lut_scale = None
+                lut = lut.astype(lut_dtype)
+
+            # ---- gather probed lists and scan codes
+            g_codes = list_codes[probes_blk]  # [t, pt, pad, n_bytes] u8
+            g_idx = list_indices[probes_blk]  # [t, pt, pad]
+            g_valid = valid_slot[probes_blk]
+            codes = _unpack_codes(g_codes, pq_dim, pq_bits)  # [t,pt,pad,s]
+            # flat-LUT gather: score contribution LUT[t,pt,s,code]
+            flat_lut = lut.reshape(qt.shape[0], pt, pq_dim * book)
+            gidx = codes + (jnp.arange(pq_dim) * book)[None, None, None, :]
+            gather_dtype = dist_dtype if lut_scale is None else flat_lut.dtype
+            contrib = jnp.take_along_axis(
+                flat_lut[:, :, None, :].astype(gather_dtype),
+                gidx.reshape(qt.shape[0], pt, list_pad * pq_dim)[:, :, None, :],
+                axis=-1,
+            ).reshape(qt.shape[0], pt, list_pad, pq_dim)
+            if lut_scale is not None:
+                # de-scale fp8 contributions per subspace before
+                # accumulating
+                contrib = contrib.astype(dist_dtype) * lut_scale[
+                    :, :, None, :].astype(dist_dtype)
+            d = jnp.sum(contrib.astype(dist_dtype),
+                        axis=-1).astype(jnp.float32)
+            d = d + base[:, :, None]
+
+            ok = g_valid
+            if has_filter:
+                ok = ok & bitset_filter_mask(g_idx, filter_words)
+            if probe_ok is not None:
+                ok = ok & probe_ok[None, :, None]
+                g_idx = jnp.where(probe_ok[None, :, None], g_idx, -1)
+            d = jnp.where(ok, d, bad_fill)
+            return d, g_idx
+
+        if p_tile == n_probes:
+            d, g_idx = probe_block(probes, None)
+            n_cand = n_probes * list_pad
+            flat_d = d.reshape(qt.shape[0], n_cand)
+            flat_i = g_idx.reshape(qt.shape[0], n_cand)
+        else:
+            # probe-tile loop: running top-kk merge keeps the peak live
+            # set at [t, p_tile, pad, …] however many lists are probed
+            n_pt = cdiv(n_probes, p_tile)
+            pp = n_pt * p_tile
+            probes_p = jnp.pad(probes, ((0, 0), (0, pp - n_probes)))
+            ok_p = (jnp.arange(pp) < n_probes).reshape(n_pt, p_tile)
+            blocks = jnp.moveaxis(
+                probes_p.reshape(qt.shape[0], n_pt, p_tile), 1, 0)
+            kk = min(k, n_probes * list_pad)
+
+            def step(carry, xs):
+                cv, ci = carry
+                pr, okb = xs
+                d, gi = probe_block(pr, okb)
+                cand_v = jnp.concatenate(
+                    [cv, d.reshape(d.shape[0], -1)], axis=1)
+                cand_i = jnp.concatenate(
+                    [ci, gi.reshape(gi.shape[0], -1)], axis=1)
+                v, sel = _sel(cand_v, kk, minimize)
+                return (v, jnp.take_along_axis(cand_i, sel, axis=1)), None
+
+            init = (jnp.full((qt.shape[0], kk), bad_fill, jnp.float32),
+                    jnp.full((qt.shape[0], kk), -1, jnp.int32))
+            (flat_d, flat_i), _ = jax.lax.scan(step, init, (blocks, ok_p))
+            n_cand = kk
+
         if has_overflow:
             od, oi = _pq_overflow_scan(q_rot, overflow_decoded,
                                        overflow_norms, overflow_indices,
@@ -1069,8 +1125,58 @@ _search_jit = jax.jit(
     _search_lut_core,
     static_argnames=("metric", "k", "n_probes", "q_tile", "per_cluster",
                      "pq_dim", "pq_bits", "has_filter", "lut_dtype",
-                     "dist_dtype", "has_overflow", "select_recall"),
+                     "dist_dtype", "has_overflow", "select_recall",
+                     "probe_tile"),
 )
+
+
+def lut_bytes_per_query_probe(list_pad: int, pq_dim: int, pq_bits: int,
+                              lut_itemsize: int = 4,
+                              dist_itemsize: int = 4) -> int:
+    """TRUE peak live-set bytes of the LUT scan body per (query, probe).
+
+    The pre-fix estimate counted only the LUT ``[t, P, s, book]`` and the
+    packed-code gather — NOT the unpack intermediates (lo_b/hi_b/word
+    int32, three ``[t, P, list_pad, pq_dim]`` arrays from the two-byte
+    gather) or the score-gather temporaries (flat-LUT gather index +
+    per-subspace contributions), which dominate as ``list_pad`` grows
+    with n and are exactly what blew HBM at 1M rows (LUT_CRASH_tpu.json:
+    q_tile solved from ~1/5 of the real footprint → a ~19 GB live set on
+    a 16 GB chip). Itemized per (query, probe):
+
+      LUT build   pq_dim·book·(4 + 4 + lut_itemsize)   dots + lut f32 + cast
+      code gather list_pad·n_code_bytes                packed u8 rows
+      unpack      list_pad·pq_dim·3·4                  lo_b, hi_b, word i32
+      score       list_pad·pq_dim·(4 + dist_itemsize)  gather idx + contrib
+      reduce      list_pad·(4 + 4 + 1)                 d f32, ids i32, valid
+    """
+    book = 1 << pq_bits
+    n_code_bytes = pq_dim * pq_bits // 8
+    return (pq_dim * book * (8 + lut_itemsize)
+            + list_pad * n_code_bytes
+            + list_pad * pq_dim * 12
+            + list_pad * pq_dim * (4 + dist_itemsize)
+            + list_pad * 9)
+
+
+def plan_lut_tiles(n_probes: int, list_pad: int, pq_dim: int, pq_bits: int,
+                   workspace_limit_bytes: int, lut_itemsize: int = 4,
+                   dist_itemsize: int = 4) -> Tuple[int, int]:
+    """Jointly solve (q_tile, probe_tile) for the LUT engine from the
+    workspace budget so the scan is memory-bounded BY CONSTRUCTION: the
+    peak intermediate is [q_tile, probe_tile, list_pad, …] and
+    ``q_tile · probe_tile · lut_bytes_per_query_probe(...)`` fits the
+    budget (full n_probes preferred; the probe-tile loop engages only
+    when even an 8-query tile cannot hold all probes at once)."""
+    per_qp = lut_bytes_per_query_probe(list_pad, pq_dim, pq_bits,
+                                       lut_itemsize, dist_itemsize)
+    q_tile, probe_tile = solve_joint_tiles(
+        workspace_limit_bytes, per_qp, n_probes, outer_cap=256)
+    if 1 < probe_tile < n_probes:
+        # balance the probe grid (a 7-wide tile over 20 probes would pay
+        # a 6/7-padding last chunk; cf. shape.balanced_tile)
+        probe_tile = balanced_tile(n_probes, probe_tile, 1)
+    return q_tile, probe_tile
 
 
 def resolve_scan_mode(n_lists: int, list_pad: int, rot_dim: int,
@@ -1090,7 +1196,13 @@ def resolve_scan_mode(n_lists: int, list_pad: int, rot_dim: int,
                 unknown-backend fallback).
     Choose the decoded-cache engine only when packed + cache fit the
     budget; otherwise the LUT engine, which keeps only packed codes
-    resident.
+    resident. The LUT engine is safe as the fallback at ANY index size:
+    its scan workspace is bounded by construction — ``plan_lut_tiles``
+    solves (q_tile, probe_tile) from the true peak live set
+    (``lut_bytes_per_query_probe``), so the per-dispatch intermediate is
+    [q_tile, probe_tile, list_pad, …] no matter how large n·n_probes
+    grow (the 1M-row TPU-worker crash, LUT_CRASH_tpu.json, was the old
+    one-axis q_tile solve under-counting that live set ~5×).
 
     DEEP-100M flagship shapes (deep-100M.json:252 — n=1e8, nlist=50000,
     pq_dim=96→rot_dim=96, pq_bits=8, bf16 cache): packed ≈ 1e8·(96+4)·1.5
@@ -1167,12 +1279,15 @@ def search(
             select_recall=float(params.select_recall),
         )
         return v[:nq], i[:nq]
-    # workspace: LUT [t,P,s,book] fp32 + gathered codes [t,P,pad,bytes]
-    per_q = n_probes * (index.pq_dim * index.pq_book_size * 4
-                        + list_pad * (index.pq_dim * 4 + 16))
-    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 256))
-    if q_tile >= 8:
-        q_tile -= q_tile % 8
+    # workspace: the TRUE peak live set of the scan body (LUT build +
+    # code gather + unpack/score temporaries — lut_bytes_per_query_probe),
+    # solved jointly into (q_tile, probe_tile) so the engine never
+    # materializes more than the budget however large n·n_probes grow
+    q_tile, probe_tile = plan_lut_tiles(
+        n_probes, list_pad, index.pq_dim, index.pq_bits,
+        res.workspace_limit_bytes,
+        jnp.dtype(params.lut_dtype).itemsize,
+        jnp.dtype(params.internal_distance_dtype).itemsize)
     per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
     v, i = _search_jit(
         queries, index.centers, index.rotation, index.codebooks,
@@ -1185,6 +1300,7 @@ def search(
         index.overflow_decoded, index.overflow_norms,
         index.overflow_indices, has_overflow,
         select_recall=float(params.select_recall),
+        probe_tile=probe_tile,
     )
     return v[:nq], i[:nq]
 

@@ -160,20 +160,35 @@ _pad_rules_cache: Optional[dict] = None
 # The one cell measured pathological in BOTH hardware sessions (r3:
 # 112.4 ms, r4: 119.7 ms for batch 2048 — vs 1.7-2.3 ms at k=32, same
 # width, same sessions). Shipped as a builtin so the fix holds even
-# when no TOPK_PAD artifact has been produced; a measured artifact for
-# the platform replaces this entirely (artifact wins in _scan_artifacts).
+# when no TOPK_PAD artifact has been produced. Artifacts MERGE with the
+# builtins per (n, k) cell (see _merge_pad_rules): a builtin survives
+# unless the artifact measured that exact cell — the shipped
+# TOPK_PAD_tpu.json has no n=4096 row, and letting it replace the whole
+# table silently disarmed this fix (ADVICE r5).
 _BUILTIN_PAD_RULES = {
     "tpu": [{"n": 4096, "k": 10, "k_pad": 32}],
 }
+
+
+def _merge_pad_rules(builtin: list, measured) -> list:
+    """Measured artifact rules + the builtins for cells the artifact did
+    not measure. A measured (n, k) always wins — including "no pad needed"
+    entries (k_pad == k), which deliberately override a builtin."""
+    measured = [dict(r) for r in measured]
+    seen = {(r["n"], r["k"]) for r in measured}
+    return measured + [dict(r) for r in builtin
+                       if (r["n"], r["k"]) not in seen]
 
 
 def _load_pad_rules() -> dict:
     global _pad_rules_cache
     if _pad_rules_cache is None:
         _pad_rules_cache = _scan_artifacts(
-            {k: list(v) for k, v in _BUILTIN_PAD_RULES.items()},
+            {k: [dict(r) for r in v] for k, v in _BUILTIN_PAD_RULES.items()},
             "TOPK_PAD", "RAFT_TPU_TOPK_PAD",
-            lambda art: list(art["pad_rules"]))
+            lambda art: _merge_pad_rules(
+                _BUILTIN_PAD_RULES.get(art["platform"], []),
+                art["pad_rules"]))
     return _pad_rules_cache
 
 

@@ -690,6 +690,8 @@ def build_ivf_pq(
     materializes the decoded scan cache per shard (fastest search);
     ``"lut"`` keeps only packed codes + codebooks resident (memory-lean,
     VERDICT r1 #7 — roughly doubles the max shard at pq_bits=8).
+    ``scan_cache_dtype`` also sets the overflow-block decode dtype for
+    *lut* builds — pin it to fp32 when comparing engines bit-for-bit.
 
     Multi-controller contract: every process must pass the IDENTICAL full
     ``dataset`` and an identically-seeded ``res`` — each process slices its
@@ -834,25 +836,31 @@ def _resolve_pq_scan_mode(params, list_decoded, list_codes) -> str:
     return mode
 
 
-def _pq_q_tile(mode: str, n_probes: int, res: Resources, list_decoded,
-               list_codes, pq_dim: int, pq_bits: int) -> int:
-    """Workspace-bounded query-tile size, shared by the mesh and elastic
-    searches so single-chip serving tiles can't desync from mesh tiles.
-    Shapes are [..., pad, last] with any number of leading axes."""
+def _pq_tiles(mode: str, n_probes: int, res: Resources, list_decoded,
+              list_codes, pq_dim: int, pq_bits: int,
+              lut_itemsize: int = 4, dist_itemsize: int = 4
+              ) -> Tuple[int, int]:
+    """Workspace-bounded (q_tile, probe_tile), shared by the mesh and
+    elastic searches so single-chip serving tiles can't desync from mesh
+    tiles. Shapes are [..., pad, last] with any number of leading axes.
+    The cache engine scans all probes in one pass (probe_tile =
+    n_probes); the LUT engine's tiles come from the true-peak accounting
+    (ivf_pq.plan_lut_tiles), engaging its probe loop when the budget
+    demands it."""
+    from raft_tpu.neighbors import ivf_pq
+
     if mode == "cache":
         list_pad = list_decoded.shape[-2]
         rot = list_decoded.shape[-1]
         per_q = n_probes * list_pad * (rot * 2 + 12)
-        cap = 1024
-    else:
-        list_pad = list_codes.shape[-2]
-        book = 1 << pq_bits
-        per_q = n_probes * (pq_dim * book * 4 + list_pad * (pq_dim * 4 + 16))
-        cap = 256
-    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, cap))
-    if q_tile >= 8:
-        q_tile -= q_tile % 8
-    return q_tile
+        q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1),
+                             1, 1024))
+        if q_tile >= 8:
+            q_tile -= q_tile % 8
+        return q_tile, n_probes
+    return ivf_pq.plan_lut_tiles(
+        n_probes, list_codes.shape[-2], pq_dim, pq_bits,
+        res.workspace_limit_bytes, lut_itemsize, dist_itemsize)
 
 
 def search_ivf_pq(
@@ -903,8 +911,8 @@ def search_ivf_pq(
                     overflow_indices=oi[0], has_overflow=True)
 
     if mode == "cache":
-        q_tile = _pq_q_tile("cache", n_probes, res, index.list_decoded,
-                            index.list_codes, index.pq_dim, index.pq_bits)
+        q_tile, _ = _pq_tiles("cache", n_probes, res, index.list_decoded,
+                              index.list_codes, index.pq_dim, index.pq_bits)
 
         def local(q_rep, c, ro, ld, dn, li, ls, *over):
             v, i = ivf_pq._search_cache_core(
@@ -925,8 +933,11 @@ def search_ivf_pq(
                            index.list_indices, index.list_sizes, *over_ops)
 
     # LUT engine: packed codes only (the DEEP-100M/8 memory-lean shape)
-    q_tile = _pq_q_tile("lut", n_probes, res, index.list_decoded,
-                        index.list_codes, index.pq_dim, index.pq_bits)
+    q_tile, probe_tile = _pq_tiles(
+        "lut", n_probes, res, index.list_decoded, index.list_codes,
+        index.pq_dim, index.pq_bits,
+        jnp.dtype(params.lut_dtype).itemsize,
+        jnp.dtype(params.internal_distance_dtype).itemsize)
     lut_dtype = jnp.dtype(params.lut_dtype).name
     dist_dtype = jnp.dtype(params.internal_distance_dtype).name
 
@@ -935,7 +946,8 @@ def search_ivf_pq(
             q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
             index.metric, int(k), n_probes, q_tile, index.per_cluster,
             index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
-            select_recall=select_recall, **unpack_over(over))
+            select_recall=select_recall, probe_tile=probe_tile,
+            **unpack_over(over))
         return merge(v, i)
 
     fn = comms.run(
@@ -1220,13 +1232,15 @@ def deserialize_ivf_pq(prefix: str, comms: Comms) -> ShardedIvfPq:
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "metric", "k", "n_probes", "q_tile", "per_cluster", "pq_dim", "pq_bits",
-    "lut_dtype", "dist_dtype", "select_recall", "has_overflow"))
+    "metric", "k", "n_probes", "q_tile", "probe_tile", "per_cluster",
+    "pq_dim", "pq_bits", "lut_dtype", "dist_dtype", "select_recall",
+    "has_overflow"))
 def _elastic_lut_search(queries, centers, rotation, codebooks, list_codes,
                         list_indices, list_sizes, overflow_decoded,
                         overflow_norms, overflow_indices, *, metric, k,
-                        n_probes, q_tile, per_cluster, pq_dim, pq_bits,
-                        lut_dtype, dist_dtype, select_recall, has_overflow):
+                        n_probes, q_tile, probe_tile, per_cluster, pq_dim,
+                        pq_bits, lut_dtype, dist_dtype, select_recall,
+                        has_overflow):
     from raft_tpu.neighbors import ivf_pq
 
     empty_filter = jnp.zeros((0,), jnp.uint32)
@@ -1240,7 +1254,8 @@ def _elastic_lut_search(queries, centers, rotation, codebooks, list_codes,
         return ivf_pq._search_lut_core(
             queries, c, ro, cb, lc, li, ls, empty_filter, metric, k,
             n_probes, q_tile, per_cluster, pq_dim, pq_bits, False,
-            lut_dtype, dist_dtype, select_recall=select_recall, **kw)
+            lut_dtype, dist_dtype, select_recall=select_recall,
+            probe_tile=probe_tile, **kw)
 
     v, i = jax.lax.map(per_shard, (centers, rotation, codebooks, list_codes,
                                    list_indices, list_sizes,
@@ -1343,8 +1358,11 @@ class ElasticIvfPq:
                     jnp.zeros((s, 0), jnp.float32),
                     jnp.zeros((s, 0), jnp.int32))
 
-        q_tile = _pq_q_tile(mode, n_probes, res, self.list_decoded,
-                            self.list_codes, self.pq_dim, self.pq_bits)
+        q_tile, probe_tile = _pq_tiles(
+            mode, n_probes, res, self.list_decoded, self.list_codes,
+            self.pq_dim, self.pq_bits,
+            jnp.dtype(params.lut_dtype).itemsize,
+            jnp.dtype(params.internal_distance_dtype).itemsize)
         if mode == "cache":
             return _elastic_cache_search(
                 queries, self.centers, self.rotation, self.list_decoded,
@@ -1357,8 +1375,8 @@ class ElasticIvfPq:
             queries, self.centers, self.rotation, self.codebooks,
             self.list_codes, self.list_indices, self.list_sizes, *over,
             metric=self.metric, k=int(k), n_probes=n_probes, q_tile=q_tile,
-            per_cluster=self.per_cluster, pq_dim=self.pq_dim,
-            pq_bits=self.pq_bits,
+            probe_tile=probe_tile, per_cluster=self.per_cluster,
+            pq_dim=self.pq_dim, pq_bits=self.pq_bits,
             lut_dtype=jnp.dtype(params.lut_dtype).name,
             dist_dtype=jnp.dtype(params.internal_distance_dtype).name,
             select_recall=select_recall, has_overflow=has_overflow)

@@ -186,3 +186,32 @@ class TestStats:
     def test_r2(self, rng):
         y = rng.standard_normal(50)
         assert float(stats.r2_score(y, y)) == pytest.approx(1.0)
+
+
+class TestSolveJointTiles:
+    """solve_joint_tiles: the workspace-bounded (outer, inner) loop-nest
+    solve behind ivf_pq.plan_lut_tiles."""
+
+    def test_full_inner_preferred(self):
+        from raft_tpu.core.resources import solve_joint_tiles
+        # 100 cells' worth of budget, inner extent 4 -> outer 24 (8-aligned)
+        outer, inner = solve_joint_tiles(100 * 10, 10, 4)
+        assert (outer, inner) == (24, 4)
+
+    def test_outer_capped(self):
+        from raft_tpu.core.resources import solve_joint_tiles
+        outer, inner = solve_joint_tiles(10_000 * 10, 10, 4, outer_cap=256)
+        assert (outer, inner) == (256, 4)
+
+    def test_inner_shrinks_when_full_extent_oversized(self):
+        from raft_tpu.core.resources import solve_joint_tiles
+        # full inner extent (64) would need 8*64=512 cells; budget holds
+        # only 8*3 -> keep the lane-aligned outer=8, tile the inner loop
+        outer, inner = solve_joint_tiles(8 * 3 * 10, 10, 64)
+        assert (outer, inner) == (8, 3)
+
+    def test_degrades_to_single_cell(self):
+        from raft_tpu.core.resources import solve_joint_tiles
+        # one cell exceeds the budget: (1, 1), never (0, _)
+        outer, inner = solve_joint_tiles(5, 10, 64)
+        assert (outer, inner) == (1, 1)

@@ -431,3 +431,101 @@ def test_pq_bits5_end_to_end_both_engines(rng):
                                                  scan_mode=mode))
         r = float(neighborhood_recall(np.asarray(i), gt))
         assert r > 0.7, (mode, r)
+
+
+def test_lut_probe_tiling_bit_identical(data):
+    """A workspace too small to hold all probes at once forces the
+    probe-tile loop (probe_tile < n_probes); the tiled scan must complete
+    and return bit-identical values/ids to the untiled single-tile run —
+    per-element contractions are unchanged, only the top-k merge order
+    differs."""
+    from raft_tpu import Resources
+
+    db, q = data
+    index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=32, pq_dim=16),
+                         res=Resources(seed=7))
+    n_probes = 12
+    sp = ivf_pq.SearchParams(n_probes=n_probes, scan_mode="lut")
+    v0, i0 = ivf_pq.search(index, q, 10, sp,
+                           res=Resources(workspace_limit_bytes=1 << 34))
+    list_pad = index.list_codes.shape[1]
+    per_qp = ivf_pq.lut_bytes_per_query_probe(list_pad, index.pq_dim,
+                                              index.pq_bits)
+    tight = Resources(workspace_limit_bytes=per_qp * 8 * 3)
+    q_tile, probe_tile = ivf_pq.plan_lut_tiles(
+        n_probes, list_pad, index.pq_dim, index.pq_bits,
+        tight.workspace_limit_bytes)
+    assert probe_tile < n_probes, (q_tile, probe_tile)
+    assert q_tile * probe_tile * per_qp <= tight.workspace_limit_bytes
+    v1, i1 = ivf_pq.search(index, q, 10, sp, res=tight)
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+def test_lut_probe_tiling_matches_cache_engine(data, gt):
+    """Tiled-LUT results stay within the existing lut-vs-cache parity
+    tolerance: both engines compute the same ADC distances (fp32 LUT vs
+    fp32 decoded cache differ only in accumulation order), so where the
+    returned ids agree the distances agree to float tolerance, the
+    neighbor sets overlap almost entirely (near-tie rank swaps only),
+    and recall holds the same floor."""
+    from raft_tpu import Resources
+
+    db, q = data
+    index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=32, pq_dim=16),
+                         res=Resources(seed=7))
+    n_probes = 12
+    list_pad = index.list_codes.shape[1]
+    per_qp = ivf_pq.lut_bytes_per_query_probe(list_pad, index.pq_dim,
+                                              index.pq_bits)
+    tight = Resources(workspace_limit_bytes=per_qp * 8 * 3)
+    # scan_cache_dtype also governs the overflow-block decode on the lut
+    # path — hold it at fp32 on BOTH engines so spilled rows don't drift
+    v1, i1 = ivf_pq.search(
+        index, q, 10, ivf_pq.SearchParams(n_probes=n_probes,
+                                          scan_mode="lut",
+                                          scan_cache_dtype=jnp.float32),
+        res=tight)
+    vc, ic = ivf_pq.search(
+        index, q, 10, ivf_pq.SearchParams(n_probes=n_probes,
+                                          scan_mode="cache",
+                                          scan_cache_dtype=jnp.float32))
+    v1, i1, vc, ic = map(np.asarray, (v1, i1, vc, ic))
+    same = i1 == ic
+    assert same.mean() >= 0.95, same.mean()
+    np.testing.assert_allclose(v1[same], vc[same], rtol=1e-4, atol=1e-3)
+    overlap = np.mean([len(np.intersect1d(a, b)) / 10.0
+                       for a, b in zip(i1, ic)])
+    assert overlap >= 0.97, overlap
+    r_lut = float(neighborhood_recall(i1, gt))
+    r_cache = float(neighborhood_recall(ic, gt))
+    assert r_lut >= r_cache - 0.02 and r_lut >= 0.7, (r_lut, r_cache)
+
+
+def test_resolve_scan_mode_lut_at_1m_shape_with_fitting_tiles():
+    """The sift-1M crash shape (LUT_CRASH_tpu.json: nlist=1024, ~1464
+    list pad, pq_dim=64, pq_bits=8, nprobe=64): when the decoded cache
+    does not fit the headroom, auto resolves to LUT — which is now safe
+    because plan_lut_tiles bounds the scan workspace by construction
+    (the old one-axis solve under-counted the live set ~5x and sized
+    q_tile=136 -> ~19 GB on a 16 GB chip)."""
+    list_pad, pq_dim, pq_bits, n_probes = 1464, 64, 8, 64
+    # fp32 cache at this shape ~ 774 MB on top of ~102 MB packed; a
+    # 512 MB headroom (no reported device memory, 128 MB workspace x4)
+    # cannot hold it -> LUT
+    mode = ivf_pq.resolve_scan_mode(
+        n_lists=1024, list_pad=list_pad, rot_dim=128, n_code_bytes=64,
+        cache_itemsize=4, device_memory_bytes=None,
+        workspace_limit_bytes=128 << 20)
+    assert mode == "lut"
+    q_tile, probe_tile = ivf_pq.plan_lut_tiles(
+        n_probes, list_pad, pq_dim, pq_bits, 128 << 20)
+    per_qp = ivf_pq.lut_bytes_per_query_probe(list_pad, pq_dim, pq_bits)
+    assert q_tile >= 1 and 1 <= probe_tile <= n_probes
+    assert q_tile * probe_tile * per_qp <= 128 << 20
+    # the crash accounting: at the old q_tile=136 with all 64 probes the
+    # true live set was multiple device memories — the joint solve must
+    # never produce it under ANY budget that reports the 16 GB chip
+    q16, p16 = ivf_pq.plan_lut_tiles(n_probes, list_pad, pq_dim, pq_bits,
+                                     (16 << 30) // 4)
+    assert q16 * p16 * per_qp <= (16 << 30) // 4

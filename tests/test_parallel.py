@@ -156,8 +156,12 @@ def test_sharded_ivf_pq_lut_matches_cache(comms):
     cache_idx = sharded.build_ivf_pq(comms, db, params, res=Resources(seed=9),
                                      scan_mode="cache",
                                      scan_cache_dtype=jnp.float32)
+    # scan_cache_dtype also governs the overflow-block decode for lut
+    # builds: leaving it bf16 here would let spilled rows' distances drift
+    # past rtol while the probed-list scans agree bit-for-bit
     lut_idx = sharded.build_ivf_pq(comms, db, params, res=Resources(seed=9),
-                                   scan_mode="lut")
+                                   scan_mode="lut",
+                                   scan_cache_dtype=jnp.float32)
     assert lut_idx.list_decoded is None  # memory-lean: no decoded cache
     assert lut_idx.list_codes is not None
 

@@ -296,8 +296,34 @@ def test_platform_key_axon_maps_to_tpu(monkeypatch):
     sk = importlib.import_module("raft_tpu.ops.select_k")
     monkeypatch.setattr(jax, "default_backend", lambda: "axon")
     assert sk._platform_key() == "tpu"
-    # builtin tpu pad rule fires under the axon backend name
+    # builtin tpu pad rule fires under the axon backend name — and
+    # survives the shipped TOPK_PAD_tpu.json artifact, which measured
+    # other widths but not the (4096, 10) cell (merge semantics:
+    # artifact rules + builtins for unmeasured cells)
     assert sk._pad_k(4096, 10) == 32
+    # a cell the artifact DID measure comes from the artifact
+    assert sk._pad_k(8192, 10) == 16
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
     assert sk._platform_key() == "cpu"
     assert sk._pad_k(4096, 10) == 10
+
+
+def test_merge_pad_rules_builtin_survives_unmeasured_cells():
+    """TOPK_PAD artifacts merge with the builtin pad table per (n, k)
+    cell: a measured cell always wins (including k_pad == k "no pad"
+    entries), a builtin survives when the artifact never measured its
+    cell (ADVICE r5: wholesale replacement silently disarmed the n=4096
+    builtin)."""
+    import importlib
+
+    sk = importlib.import_module("raft_tpu.ops.select_k")
+    builtin = [{"n": 4096, "k": 10, "k_pad": 32},
+               {"n": 2048, "k": 10, "k_pad": 32}]
+    measured = [{"n": 2048, "k": 10, "k_pad": 10},   # measured: no pad
+                {"n": 8192, "k": 10, "k_pad": 16}]
+    merged = sk._merge_pad_rules(builtin, measured)
+    cells = {(r["n"], r["k"]): r["k_pad"] for r in merged}
+    assert cells[(2048, 10)] == 10   # measured overrides builtin
+    assert cells[(8192, 10)] == 16   # measured-only cell kept
+    assert cells[(4096, 10)] == 32   # unmeasured builtin survives
+    assert len(merged) == 3

@@ -148,7 +148,11 @@ def main():
         chunk = 2_000_000
         dists, ids = [], []
         for lo in range(0, args.rows, chunk):
-            d_c, i_c = brute_force.knn(q, db[lo:lo + chunk], k=args.k,
+            db_c = db[lo:lo + chunk]
+            # a short tail chunk can hold fewer than k rows; np.concatenate
+            # along axis=1 tolerates the narrower block
+            d_c, i_c = brute_force.knn(q, db_c,
+                                       k=min(args.k, db_c.shape[0]),
                                        metric="sqeuclidean")
             dists.append(np.asarray(d_c))
             ids.append(np.asarray(i_c) + lo)

@@ -30,7 +30,9 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
   fi
 }
 
-# v3: pareto resumes FIRST (LUT params pulled after 2x TPU worker crash)
+# Short gates first; the pareto resume runs after them (LUT params were
+# pulled from the conf after 2x TPU worker crash — since restored with the
+# tiled scan engine, so a resume picks the lut points up as missing).
 run_step bench  /tmp/q5_bench.done  timeout 1800 python bench.py
 run_step tputests /tmp/q5_tputests.done timeout 2700 \
   python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
