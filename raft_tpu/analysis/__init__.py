@@ -1,0 +1,99 @@
+"""graftcheck — JAX/TPU-aware static analysis for raft_tpu.
+
+Two tiers:
+
+* **Tier A** (pure AST, no JAX import): rules R001–R005 over every
+  ``raft_tpu``/``tools``/``tests`` module — host-sync in jit-reachable
+  code, Python control flow on traced values, recompilation hazards,
+  cross-package private imports, unguarded broadcasts.
+* **Tier B** (``--jaxpr-audit``): abstract-evals the public search/build
+  entrypoints at canonical shapes (no device memory is allocated), walks
+  the closed jaxpr for a peak-live-set upper bound and fails when an
+  entrypoint's estimate exceeds its workspace budget (rule B001).
+
+Findings are keyed ``(rule, file, qualname)`` so a committed baseline
+survives line churn; see :mod:`raft_tpu.analysis.findings`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Tuple
+
+from raft_tpu.analysis.astutils import ModuleInfo
+from raft_tpu.analysis.findings import (Finding, load_baseline, save_baseline,
+                                        split_by_baseline)
+from raft_tpu.analysis.layering import check_layering
+from raft_tpu.analysis.rules_ast import AST_RULES
+
+__all__ = [
+    "Finding", "ModuleInfo", "AST_RULES", "check_layering",
+    "load_baseline", "save_baseline", "split_by_baseline",
+    "collect_modules", "run_tier_a", "DEFAULT_SCAN_DIRS",
+]
+
+#: directories scanned by default, relative to the repo root.
+DEFAULT_SCAN_DIRS = ("raft_tpu", "tools", "tests")
+
+_SKIP_PARTS = {"__pycache__", ".git", "data"}
+
+
+def _modname_for(relfile: str) -> str:
+    mod = relfile[:-3] if relfile.endswith(".py") else relfile
+    mod = mod.replace(os.sep, ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def collect_modules(root: str,
+                    dirs: Iterable[str] = DEFAULT_SCAN_DIRS,
+                    ) -> Tuple[List[ModuleInfo], List[Finding]]:
+    """Parse every ``.py`` file under ``dirs`` into :class:`ModuleInfo`.
+
+    Returns ``(modules, parse_findings)``; files that fail to parse
+    become rule ``E000`` findings instead of aborting the whole scan.
+    """
+    modules: List[ModuleInfo] = []
+    errors: List[Finding] = []
+    for d in dirs:
+        base = os.path.join(root, d)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(x for x in dirnames
+                                 if x not in _SKIP_PARTS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                relfile = os.path.relpath(path, root)
+                try:
+                    modules.append(
+                        ModuleInfo(path, relfile, _modname_for(relfile)))
+                except SyntaxError as e:
+                    errors.append(Finding(
+                        rule="E000", file=relfile, qualname="<module>",
+                        line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}"))
+    return modules, errors
+
+
+def run_tier_a(root: str,
+               dirs: Iterable[str] = DEFAULT_SCAN_DIRS,
+               rules: Optional[Iterable] = None) -> List[Finding]:
+    """Run every Tier-A rule (R001–R005) over the tree at ``root``."""
+    modules, findings = collect_modules(root, dirs)
+    for mod in modules:
+        for rule in (rules if rules is not None else AST_RULES):
+            findings.extend(rule(mod))
+    findings.extend(check_layering(modules))
+    seen = set()
+    unique = []
+    for f in findings:
+        ident = (f.key, f.line, f.message)
+        if ident not in seen:
+            seen.add(ident)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.file, f.line, f.rule))
+    return unique

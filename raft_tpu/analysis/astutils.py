@@ -1,0 +1,299 @@
+"""Shared AST infrastructure for the Tier-A rules (no JAX import needed).
+
+Per module this builds:
+
+- an import-alias map so rules can resolve ``jnp.einsum`` →
+  ``jax.numpy.einsum`` whatever the local alias is;
+- a function table keyed by dotted qualname (``Class.method``,
+  ``outer.inner`` for nested defs);
+- the **jit context**: which functions are jit roots — ``@jax.jit`` /
+  ``@functools.partial(jax.jit, ...)`` decorated, wrapped by a
+  ``_f_jit = jax.jit(_f, static_argnames=...)`` module-level assignment,
+  or passed inline to ``jax.jit(fn)`` — with their ``static_argnames``
+  when statically recoverable;
+- a bare-name call graph (alias-aware: a call to ``_f_jit`` counts as a
+  call to ``_f``), from which JIT-REACHABILITY is computed — the set of
+  functions whose bodies can be traced under ``jax.jit``. Nested defs
+  inherit reachability from their parent (tile/scan bodies are traced).
+
+Heuristics are per-module by design: cross-module tracing would need
+whole-program import resolution for marginal extra recall, and every
+hot-path core in this codebase is jitted in its defining module.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+#: callables that make their function argument a jit root
+JIT_WRAPPERS = ("jax.jit", "jax.pmap", "jax.experimental.pjit.pjit")
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    name: str
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    parent: Optional[str]  # enclosing function qualname, None at module level
+    lineno: int
+    params: tuple = ()
+    #: static_argnames when the jit wrapping makes them recoverable;
+    #: None = unknown (rules must not assume a param is traced)
+    static_argnames: Optional[frozenset] = None
+    jit_root: bool = False
+    calls: set = dataclasses.field(default_factory=set)  # bare callee names
+
+
+class ModuleInfo:
+    """Parsed module + jit context; input to every Tier-A rule."""
+
+    def __init__(self, path: str, relfile: str, modname: str):
+        self.path = path
+        self.relfile = relfile
+        self.modname = modname  # e.g. "raft_tpu.ops.select_k"
+        parts = modname.split(".")
+        #: containing package, e.g. "raft_tpu.ops" ("raft_tpu" at top level)
+        self.package = ".".join(parts[:-1]) if len(parts) > 1 else parts[0]
+        with open(path) as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self.aliases: dict[str, str] = {}  # local name -> dotted origin
+        self.functions: dict[str, FunctionInfo] = {}
+        self.name_index: dict[str, list] = {}  # bare name -> [qualnames]
+        # call alias -> target bare function name (_search_jit -> _search_...)
+        self.jit_aliases: dict[str, str] = {}
+        self._build()
+        self._jit_reachable: Optional[set] = None
+
+    # -------------------------------------------------------------- building
+    def _build(self) -> None:
+        self._collect_imports()
+        self._collect_functions()
+        self._collect_jit_wrappings()
+        self._collect_calls()
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative import: anchor in this package
+                    base = ".".join(
+                        self.modname.split(".")[:-node.level] + [node.module])
+                else:
+                    base = node.module
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _collect_functions(self) -> None:
+        def visit(node, prefix, parent_fn):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    args = child.args
+                    params = tuple(
+                        a.arg for a in (args.posonlyargs + args.args
+                                        + args.kwonlyargs))
+                    statics, root = self._statics_from_decorators(child)
+                    info = FunctionInfo(
+                        name=child.name, qualname=qual, node=child,
+                        parent=parent_fn, lineno=child.lineno, params=params,
+                        static_argnames=statics, jit_root=root)
+                    self.functions[qual] = info
+                    self.name_index.setdefault(child.name, []).append(qual)
+                    visit(child, f"{qual}.", qual)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", parent_fn)
+                else:
+                    visit(child, prefix, parent_fn)
+
+        visit(self.tree, "", None)
+
+    def _statics_from_decorators(self, node):
+        """→ (static_argnames|None, is_jit_root) from the decorator list."""
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            dotted = self.resolve(target)
+            if dotted in JIT_WRAPPERS:
+                statics = (self._extract_statics(dec)
+                           if isinstance(dec, ast.Call) else frozenset())
+                return statics, True
+            # @functools.partial(jax.jit, static_argnames=(...))
+            if (isinstance(dec, ast.Call)
+                    and dotted == "functools.partial" and dec.args
+                    and self.resolve(dec.args[0]) in JIT_WRAPPERS):
+                return self._extract_statics(dec), True
+        return None, False
+
+    def _extract_statics(self, call: ast.Call) -> Optional[frozenset]:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                if kw.arg == "static_argnums":
+                    return None  # positional statics: leave unknown
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    return frozenset((v.value,))
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    names = []
+                    for e in v.elts:
+                        if not (isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)):
+                            return None
+                        names.append(e.value)
+                    return frozenset(names)
+                return None
+        return frozenset()
+
+    def _collect_jit_wrappings(self) -> None:
+        """``X = jax.jit(F, ...)`` assignments and inline ``jax.jit(F)``."""
+        for node in ast.walk(self.tree):
+            call = None
+            if isinstance(node, ast.Assign):
+                call = node.value
+            elif isinstance(node, ast.Call):
+                call = node
+            if not (isinstance(call, ast.Call)
+                    and self.resolve(call.func) in JIT_WRAPPERS and call.args):
+                continue
+            target = call.args[0]
+            if not isinstance(target, ast.Name):
+                continue
+            statics = self._extract_statics(call)
+            for qual in self.name_index.get(target.id, ()):
+                info = self.functions[qual]
+                info.jit_root = True
+                if info.static_argnames is None:
+                    info.static_argnames = statics
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jit_aliases[t.id] = target.id
+
+    def _collect_calls(self) -> None:
+        for info in self.functions.values():
+            collector = _CallCollector(self, skip_node=info.node)
+            for child in ast.iter_child_nodes(info.node):
+                collector.visit(child)
+            info.calls = collector.names
+
+    # ------------------------------------------------------------- utilities
+    def dotted(self, node) -> Optional[str]:
+        """`a.b.c` Attribute/Name chain → "a.b.c", else None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve(self, node_or_str) -> Optional[str]:
+        """Dotted path with the first segment expanded through imports."""
+        dotted = (node_or_str if isinstance(node_or_str, str)
+                  else self.dotted(node_or_str))
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        origin = self.aliases.get(head, head)
+        return f"{origin}.{rest}" if rest else origin
+
+    def callee_function_name(self, call_name: str) -> str:
+        """Resolve a called bare name through jit aliases."""
+        return self.jit_aliases.get(call_name, call_name)
+
+    # ---------------------------------------------------------- reachability
+    @property
+    def jit_reachable(self) -> set:
+        """Qualnames of functions whose bodies may run under a jit trace."""
+        if self._jit_reachable is not None:
+            return self._jit_reachable
+        reach = {q for q, f in self.functions.items() if f.jit_root}
+        frontier = list(reach)
+        while frontier:
+            qual = frontier.pop()
+            info = self.functions[qual]
+            nxt = set()
+            # callees by bare name (through jit aliases)
+            for name in info.calls:
+                nxt.update(self.name_index.get(
+                    self.callee_function_name(name), ()))
+            # nested defs are traced with their parent
+            nxt.update(q for q, f in self.functions.items()
+                       if f.parent == qual)
+            for q in nxt:
+                if q not in reach:
+                    reach.add(q)
+                    frontier.append(q)
+        self._jit_reachable = reach
+        return reach
+
+    def callers_of(self, qualname: str) -> set:
+        """Transitive in-module callers of ``qualname`` (incl. itself)."""
+        name = self.functions[qualname].name
+        wanted = {qualname}
+        # aliases that point at this function count as the function
+        alias_names = {a for a, t in self.jit_aliases.items() if t == name}
+        alias_names.add(name)
+        changed = True
+        while changed:
+            changed = False
+            for qual, info in self.functions.items():
+                if qual in wanted:
+                    continue
+                callee_quals = set()
+                for n in info.calls:
+                    if n in alias_names:
+                        callee_quals.add(qualname)
+                    callee_quals.update(self.name_index.get(
+                        self.callee_function_name(n), ()))
+                if callee_quals & wanted:
+                    wanted.add(qual)
+                    # calls to this caller now also reach the target
+                    alias_names.add(info.name)
+                    changed = True
+        return wanted
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        """Inline escape hatch: ``# graftcheck: RXXX`` on the flagged line."""
+        if 0 < lineno <= len(self.lines):
+            line = self.lines[lineno - 1]
+            if "graftcheck:" in line:
+                tail = line.split("graftcheck:", 1)[1]
+                return rule in tail
+        return False
+
+
+class _CallCollector(ast.NodeVisitor):
+    """Bare names called within one function body, not descending into
+    nested function/class definitions (they have their own entries)."""
+
+    def __init__(self, mod: ModuleInfo, skip_node):
+        self.mod = mod
+        self.skip = skip_node
+        self.names: set = set()
+
+    def visit_FunctionDef(self, node):  # noqa: N802 (ast visitor API)
+        if node is self.skip:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802 (ast visitor API)
+        pass
+
+    def visit_Call(self, node):  # noqa: N802 (ast visitor API)
+        if isinstance(node.func, ast.Name):
+            self.names.add(node.func.id)
+        # functional references too: lax.map(tile_body, ...), scan(step, ...)
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                self.names.add(arg.id)
+        self.generic_visit(node)

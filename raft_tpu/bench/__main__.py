@@ -2,7 +2,8 @@
 
     python -m raft_tpu.bench run --conf config.json [--k 10] ...
     python -m raft_tpu.bench get-dataset --hdf5 glove-100-angular.hdf5 --out data/
-    python -m raft_tpu.bench generate-groundtruth --base b.fbin --queries q.fbin --out gt.ibin
+    python -m raft_tpu.bench generate-groundtruth --base b.fbin \\
+        --queries q.fbin --out gt.ibin
     python -m raft_tpu.bench split-groundtruth --gt combined.fbin --out-prefix gt
 
 ``run`` reads a run config, executes every index/search combo, writes

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import csv
 import json
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 
 def load_results(path: str) -> List[Dict]:

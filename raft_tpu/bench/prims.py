@@ -69,13 +69,13 @@ def bench_select_k(batch=1024, n=16384, k=64):
 
 
 def bench_kmeans_iter(m=100_000, d=96, c=1024):
-    from raft_tpu.cluster.kmeans import _assign
+    from raft_tpu.cluster.kmeans import assign
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((m, d)), jnp.float32)
     cen = jnp.asarray(rng.standard_normal((c, d)), jnp.float32)
     xn = jnp.sum(x * x, -1)
-    f = jax.jit(lambda a, an, b: _assign(a, an, b, 65536))
+    f = jax.jit(lambda a, an, b: assign(a, an, b, 65536))
     dt = _time(f, x, xn, cen)
     flops = 2.0 * m * c * d
     return {"case": "kmeans_assign", "shape": [m, d, c],

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
 from raft_tpu.ops.fused_l2_nn import (fused_l2_nn_argmin,
-    _fused_l2_nn_jit, choose_tile_rows)
+    choose_tile_rows, fused_l2_nn_core)
 
 
 class InitMethod(enum.Enum):
@@ -60,9 +60,14 @@ def _assign(x, x_norms, centers, tile: int):
     """E-step: (labels, distance²) via the shared tiled fused-L2 kernel
     (raft_tpu.ops.fused_l2_nn) — single implementation for kmeans, predict
     and cluster_cost."""
-    d2, labels = _fused_l2_nn_jit(x, centers, x_norms, row_norms_sq(centers),
+    d2, labels = fused_l2_nn_core(x, centers, x_norms, row_norms_sq(centers),
                                   False, tile)
     return labels, d2
+
+
+#: public traceable-core name — the cross-package contract for the bench
+#: harness and any caller jitting the E-step directly (R004).
+assign = _assign
 
 
 def _update(x, labels, old_centers, weights=None):

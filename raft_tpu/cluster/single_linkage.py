@@ -16,9 +16,8 @@ negligible next to the O(n²d) connectivity step."""
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 

@@ -13,11 +13,9 @@ to build additive distance masks.
 
 from __future__ import annotations
 
-from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 _WORD_BITS = 32
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Iterable
+from typing import Dict
 
 import jax
 

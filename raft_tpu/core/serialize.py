@@ -18,7 +18,6 @@ neighbors/detail/ivf_pq_serialize.cuh). We keep the same container model:
 
 from __future__ import annotations
 
-import io
 import struct
 from typing import BinaryIO, Union
 
@@ -102,11 +101,13 @@ class IndexReader:
         (kind_len,) = struct.unpack("<I", stream.read(4))
         found = stream.read(kind_len).decode()
         if found != kind:
-            raise ValueError(f"index kind mismatch: file has {found!r}, expected {kind!r}")
+            raise ValueError(
+                f"index kind mismatch: file has {found!r}, expected {kind!r}")
         (self.version,) = struct.unpack("<I", stream.read(4))
         if self.version > max_version:
             raise ValueError(
-                f"{kind} index version {self.version} is newer than supported {max_version}"
+                f"{kind} index version {self.version} is newer than "
+                f"supported {max_version}"
             )
 
     def scalar(self):
@@ -122,6 +123,7 @@ class IndexReader:
 
 def open_for(file_or_stream, mode: str):
     """Return (stream, should_close) for a path or an already-open stream."""
-    if isinstance(file_or_stream, (str, bytes)) or hasattr(file_or_stream, "__fspath__"):
+    if (isinstance(file_or_stream, (str, bytes))
+            or hasattr(file_or_stream, "__fspath__")):
         return open(file_or_stream, mode), True
     return file_or_stream, False

@@ -17,4 +17,5 @@ from raft_tpu.neighbors import (
 )
 
 __all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "quantize", "rbc", "refine"]
+           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "quantize",
+           "rbc", "refine"]

@@ -20,7 +20,6 @@ the RBC guarantee."""
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import math
 from typing import Optional, Tuple

@@ -23,7 +23,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.core import serialize as ser
 from raft_tpu.core.resources import Resources, ensure_resources
@@ -36,7 +35,7 @@ from raft_tpu.ops.distance import (
     l2_expanded,
     resolve_metric,
     row_norms_sq,
-    _pairwise_impl,
+    pairwise_core,
 )
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
@@ -81,11 +80,25 @@ def build(dataset, metric="euclidean", metric_arg: float = 2.0,
 def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
                   ) -> Tuple[int, int]:
     """Pick (query_tile, db_tile) so the distance tile fits the workspace
-    budget (analog of chooseTileSize, detail/knn_brute_force.cuh:84)."""
+    budget (analog of chooseTileSize, detail/knn_brute_force.cuh:84).
+
+    The budget pays for (a) one whole-dataset pad copy that stays live
+    across the scan (the tile reshape needs n_db rounded up to the tile)
+    and (b) ~5 concurrent fp32 tiles in the expanded-L2 chain
+    (dot, norm-add, clamp, mask-select, top-k negation) — the graftcheck
+    jaxpr audit certifies the resulting peak statically; the old solve
+    charged only 4 tiles and no pad copy and overshot by ~25%."""
     q_tile = balanced_tile(n_queries, min(n_queries, 1024), 8)
-    db_budget = max(budget // (4 * max(q_tile, 1) * 4), 1)  # fp32 + headroom
+    pad_copy = n_db * dim * 4
+    avail = max(budget - pad_copy, budget // 4)
+    db_budget = max(avail // (5 * max(q_tile, 1) * 4), 1)
     db_tile = min(n_db, max(db_budget, 4 * k, 1024))
     return q_tile, balanced_tile(n_db, db_tile, 128)
+
+
+#: public planner name — consumed by the graftcheck jaxpr audit, which
+#: certifies the solve statically against the workspace budget (R004)
+choose_tiles = _choose_tiles
 
 
 #: metrics eligible for the bf16 fast-scan (their scan is one MXU matmul and
@@ -188,7 +201,7 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
                         x_norms=qt_norms, y_norms=dbn_t,
                     )
             else:
-                d = _pairwise_impl(qt, db_t, metric, metric_arg, budget)
+                d = pairwise_core(qt, db_t, metric, metric_arg, budget)
             bad = jax.lax.dynamic_slice_in_dim(pad_bad, t * db_tile, db_tile, 0)
             if has_filter:
                 # bitset prefilter in the tile epilogue (reference:
@@ -228,6 +241,11 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
         vals = vq[0].reshape(-1, k)
         idxs = vq[1].reshape(-1, k)
     return vals[:nq], idxs[:nq]
+
+
+#: public traceable-core name — consumed by the graftcheck jaxpr audit
+#: (R004: the underscore spelling stays package-private)
+knn_core = _knn_jit
 
 
 def search(index: Index, queries, k: int, filter=None,

@@ -46,7 +46,7 @@ from raft_tpu.ops.distance import (
     gathered_distances,
     resolve_metric,
 )
-from raft_tpu.ops.select_k import merge_topk_dedup, merge_topk_dedup_flagged
+from raft_tpu.ops.select_k import merge_topk_dedup_flagged
 from raft_tpu.utils.shape import (as_query_array, cdiv, pad_rows,
                                   query_bucket)
 
@@ -493,6 +493,12 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
     elif metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
     return out_d, out_i
+
+
+#: public traceable-core name — the cross-package contract for the
+#: sharded engine (parallel/sharded.py); the underscore spelling stays
+#: package-private (R004 layering, docs/analysis.md)
+search_core = _search_jit
 
 
 def search(

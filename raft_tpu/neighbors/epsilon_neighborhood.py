@@ -10,7 +10,6 @@ adjacency never materializes distances in HBM beyond the tile."""
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax

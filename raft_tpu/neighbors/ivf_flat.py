@@ -27,7 +27,6 @@ TPU-native design:
 from __future__ import annotations
 
 import dataclasses
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -529,6 +528,24 @@ _search_jit = jax.jit(
                      "has_overflow", "select_recall", "refine_mult"),
 )
 
+#: public traceable-core name — the cross-package contract for the sharded
+#: engine (parallel/sharded.py shard_maps this body) and the graftcheck
+#: jaxpr audit; the underscore spelling stays package-private (R004)
+search_core = _search_core
+
+
+def plan_scan_tiles(n_probes: int, list_pad: int, dim: int,
+                    workspace_limit_bytes: int) -> int:
+    """q_tile from the workspace budget: the gathered probe tile is
+    [q_tile, n_probes, list_pad, dim] fp32, ×2 for the distance/score
+    temporaries that are live with it (shared by ``search`` and the
+    graftcheck jaxpr audit, which certifies the solve statically)."""
+    per_q = n_probes * list_pad * dim * 4 * 2
+    q_tile = int(np.clip(workspace_limit_bytes // max(per_q, 1), 1, 1024))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    return q_tile
+
 
 def search(
     index: Index,
@@ -555,11 +572,8 @@ def search(
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     n_probes = int(min(params.n_probes, index.n_lists))
     list_pad = index.list_data.shape[1]
-    # q_tile from workspace: gathered tile is q_tile*n_probes*list_pad*dim fp32
-    per_q = n_probes * list_pad * index.dim * 4 * 2
-    q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1), 1, 1024))
-    if q_tile >= 8:
-        q_tile -= q_tile % 8
+    q_tile = plan_scan_tiles(n_probes, list_pad, index.dim,
+                             res.workspace_limit_bytes)
     from raft_tpu.ops import pallas_kernels as pk
 
     fast_scan = params.scan_dtype is not None

@@ -49,7 +49,7 @@ from raft_tpu.core.resources import (Resources, ensure_resources,
                                      solve_joint_tiles)
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.ops.distance import DistanceType, resolve_metric, row_norms_sq
+from raft_tpu.ops.distance import DistanceType, resolve_metric
 from raft_tpu.ops.select_k import select_k_maybe_approx
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
@@ -921,6 +921,13 @@ _search_cache_jit = jax.jit(
                      "select_recall"),
 )
 
+#: public traceable-core names — the cross-package contract for the
+#: sharded engines (parallel/sharded.py shard_maps these bodies) and the
+#: graftcheck jaxpr audit; the underscore spellings stay package-private
+#: (R004 layering, docs/analysis.md)
+search_cache_core = _search_cache_core
+encode_core = _encode_jit
+
 
 def _search_lut_core(queries, centers, rotation, codebooks, list_codes,
                      list_indices, list_sizes, filter_words,
@@ -1130,6 +1137,10 @@ _search_jit = jax.jit(
 )
 
 
+#: public traceable-core name (see search_cache_core above)
+search_lut_core = _search_lut_core
+
+
 def lut_bytes_per_query_probe(list_pad: int, pq_dim: int, pq_bits: int,
                               lut_itemsize: int = 4,
                               dist_itemsize: int = 4) -> int:
@@ -1177,6 +1188,21 @@ def plan_lut_tiles(n_probes: int, list_pad: int, pq_dim: int, pq_bits: int,
         # a 6/7-padding last chunk; cf. shape.balanced_tile)
         probe_tile = balanced_tile(n_probes, probe_tile, 1)
     return q_tile, probe_tile
+
+
+def plan_cache_tiles(n_probes: int, list_pad: int, rot_dim: int,
+                     workspace_limit_bytes: int) -> int:
+    """q_tile for the decoded-cache engine from the workspace budget: the
+    peak per query is the gathered cache tile [P, pad, rot] bf16, its fp32
+    upcast feeding the MXU einsum (the dominant term the old inline solve
+    missed — a 3x undercount caught by the graftcheck jaxpr audit), and the
+    fp32 distance/id/mask temporaries (shared by ``search`` and the audit,
+    which certifies the solve statically)."""
+    per_q = n_probes * list_pad * (rot_dim * 6 + 24)
+    q_tile = int(np.clip(workspace_limit_bytes // max(per_q, 1), 1, 1024))
+    if q_tile >= 8:
+        q_tile -= q_tile % 8
+    return q_tile
 
 
 def resolve_scan_mode(n_lists: int, list_pad: int, rot_dim: int,
@@ -1258,13 +1284,9 @@ def search(
         ensure_overflow_decoded(index, params.scan_cache_dtype)
     if scan_mode == "cache":  # resolve_scan_mode never returns "auto"
         ensure_scan_cache(index, params.scan_cache_dtype)
-        rot_dim = index.rot_dim
         # workspace: gathered decoded cache [t,P,pad,rot] bf16 + dists
-        per_q = n_probes * list_pad * (rot_dim * 2 + 12)
-        q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1),
-                             1, 1024))
-        if q_tile >= 8:
-            q_tile -= q_tile % 8
+        q_tile = plan_cache_tiles(n_probes, list_pad, index.rot_dim,
+                                  res.workspace_limit_bytes)
         from raft_tpu.ops import pallas_kernels as pk
 
         v, i = _search_cache_jit(

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import enum
 import functools
-import math
 from typing import Optional
 
 import jax
@@ -149,7 +148,8 @@ def row_norms_sq(x: jax.Array) -> jax.Array:
 
 
 def l2_expanded(
-    x, y, sqrt: bool, x_norms: Optional[jax.Array] = None, y_norms: Optional[jax.Array] = None
+    x, y, sqrt: bool, x_norms: Optional[jax.Array] = None,
+    y_norms: Optional[jax.Array] = None
 ):
     """dist_ij = ||x_i||² + ||y_j||² − 2·x_i·y_j, clamped ≥ 0 (l2_exp.cuh)."""
     xn = row_norms_sq(x) if x_norms is None else x_norms
@@ -172,7 +172,8 @@ def inner_product(x, y):
 
 
 def correlation_expanded(x, y):
-    """1 − (k·Σxy − ΣxΣy)/√((k·Σx² − (Σx)²)(k·Σy² − (Σy)²)) (correlation.cuh)."""
+    """1 − (k·Σxy − ΣxΣy)/√((k·Σx² − (Σx)²)(k·Σy² − (Σy)²))
+    (correlation.cuh)."""
     k = x.shape[-1]
     xf, yf = x.astype(jnp.float32), y.astype(jnp.float32)
     sx, sy = jnp.sum(xf, -1), jnp.sum(yf, -1)
@@ -374,6 +375,12 @@ def _pairwise_impl(x, y, metric: DistanceType, metric_arg: float, budget: int):
 @functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "budget"))
 def _pairwise_jit(x, y, metric, metric_arg, budget):
     return _pairwise_impl(x, y, metric, metric_arg, budget)
+
+
+#: public traceable-core name — the cross-package contract for callers that
+#: evaluate pairwise distances inside their own jit (sparse densify path,
+#: sharded engines).  Keeps ``_pairwise_impl`` module-private (R004).
+pairwise_core = _pairwise_impl
 
 
 def pairwise_distance(

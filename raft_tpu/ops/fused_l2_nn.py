@@ -62,6 +62,12 @@ def _fused_l2_nn_jit(x, y, x_norms, y_norms, sqrt: bool, tile: int):
     return val, idx.astype(jnp.int32)
 
 
+#: public traceable-core name — the cross-package contract for clients that
+#: compose the fused kernel inside their own jit (kmeans E-step, graftcheck
+#: jaxpr audit).  Keeps ``_fused_l2_nn_jit`` module-private (R004).
+fused_l2_nn_core = _fused_l2_nn_jit
+
+
 def fused_l2_nn_argmin(
     x,
     y,

@@ -17,12 +17,10 @@ LinearOperator-style) symmetric matrix — the spectral/partition dependency.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 # ------------------------------------------------------------- BLAS wrappers
@@ -259,14 +257,16 @@ def lanczos(
         v = vs[j]
         w = matvec(v)
         alpha = jnp.vdot(v, w)
-        w = w - alpha * v - jnp.where(j > 0, betas[j - 1], 0.0) * vs[jnp.maximum(j - 1, 0)]
+        w = (w - alpha * v
+             - jnp.where(j > 0, betas[j - 1], 0.0) * vs[jnp.maximum(j - 1, 0)])
         # full reorthogonalization against all previous vectors
         mask = (jnp.arange(ncv) <= j)[:, None]
         proj = (vs * mask) @ w
         w = w - (vs * mask).T @ proj
         beta = jnp.linalg.norm(w)
         w = w / jnp.maximum(beta, 1e-20)
-        vs = vs.at[j + 1].set(jnp.where(j + 1 < ncv, w, vs[jnp.minimum(j + 1, ncv - 1)]))
+        vs = vs.at[j + 1].set(
+            jnp.where(j + 1 < ncv, w, vs[jnp.minimum(j + 1, ncv - 1)]))
         alphas = alphas.at[j].set(alpha)
         betas = betas.at[j].set(beta)
         return vs, alphas, betas

@@ -24,11 +24,10 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from raft_tpu.utils.shape import cdiv, round_up_to
+from raft_tpu.utils.shape import round_up_to
 
 
 def _fused_l2_argmin_kernel(x_ref, y_ref, xn_ref, yn_ref, val_ref, idx_ref):

@@ -342,7 +342,7 @@ def _two_phase(values: jax.Array, k: int, select_min: bool):
     v = -values if select_min else values
     if pad:
         v = jnp.pad(v, ((0, 0), (0, pad)), constant_values=-jnp.inf)
-    vt = v.reshape(batch, n_tiles, tile)
+    vt = v.reshape(batch, n_tiles, tile)  # graftcheck: R005 — O(input) view
     # Phase 1: top-k within each tile (vmapped over tiles).
     tv, ti = jax.lax.top_k(vt, min(k, tile))
     ti = ti + (jnp.arange(n_tiles, dtype=ti.dtype) * tile)[None, :, None]

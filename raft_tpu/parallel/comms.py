@@ -25,8 +25,7 @@ mirrors that so algorithms take one ``res`` and find the communicator.
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Callable, Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

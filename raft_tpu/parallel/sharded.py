@@ -28,7 +28,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.core.resources import Resources, ensure_resources
-from raft_tpu.ops.distance import DistanceType, resolve_metric, _pairwise_impl
+from raft_tpu.ops.distance import DistanceType, resolve_metric, pairwise_core
 from raft_tpu.ops.select_k import refine_multiplier, select_k
 from raft_tpu.parallel.comms import Comms
 from raft_tpu.utils.shape import cdiv
@@ -206,7 +206,7 @@ def knn(
     def local(q_rep, x_loc):
         rank = comms.rank()
         base = rank * shard
-        d = _pairwise_impl(q_rep, x_loc, m, 2.0, 1 << 30)
+        d = pairwise_core(q_rep, x_loc, m, 2.0, 1 << 30)
         # mask padding rows of the last shard
         local_ids = jnp.arange(shard) + base
         d = jnp.where(local_ids[None, :] < n, d,
@@ -270,7 +270,7 @@ def pairwise_distance(
         def tile(i, y_cur, out):
             # after i ring shifts, this device holds shard (rank - i)
             src = (rank - i) % size
-            d = _pairwise_impl(x_loc, y_cur, m_, metric_arg, 1 << 30)
+            d = pairwise_core(x_loc, y_cur, m_, metric_arg, 1 << 30)
             return jax.lax.dynamic_update_slice(
                 out, d.astype(out.dtype), (0, src * ys_rows))
 
@@ -461,7 +461,7 @@ def search_cagra(
         seeds = jax.random.randint(
             jax.random.fold_in(key, rank), (q_rep.shape[0], n_seeds), 0,
             jnp.maximum(n_valid[0], 1), jnp.int32)
-        v, i = cagra._search_jit(
+        v, i = cagra.search_core(
             q_rep, ds[0], sds[0], gr[0], seeds, empty, index.metric, int(k),
             itopk, width, max_iter, False, fast_scan)
         # local → global ids; mask out padding rows
@@ -915,7 +915,7 @@ def search_ivf_pq(
                               index.list_codes, index.pq_dim, index.pq_bits)
 
         def local(q_rep, c, ro, ld, dn, li, ls, *over):
-            v, i = ivf_pq._search_cache_core(
+            v, i = ivf_pq.search_cache_core(
                 q_rep, c[0], ro[0], ld[0], dn[0], li[0], ls[0], empty_filter,
                 index.metric, int(k), n_probes, q_tile, False,
                 select_recall=select_recall, **unpack_over(over))
@@ -942,7 +942,7 @@ def search_ivf_pq(
     dist_dtype = jnp.dtype(params.internal_distance_dtype).name
 
     def local(q_rep, c, ro, cb, lc, li, ls, *over):
-        v, i = ivf_pq._search_lut_core(
+        v, i = ivf_pq.search_lut_core(
             q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
             index.metric, int(k), n_probes, q_tile, index.per_cluster,
             index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
@@ -1012,7 +1012,7 @@ def search_ivf_flat(
     if has_overflow:
         # each device scans its own spill block alongside its probed lists
         def local(q_rep, c, ld, li, ls, od, oi):
-            v, i = ivf_flat._search_core(
+            v, i = ivf_flat.search_core(
                 q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
                 int(k), n_probes, q_tile, False, fast_scan=fast_scan,
                 overflow_data=od[0], overflow_indices=oi[0],
@@ -1032,7 +1032,7 @@ def search_ivf_flat(
                            index.overflow_data, index.overflow_indices)
 
     def local(q_rep, c, ld, li, ls):
-        v, i = ivf_flat._search_core(
+        v, i = ivf_flat.search_core(
             q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
             int(k), n_probes, q_tile, False, fast_scan=fast_scan,
             select_recall=select_recall, refine_mult=refine_mult)
@@ -1251,7 +1251,7 @@ def _elastic_lut_search(queries, centers, rotation, codebooks, list_codes,
         kw = (dict(overflow_decoded=od, overflow_norms=on,
                    overflow_indices=oi, has_overflow=True)
               if has_overflow else {})
-        return ivf_pq._search_lut_core(
+        return ivf_pq.search_lut_core(
             queries, c, ro, cb, lc, li, ls, empty_filter, metric, k,
             n_probes, q_tile, per_cluster, pq_dim, pq_bits, False,
             lut_dtype, dist_dtype, select_recall=select_recall,
@@ -1281,7 +1281,7 @@ def _elastic_cache_search(queries, centers, rotation, list_decoded,
         kw = (dict(overflow_decoded=od, overflow_norms=on,
                    overflow_indices=oi, has_overflow=True)
               if has_overflow else {})
-        return ivf_pq._search_cache_core(
+        return ivf_pq.search_cache_core(
             queries, c, ro, ld, dn, li, ls, empty_filter, metric, k,
             n_probes, q_tile, False, select_recall=select_recall, **kw)
 

@@ -15,7 +15,6 @@ comes from the Resources workspace budget like every other tiled op."""
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -25,12 +24,11 @@ import numpy as np
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
-    _pairwise_impl,
+    pairwise_core,
     resolve_metric,
 )
 from raft_tpu.sparse.types import CSR
 from raft_tpu.sparse.convert import csr_to_dense
-from raft_tpu.utils.shape import cdiv
 
 SUPPORTED = (
     DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
@@ -95,7 +93,7 @@ def pairwise_distance(
             inter, nx, ny = _binary_overlap(xt, yd)
             return 1.0 - 2.0 * inter / jnp.maximum(
                 nx[:, None] + ny[None, :], 1.0)
-        return _pairwise_impl(xt, yd, m, float(metric_arg),
+        return pairwise_core(xt, yd, m, float(metric_arg),
                               res.workspace_limit_bytes)
 
     if n_x <= tile:

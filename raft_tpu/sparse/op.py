@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.sparse.types import COO, CSR
-from raft_tpu.sparse.convert import coo_to_csr, csr_to_coo
 
 
 def _compact(coo: COO, keep) -> Tuple[COO, jax.Array]:

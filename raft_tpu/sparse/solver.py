@@ -15,7 +15,6 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
 
 from raft_tpu.ops import linalg as rlinalg
 from raft_tpu.sparse.linalg import spmv

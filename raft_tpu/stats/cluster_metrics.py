@@ -18,7 +18,9 @@ def _contingency(a, b, n_a: int, n_b: int) -> jax.Array:
     a = jnp.asarray(a, jnp.int32)
     b = jnp.asarray(b, jnp.int32)
     flat = a * n_b + b
-    counts = jnp.zeros((n_a * n_b,), jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
+    counts = jnp.zeros(
+        (n_a * n_b,),
+        jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
     return counts.at[flat].add(1.0).reshape(n_a, n_b)
 
 
@@ -95,7 +97,8 @@ def silhouette_score(x, labels, n_classes: int, metric="l2_expanded"):
     own_size = cluster_sizes[own]
     # a: mean intra-cluster distance excluding self (distance to self is 0).
     a = jnp.where(own_size > 1,
-                  jnp.take_along_axis(sums, own[:, None], 1)[:, 0] / jnp.maximum(own_size - 1, 1),
+                  jnp.take_along_axis(sums, own[:, None], 1)[:, 0]
+                  / jnp.maximum(own_size - 1, 1),
                   0.0)
     # b: min over other clusters of mean distance.
     means = sums / jnp.maximum(cluster_sizes[None, :], 1.0)

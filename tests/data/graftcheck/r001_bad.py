@@ -1,0 +1,10 @@
+"""R001 fixture: host-sync inside a jit-traced function."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def pulls_to_host(x):
+    y = jnp.sum(x * x)
+    return np.asarray(y)  # device->host sync under trace

@@ -1,0 +1,11 @@
+"""R002 fixture: Python `if` on a traced value inside jit."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branches_on_tracer(x):
+    s = jnp.sum(x)
+    if s:  # TracerBoolConversionError at trace time
+        return x
+    return -x

@@ -1,0 +1,14 @@
+"""R002 clean twin: data-dependent choice via jnp.where; the only Python
+branches are on static properties (shape) and `is None`."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def selects_on_device(x, bias=None):
+    s = jnp.sum(x)
+    if x.shape[0] > 1:
+        x = x[:1]
+    if bias is not None:
+        x = x + bias
+    return jnp.where(s > 0, x, -x)

@@ -1,0 +1,10 @@
+"""R003 fixture: jax.jit constructed inside a loop."""
+import jax
+
+
+def compiles_every_iteration(xs):
+    out = []
+    for scale in (1, 2, 3):
+        f = jax.jit(lambda v, s=scale: v * s)
+        out.append(f(xs))
+    return out

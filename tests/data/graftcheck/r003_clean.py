@@ -1,0 +1,13 @@
+"""R003 clean twin: one wrapper, static argument varies per call."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("s",))
+def scaled(v, s):
+    return v * s
+
+
+def compiles_once_per_scale(xs):
+    return [scaled(xs, s) for s in (1, 2, 3)]

@@ -1,0 +1,6 @@
+"""R004 fixture: cross-package import of a private name."""
+from raft_tpu.fixture_pkg_a.r004_provider import _detail_kernel
+
+
+def consumes_detail(x):
+    return _detail_kernel(x)

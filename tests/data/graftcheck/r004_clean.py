@@ -1,0 +1,6 @@
+"""R004 clean twin: crosses the package boundary through the public name."""
+from raft_tpu.fixture_pkg_a.r004_provider import public_kernel
+
+
+def consumes_public(x):
+    return public_kernel(x)

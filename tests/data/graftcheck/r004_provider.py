@@ -1,0 +1,8 @@
+"""R004 fixture provider: a package-private detail plus its public name."""
+
+
+def _detail_kernel(x):
+    return x * 2
+
+
+public_kernel = _detail_kernel

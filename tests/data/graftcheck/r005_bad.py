@@ -1,0 +1,9 @@
+"""R005 fixture: 3-symbolic-dim intermediate with no workspace solve."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def gathers_everything(lut, idx):
+    g = jnp.zeros((idx.shape[0], idx.shape[1], lut.shape[1]), jnp.float32)
+    return g + lut[idx]

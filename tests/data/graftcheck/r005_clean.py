@@ -1,0 +1,17 @@
+"""R005 clean twin: same intermediate, but the enclosing caller sizes the
+tiles with the workspace solver, so the live set is budget-bounded."""
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import solve_joint_tiles
+
+
+@jax.jit
+def gather_core(lut, idx, q_tile):
+    g = jnp.zeros((q_tile, idx.shape[1], lut.shape[1]), jnp.float32)
+    return g + lut[idx[:q_tile]]
+
+
+def gather_search(lut, idx, budget):
+    q_tile, p_tile = solve_joint_tiles(budget, lut.shape[1] * 4, idx.shape[1])
+    return gather_core(lut, idx, q_tile)

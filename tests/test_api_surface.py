@@ -141,3 +141,38 @@ def test_round4_surface_names():
     from raft_tpu.utils.shape import as_query_array  # noqa: F401
 
     assert SelectAlgo.SCREEN.value == "screen"
+
+
+def test_imports_are_deprecation_clean():
+    """Importing the full public surface must not raise DeprecationWarning
+    (one subprocess so -W error::DeprecationWarning covers import time)."""
+    import os
+    import subprocess
+    import sys
+
+    mods = sorted(set(MODULES) | set(_discover_modules()))
+    code = ("import importlib\n"
+            "for m in %r:\n"
+            "    importlib.import_module(m)\n" % (mods,))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-W", "error::DeprecationWarning", "-c", code],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_no_cross_package_private_imports():
+    """R004 as an API-surface invariant: no raft_tpu package reaches
+    another package's underscore-private names (the detail:: layering
+    convention); enforced by the same analyzer the graftcheck CI gate
+    runs, so a local pytest run fails before CI does."""
+    import os
+
+    from raft_tpu.analysis import collect_modules
+    from raft_tpu.analysis.layering import check_layering
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    modules, parse_errors = collect_modules(repo, dirs=("raft_tpu",))
+    assert parse_errors == []
+    findings = check_layering(modules)
+    assert findings == [], "\n".join(f.format() for f in findings)

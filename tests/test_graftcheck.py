@@ -1,0 +1,164 @@
+"""graftcheck suite: Tier-A rules on one-violation fixtures (plus clean
+twins), the baseline round-trip, and the Tier-B jaxpr memory audit
+cross-checked against the itemized LUT model from docs/tuning.md."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.analysis import (AST_RULES, ModuleInfo, check_layering,
+                               load_baseline, run_tier_a, save_baseline,
+                               split_by_baseline)
+from raft_tpu.analysis.rules_ast import (rule_host_sync, rule_recompile_hazard,
+                                         rule_traced_branch,
+                                         rule_unguarded_broadcast)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
+
+
+def _mod(fname, modname):
+    return ModuleInfo(os.path.join(FIXDIR, fname),
+                      f"tests/data/graftcheck/{fname}", modname)
+
+
+# ------------------------------------------------------------ Tier A rules
+
+@pytest.mark.parametrize("rule,bad,clean,expect_qual", [
+    (rule_host_sync, "r001_bad.py", "r001_clean.py", "pulls_to_host"),
+    (rule_traced_branch, "r002_bad.py", "r002_clean.py",
+     "branches_on_tracer"),
+    (rule_recompile_hazard, "r003_bad.py", "r003_clean.py",
+     "compiles_every_iteration"),
+    (rule_unguarded_broadcast, "r005_bad.py", "r005_clean.py",
+     "gathers_everything"),
+], ids=["R001", "R002", "R003", "R005"])
+def test_rule_flags_bad_and_passes_clean(rule, bad, clean, expect_qual):
+    rule_id = {rule_host_sync: "R001", rule_traced_branch: "R002",
+               rule_recompile_hazard: "R003",
+               rule_unguarded_broadcast: "R005"}[rule]
+    found = rule(_mod(bad, f"raft_tpu.fixture_pkg_b.{bad[:-3]}"))
+    assert [(f.rule, f.qualname) for f in found] == [(rule_id, expect_qual)]
+    assert rule(_mod(clean, f"raft_tpu.fixture_pkg_b.{clean[:-3]}")) == []
+
+
+def test_clean_twins_pass_every_rule():
+    for fname in ("r001_clean.py", "r002_clean.py", "r003_clean.py",
+                  "r005_clean.py"):
+        mod = _mod(fname, f"raft_tpu.fixture_pkg_b.{fname[:-3]}")
+        for rule in AST_RULES:
+            assert rule(mod) == [], (fname, rule.__name__)
+
+
+def test_layering_flags_cross_package_private_import():
+    provider = _mod("r004_provider.py", "raft_tpu.fixture_pkg_a.r004_provider")
+    bad = _mod("r004_bad.py", "raft_tpu.fixture_pkg_b.r004_bad")
+    clean = _mod("r004_clean.py", "raft_tpu.fixture_pkg_b.r004_clean")
+    found = check_layering([provider, bad, clean])
+    assert [(f.rule, f.file, f.qualname) for f in found] == [
+        ("R004", "tests/data/graftcheck/r004_bad.py", "<module>")]
+    assert "_detail_kernel" in found[0].message
+
+
+def test_layering_allows_same_package_private_use():
+    provider = _mod("r004_provider.py", "raft_tpu.fixture_pkg_a.r004_provider")
+    # same file re-declared as a sibling of the provider's package
+    sibling = _mod("r004_bad.py", "raft_tpu.fixture_pkg_a.r004_bad")
+    assert check_layering([provider, sibling]) == []
+
+
+def test_inline_suppression(tmp_path):
+    src = open(os.path.join(FIXDIR, "r002_bad.py")).read()
+    src = src.replace("    if s:", "    if s:  # graftcheck: R002")
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    mod = ModuleInfo(str(p), "suppressed.py", "raft_tpu.fixture.suppressed")
+    assert rule_traced_branch(mod) == []
+
+
+# ------------------------------------------------------- baseline handling
+
+def test_baseline_round_trip(tmp_path):
+    mod = _mod("r001_bad.py", "raft_tpu.fixture_pkg_b.r001_bad")
+    findings = rule_host_sync(mod)
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), findings, {})
+    baseline = load_baseline(str(path))
+    new, suppressed = split_by_baseline(findings, baseline)
+    assert new == [] and len(suppressed) == 1
+    # keys survive line churn: same (rule, file, qualname), any line
+    moved = [type(f)(f.rule, f.file, f.qualname, f.line + 40, f.message)
+             for f in findings]
+    new, suppressed = split_by_baseline(moved, baseline)
+    assert new == [] and len(suppressed) == 1
+
+
+def test_baseline_update_carries_justifications(tmp_path):
+    mod = _mod("r001_bad.py", "raft_tpu.fixture_pkg_b.r001_bad")
+    findings = rule_host_sync(mod)
+    path = tmp_path / "baseline.json"
+    save_baseline(str(path), findings, {})
+    data = json.load(open(path))
+    data["entries"][0]["justification"] = "measured, deliberate"
+    json.dump(data, open(path, "w"))
+    save_baseline(str(path), findings, load_baseline(str(path)))
+    assert (json.load(open(path))["entries"][0]["justification"]
+            == "measured, deliberate")
+
+
+# --------------------------------------------------------------- the gate
+
+def test_repo_is_clean_under_committed_baseline():
+    findings = run_tier_a(REPO)
+    baseline = load_baseline(os.path.join(REPO, "graftcheck_baseline.json"))
+    new, _ = split_by_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_cli_nonzero_on_injected_violation(tmp_path):
+    pkg = tmp_path / "raft_tpu" / "fixture_pkg_b"
+    pkg.mkdir(parents=True)
+    bad = open(os.path.join(FIXDIR, "r001_bad.py")).read()
+    (pkg / "injected.py").write_text(bad)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+         "--root", str(tmp_path), "--no-baseline"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "R001" in proc.stdout and "pulls_to_host" in proc.stdout
+
+
+# ------------------------------------------------------------------ Tier B
+
+def test_jaxpr_walker_within_2x_of_itemized_lut_model():
+    from raft_tpu.analysis import jaxpr_audit as ja
+    budget = ja.DEFAULT_BUDGET_BYTES
+    peak = ja.peak_live_bytes(ja.make_ivf_pq_lut_jaxpr(budget))
+    oracle = ja.lut_itemized_peak(budget_bytes=budget)
+    ratio = max(peak, oracle) / min(peak, oracle)
+    assert ratio <= 2.0, (peak, oracle, ratio)
+
+
+def test_audit_certifies_lut_search_at_sift1m_crash_shape():
+    from raft_tpu.analysis import jaxpr_audit as ja
+    budget = ja.DEFAULT_BUDGET_BYTES
+    peak = ja.peak_live_bytes(ja.make_ivf_pq_lut_jaxpr(budget))
+    assert peak <= budget
+
+
+def test_audit_detects_pre_tiling_unbounded_variant():
+    from raft_tpu.analysis import jaxpr_audit as ja
+    budget = ja.DEFAULT_BUDGET_BYTES
+    peak = ja.peak_live_bytes(
+        ja.make_ivf_pq_lut_jaxpr(budget, unbounded_variant=True))
+    assert peak > 4 * budget  # the sift-1M crash: ~5x over a 2 GiB budget
+
+
+def test_audit_default_entries_all_within_budget():
+    from raft_tpu.analysis import jaxpr_audit as ja
+    results, findings = ja.run_audit()
+    assert len(results) == 7
+    assert findings == [], [f.format() for f in findings]
+    assert all(r.ok for r in results)

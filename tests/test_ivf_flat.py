@@ -64,7 +64,8 @@ def test_full_probe_is_exact(data, gt):
 def test_inner_product(data):
     db, q = data
     dbn = db / np.linalg.norm(db, axis=1, keepdims=True)
-    index = ivf_flat.build(dbn, ivf_flat.IndexParams(n_lists=16, metric="inner_product"))
+    index = ivf_flat.build(
+        dbn, ivf_flat.IndexParams(n_lists=16, metric="inner_product"))
     d, i = ivf_flat.search(index, q, 10, ivf_flat.SearchParams(n_probes=16))
     ip = q @ dbn.T
     want = np.argsort(-ip, 1)[:, :10]

@@ -1,7 +1,6 @@
 """Out-of-core streamed builds (reference analog: host-memory datasets +
 batched staging, wiki_all larger-than-memory workflow)."""
 
-import os
 
 import numpy as np
 import pytest

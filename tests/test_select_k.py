@@ -16,7 +16,9 @@ def _ref_select(values, k, select_min):
 @pytest.mark.parametrize(
     "algo", [SelectAlgo.DIRECT, SelectAlgo.TWO_PHASE, SelectAlgo.SCREEN,
              SelectAlgo.AUTO])
-@pytest.mark.parametrize("shape,k", [((4, 100), 10), ((1, 17), 17), ((7, 2048), 256), ((3, 100000), 64)])
+@pytest.mark.parametrize(
+    "shape,k",
+    [((4, 100), 10), ((1, 17), 17), ((7, 2048), 256), ((3, 100000), 64)])
 @pytest.mark.parametrize("select_min", [True, False])
 def test_select_k(algo, shape, k, select_min, rng):
     if shape[1] < 100 and algo == SelectAlgo.TWO_PHASE:
@@ -24,7 +26,8 @@ def test_select_k(algo, shape, k, select_min, rng):
     values = rng.standard_normal(shape).astype(np.float32)
     got_v, got_i = select_k(values, k, select_min=select_min, algo=algo)
     want_v, _ = _ref_select(values, k, select_min)
-    np.testing.assert_allclose(np.sort(np.asarray(got_v), -1), np.sort(want_v, -1), rtol=1e-6)
+    np.testing.assert_allclose(np.sort(np.asarray(got_v), -1),
+                               np.sort(want_v, -1), rtol=1e-6)
     # indices must gather the returned values
     np.testing.assert_allclose(
         np.take_along_axis(values, np.asarray(got_i), -1), np.asarray(got_v), rtol=1e-6

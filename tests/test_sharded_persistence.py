@@ -112,7 +112,8 @@ def _assert_same_neighbors(d0, i0, d1, i1, rtol=1e-4):
 def test_elastic_restore_matches_mesh_search(tmp_path, data, scan_mode):
     """Elastic restore (any device count) returns the same neighbors as
     the mesh search it was checkpointed from (distances to fp tolerance —
-    same cores, same merge, different compiled program), no mesh required (the single-chip serving path for a multi-shard
+    same cores, same merge, different compiled program), no mesh required
+    (the single-chip serving path for a multi-shard
     build)."""
     x, q = data
     comms = comms_mod.init_comms(axis="elastic_pq_" + scan_mode)

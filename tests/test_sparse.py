@@ -2,7 +2,6 @@
 single-linkage (reference: cpp/test/sparse/*, cpp/test/cluster/linkage.cu)."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

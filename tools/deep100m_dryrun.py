@@ -67,7 +67,6 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    import numpy as np
 
     art = {"config": vars(args), "hbm": [], "eval_shape": {}}
     for chips in (8, 16, 32):
@@ -97,7 +96,7 @@ def main():
     try:
         out = jax.eval_shape(
             lambda q, c, rot, books, codes, ids, sizes: (
-                ivfpq._search_lut_core(
+                ivfpq.search_lut_core(
                     q, c, rot, books, codes, ids, sizes,
                     jnp.zeros((0,), jnp.uint32),
                     metric=ivfpq.DistanceType.L2Expanded, k=k,

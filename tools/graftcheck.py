@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""graftcheck — JAX/TPU-aware static analysis gate for raft_tpu.
+
+Tier A (default) is pure AST work and never imports JAX, so it runs in
+well under a second and is safe for pre-commit.  Tier B
+(``--jaxpr-audit``) imports JAX, abstract-evals the public entrypoints
+at canonical shapes (sift-1M crash shape included) and bounds the peak
+live set of each jaxpr against the workspace budget.
+
+Exit status: 0 when every finding is baselined, 1 when new findings
+exist, 2 on usage errors.
+
+Typical use::
+
+    python tools/graftcheck.py                    # Tier A, gate on baseline
+    python tools/graftcheck.py --jaxpr-audit      # Tier A + Tier B
+    python tools/graftcheck.py --update-baseline  # re-record the baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from raft_tpu.analysis import (load_baseline, run_tier_a,  # noqa: E402
+                               save_baseline, split_by_baseline)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftcheck_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(carries existing justifications forward)")
+    ap.add_argument("--jaxpr-audit", action="store_true",
+                    help="also run the Tier-B jaxpr memory-budget audit "
+                         "(imports JAX)")
+    ap.add_argument("--budget-bytes", type=int, default=None,
+                    help="override the Tier-B workspace budget "
+                         "(default: 2 GiB, the CPU-fallback "
+                         "workspace_limit_bytes)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to keep (e.g. R001,R004)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+
+    findings = run_tier_a(args.root)
+
+    if args.jaxpr_audit:
+        from raft_tpu.analysis import jaxpr_audit
+        budget = args.budget_bytes or jaxpr_audit.DEFAULT_BUDGET_BYTES
+        results, audit_findings = jaxpr_audit.run_audit(budget_bytes=budget)
+        findings.extend(audit_findings)
+        if not args.quiet:
+            for r in results:
+                state = "OK  " if r.ok else "FAIL"
+                print(f"  [jaxpr-audit] {state} {r.name}: peak "
+                      f"{r.peak_bytes / 2**20:.1f} MiB "
+                      f"<= budget {r.budget_bytes / 2**20:.0f} MiB"
+                      if r.ok else
+                      f"  [jaxpr-audit] {state} {r.name}: peak "
+                      f"{r.peak_bytes / 2**20:.1f} MiB "
+                      f"> budget {r.budget_bytes / 2**20:.0f} MiB")
+
+    if args.rules:
+        keep = {r.strip() for r in args.rules.split(",") if r.strip()}
+        findings = [f for f in findings if f.rule in keep]
+
+    if args.update_baseline:
+        old = load_baseline(args.baseline)
+        save_baseline(args.baseline, findings, old)
+        print(f"graftcheck: baseline rewritten with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, suppressed = split_by_baseline(findings, baseline)
+
+    if not args.quiet:
+        for f in new:
+            print(f.format())
+    n_rules = len({f.rule for f in new})
+    print(f"graftcheck: {len(new)} new finding(s) across {n_rules} rule(s); "
+          f"{len(suppressed)} baselined")
+    if new:
+        print("fix the findings, suppress a line with '# graftcheck: RXXX', "
+              "or re-record with --update-baseline (justify in the JSON)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,7 +44,6 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     import jax
-    import jax.numpy as jnp
 
     from raft_tpu.bench import timing
     from raft_tpu.neighbors import ivf_flat, ivf_pq

@@ -30,7 +30,6 @@ def main():
     args = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
 
     from raft_tpu.bench.timing import prepare, time_dispatches
     from raft_tpu.ops import fused_l2_nn as fl
