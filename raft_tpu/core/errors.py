@@ -16,6 +16,22 @@ class LogicError(RaftError):
     """Precondition violation (raft::logic_error / RAFT_EXPECTS)."""
 
 
+class IntegrityError(RaftError):
+    """A checkpoint file failed validation: missing, truncated, or corrupt.
+
+    ``path`` names the file, ``record`` the 0-based framed record inside it
+    (None when the fault is file-level), and ``reason`` is one of
+    ``"missing"``, ``"truncated"``, ``"corrupt"`` so callers (degraded-mode
+    restore, pre-flight verification) can branch without parsing messages.
+    """
+
+    def __init__(self, message: str, *, path=None, record=None, reason=None):
+        super().__init__(message)
+        self.path = path
+        self.record = record
+        self.reason = reason
+
+
 def expects(condition: bool, message: str = "precondition violated") -> None:
     """``RAFT_EXPECTS(cond, msg)`` — raise LogicError unless condition.
 

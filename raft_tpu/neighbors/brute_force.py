@@ -324,33 +324,28 @@ _SERIAL_VERSION = 1
 
 
 def serialize(index: Index, file) -> None:
-    """Write index (reference: brute_force_serialize.cuh)."""
-    stream, close = ser.open_for(file, "wb")
-    try:
+    """Write index (reference: brute_force_serialize.cuh). Paths are
+    written atomically (tmp + os.replace) with per-record crc framing."""
+    with ser.writer_for(file) as stream:
         w = ser.IndexWriter(stream, "brute_force", _SERIAL_VERSION)
         w.scalar(int(index.metric), "<i4").scalar(index.metric_arg, "<f8")
         w.array(index.dataset)
         w.scalar(1 if index.norms is not None else 0, "<i4")
         if index.norms is not None:
             w.array(index.norms)
-    finally:
-        if close:
-            stream.close()
+        w.finish()
 
 
 def deserialize(file, res: Optional[Resources] = None) -> Index:
     ensure_resources(res)
-    stream, close = ser.open_for(file, "rb")
-    try:
+    with ser.reader_for(file) as stream:
         r = ser.IndexReader(stream, "brute_force", _SERIAL_VERSION)
         metric = DistanceType(r.scalar())
         metric_arg = r.scalar()
         dataset = jnp.asarray(r.array())
         norms = jnp.asarray(r.array()) if r.scalar() else None
+        r.finish()
         return Index(dataset, metric, metric_arg, norms)
-    finally:
-        if close:
-            stream.close()
 
 
 def make_batch_k_query(index: Index, queries, batch_size: int,

@@ -565,9 +565,9 @@ _SERIAL_VERSION = 1
 
 
 def serialize(index: Index, file, include_dataset: bool = True) -> None:
-    """reference: detail/cagra/cagra_serialize.cuh."""
-    stream, close = ser.open_for(file, "wb")
-    try:
+    """reference: detail/cagra/cagra_serialize.cuh. Paths are written
+    atomically (tmp + os.replace) with per-record crc framing."""
+    with ser.writer_for(file) as stream:
         w = ser.IndexWriter(stream, "cagra", _SERIAL_VERSION)
         w.scalar(int(index.metric), "<i4")
         w.scalar(index.graph_degree, "<i4")
@@ -575,15 +575,12 @@ def serialize(index: Index, file, include_dataset: bool = True) -> None:
         w.array(index.graph)
         if include_dataset:
             w.array(index.dataset)
-    finally:
-        if close:
-            stream.close()
+        w.finish()
 
 
 def deserialize(file, dataset=None, res: Optional[Resources] = None) -> Index:
     ensure_resources(res)
-    stream, close = ser.open_for(file, "rb")
-    try:
+    with ser.reader_for(file) as stream:
         r = ser.IndexReader(stream, "cagra", _SERIAL_VERSION)
         metric = DistanceType(r.scalar())
         graph_degree = r.scalar()
@@ -596,8 +593,6 @@ def deserialize(file, dataset=None, res: Optional[Resources] = None) -> Index:
         else:
             raise ValueError(
                 "index file has no dataset; pass dataset= to deserialize")
+        r.finish()
         params = IndexParams(graph_degree=graph_degree, metric=metric)
         return Index(params, ds, graph)
-    finally:
-        if close:
-            stream.close()

@@ -610,11 +610,11 @@ _SERIAL_VERSION = 2  # v2: + list_pad_expansion, overflow block
 
 
 def serialize(index: Index, file) -> None:
-    """reference: detail/ivf_flat_serialize.cuh."""
+    """reference: detail/ivf_flat_serialize.cuh. Paths are written
+    atomically (tmp + os.replace) with per-record crc framing."""
     if index.list_data is None:
         raise ValueError("index has no data; call extend() before serialize()")
-    stream, close = ser.open_for(file, "wb")
-    try:
+    with ser.writer_for(file) as stream:
         w = ser.IndexWriter(stream, "ivf_flat", _SERIAL_VERSION)
         w.scalar(int(index.metric), "<i4")
         w.scalar(index.params.n_lists, "<i8")
@@ -629,15 +629,12 @@ def serialize(index: Index, file) -> None:
         w.array(index.list_sizes)
         w.array(index.overflow_data)
         w.array(index.overflow_indices)
-    finally:
-        if close:
-            stream.close()
+        w.finish()
 
 
 def deserialize(file, res: Optional[Resources] = None) -> Index:
     ensure_resources(res)
-    stream, close = ser.open_for(file, "rb")
-    try:
+    with ser.reader_for(file) as stream:
         r = ser.IndexReader(stream, "ivf_flat", _SERIAL_VERSION)
         metric = DistanceType(r.scalar())
         params = IndexParams(
@@ -654,11 +651,9 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
         sizes = jnp.asarray(r.array())
         over_rows = jnp.asarray(r.array()) if r.version >= 2 else None
         over_ids = jnp.asarray(r.array()) if r.version >= 2 else None
+        r.finish()
         return Index(params, centers, data, idxs, sizes, n_rows,
                      over_rows, over_ids)
-    finally:
-        if close:
-            stream.close()
 
 
 # ------------------------------------------------------------------ helpers

@@ -1331,11 +1331,11 @@ _SERIAL_VERSION = 2  # v2: + list_pad_expansion, overflow block
 
 
 def serialize(index: Index, file) -> None:
-    """reference: detail/ivf_pq_serialize.cuh."""
+    """reference: detail/ivf_pq_serialize.cuh. Paths are written
+    atomically (tmp + os.replace) with per-record crc framing."""
     if index.list_codes is None:
         raise ValueError("index has no data; call extend() before serialize()")
-    stream, close = ser.open_for(file, "wb")
-    try:
+    with ser.writer_for(file) as stream:
         w = ser.IndexWriter(stream, "ivf_pq", _SERIAL_VERSION)
         w.scalar(int(index.metric), "<i4")
         w.scalar(index.params.n_lists, "<i8")
@@ -1356,15 +1356,12 @@ def serialize(index: Index, file) -> None:
         w.array(index.overflow_codes)
         w.array(index.overflow_labels)
         w.array(index.overflow_indices)
-    finally:
-        if close:
-            stream.close()
+        w.finish()
 
 
 def deserialize(file, res: Optional[Resources] = None) -> Index:
     ensure_resources(res)
-    stream, close = ser.open_for(file, "rb")
-    try:
+    with ser.reader_for(file) as stream:
         r = ser.IndexReader(stream, "ivf_pq", _SERIAL_VERSION)
         metric = DistanceType(r.scalar())
         n_lists = r.scalar()
@@ -1392,11 +1389,9 @@ def deserialize(file, res: Optional[Resources] = None) -> Index:
         o_codes = jnp.asarray(r.array()) if r.version >= 2 else None
         o_labels = jnp.asarray(r.array()) if r.version >= 2 else None
         o_ids = jnp.asarray(r.array()) if r.version >= 2 else None
+        r.finish()
         return Index(params, pq_dim, centers, rotation, codebooks, codes,
                      idxs, sizes, n_rows, o_codes, o_labels, o_ids)
-    finally:
-        if close:
-            stream.close()
 
 
 # ------------------------------------------------------------------ helpers

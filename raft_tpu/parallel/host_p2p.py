@@ -32,6 +32,7 @@ import errno
 import io
 import os
 import queue
+import random
 import selectors
 import socket
 import struct
@@ -57,11 +58,17 @@ class Request:
     """An in-flight isend/irecv (the request_t analog). ``wait`` blocks
     until completion and, for receives, returns the payload. A receive
     whose ``wait`` times out is cancelled: the message it would have
-    matched goes to the next ``irecv`` instead of being lost."""
+    matched goes to the next ``irecv`` instead of being lost.
 
-    def __init__(self, kind: str, lock: threading.Lock):
+    ``wait()`` with no explicit timeout uses the ENDPOINT's timeout as a
+    real deadline (raising TimeoutError) rather than blocking forever — a
+    dead peer costs a bounded wait, never a hung serving process."""
+
+    def __init__(self, kind: str, lock: threading.Lock,
+                 default_timeout: Optional[float] = None):
         self.kind = kind
         self._lock = lock  # endpoint matching lock
+        self._default_timeout = default_timeout
         self._done = threading.Event()
         self._cancelled = False
         self._value = None
@@ -76,11 +83,14 @@ class Request:
         return self._done.is_set()
 
     def wait(self, timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = self._default_timeout
         if not self._done.wait(timeout):
             with self._lock:
                 if not self._done.is_set():  # lost the race with delivery?
                     self._cancelled = True
-                    raise TimeoutError(f"{self.kind} request timed out")
+                    raise TimeoutError(
+                        f"{self.kind} request timed out after {timeout}s")
         if self._error is not None:
             raise self._error
         return self._value
@@ -130,14 +140,33 @@ class HostP2P:
 
     ``peers``: (host, port) per rank. ``peers=None`` → all-localhost at
     ``base_port + r`` (single-host multiprocess, and the CI shape).
+
+    Fault model (docs/robustness.md): a failed connect/send is RETRIED up
+    to ``retries`` times with exponential backoff + jitter before the
+    stream poisons (``retries=0`` restores strict fail-fast). Retried
+    sends are at-least-once: a frame cut mid-send is resent whole on a
+    fresh connection, so a crash window can deliver a message twice —
+    receivers that care must dedup by tag/sequence. ``wait``/``waitall``
+    default to the endpoint ``timeout`` as a hard deadline (TimeoutError,
+    never a hang). A connection that drops MID-FRAME starts a
+    ``peer_grace`` timer on the receiver; if the peer has not delivered
+    again when it fires, every pending ``irecv`` from that source fails
+    with ConnectionError (a reconnect in the window cancels the verdict —
+    it was a sender retry, not a death).
     """
 
     def __init__(self, rank: int, size: int,
                  peers: Optional[Sequence[Tuple[str, int]]] = None,
-                 base_port: int = 41300, timeout: float = 120.0):
+                 base_port: int = 41300, timeout: float = 120.0,
+                 retries: int = 3, retry_backoff: float = 0.05,
+                 retry_backoff_max: float = 2.0, peer_grace: float = 2.0):
         self.rank = int(rank)
         self.size = int(size)
         self.timeout = timeout
+        self.retries = int(retries)
+        self.retry_backoff = float(retry_backoff)
+        self.retry_backoff_max = float(retry_backoff_max)
+        self.peer_grace = float(peer_grace)
         self.peers = (list(peers) if peers is not None
                       else [("127.0.0.1", base_port + r)
                             for r in range(size)])
@@ -148,9 +177,16 @@ class HostP2P:
         self._match_lock = threading.Lock()
         self._inbox: dict = {}  # (src, tag) -> deque of payloads
         self._waiting: dict = {}  # (src, tag) -> deque of Requests
+        # per-src delivery generation counters (under _match_lock): an
+        # abnormal connection drop schedules a grace check against the
+        # generation at drop time — any later delivery proves the peer
+        # (or its retry) is alive and voids the death verdict
+        self._peer_gen: dict = {}
         # per-destination sender worker: one persistent connection, FIFO
         self._send_queues: dict = {}
         self._send_lock = threading.Lock()
+        # dest -> live outbound socket (test hook _sever_send cuts it)
+        self._active_send: dict = {}
         self._conns: set = set()  # live accepted connections (see close())
         self._conns_lock = threading.Lock()
         self._closed = threading.Event()
@@ -182,27 +218,44 @@ class HostP2P:
 
     def _serve(self, conn: socket.socket):
         """One thread per inbound connection; messages on a connection are
-        delivered in arrival order (TCP preserves the sender's order)."""
+        delivered in arrival order (TCP preserves the sender's order).
+
+        A connection that ends CLEANLY at a frame boundary is a normal
+        disconnect. One that cuts mid-frame (partial header/payload,
+        reset) is ABNORMAL: the sender likely died mid-send — schedule a
+        peer-death check so its pending irecvs fail after ``peer_grace``
+        instead of waiting out the full endpoint timeout."""
+        last_src = None
+        abnormal = False
         try:
             with conn:
                 while True:
                     hdr = conn.recv(_HDR.size, socket.MSG_WAITALL)
+                    if not hdr:
+                        return  # clean EOF at a frame boundary
                     if len(hdr) < _HDR.size:
+                        abnormal = True  # cut mid-header
                         return
                     magic, src, tag, nbytes = _HDR.unpack(hdr)
                     if magic != _MAGIC:
                         raise ConnectionError("bad frame magic")
+                    last_src = src
                     ty = _read_exact(conn, 1)
                     raw = _read_exact(conn, nbytes)
                     self._deliver(src, tag, _decode(ty, raw))
         except (ConnectionError, OSError):
+            abnormal = True
             return
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
+            if (abnormal and last_src is not None
+                    and not self._closed.is_set()):
+                self._schedule_peer_check(last_src)
 
     def _deliver(self, src: int, tag: int, payload):
         with self._match_lock:
+            self._peer_gen[src] = self._peer_gen.get(src, 0) + 1
             waiting = self._waiting.get((src, tag))
             while waiting:
                 req = waiting.popleft()
@@ -212,13 +265,51 @@ class HostP2P:
             self._inbox.setdefault((src, tag),
                                    collections.deque()).append(payload)
 
+    # ----------------------------------------------------------- peer death
+    def _schedule_peer_check(self, src: int) -> None:
+        with self._match_lock:
+            gen = self._peer_gen.get(src, 0)
+        t = threading.Timer(self.peer_grace, self._peer_check, (src, gen))
+        t.daemon = True
+        t.start()
+
+    def _peer_check(self, src: int, gen: int) -> None:
+        """Grace timer body: if ``src`` has delivered nothing since the
+        abnormal drop, presume it dead; a sender retry that reconnected in
+        the window bumped the generation and voids the verdict."""
+        if self._closed.is_set():
+            return
+        with self._match_lock:
+            if self._peer_gen.get(src, 0) != gen:
+                return  # delivered again — alive (retry/reconnect)
+            self._fail_src_locked(src, ConnectionError(
+                f"peer rank {src} presumed dead: connection dropped "
+                f"mid-frame and nothing arrived within "
+                f"peer_grace={self.peer_grace}s"))
+
+    def mark_peer_dead(self, src: int,
+                       error: Optional[BaseException] = None) -> None:
+        """Fail every pending ``irecv`` from ``src`` now (an external
+        failure detector — a cluster manager, a died subprocess — can
+        short-circuit the grace window)."""
+        with self._match_lock:
+            self._fail_src_locked(src, error or ConnectionError(
+                f"peer rank {src} marked dead"))
+
+    def _fail_src_locked(self, src: int, error: BaseException) -> None:
+        for key in [k for k in self._waiting if k[0] == src]:
+            for req in self._waiting.pop(key):
+                if not req._cancelled:
+                    req._finish(error=error)
+
     def irecv(self, source: int, tag: int = 0) -> Request:
         """Non-blocking receive (comms_t::irecv, core/comms.hpp:140);
         ``req.wait()`` returns the payload. Requests posted earlier match
         earlier messages (non-overtaking)."""
         if self._closed.is_set():
             raise ConnectionError("irecv on a closed HostP2P endpoint")
-        req = Request("irecv", self._match_lock)
+        req = Request("irecv", self._match_lock,
+                      default_timeout=self.timeout)
         with self._match_lock:
             box = self._inbox.get((source, tag))
             if box:
@@ -334,9 +425,42 @@ class HostP2P:
         except OSError:
             pass
 
+    def _retry_delay(self, attempt: int) -> float:
+        """Exponential backoff with full-range jitter (0.5×–1.5×) so a
+        fleet of senders retrying into a restarted peer doesn't
+        synchronize into a thundering herd."""
+        base = min(self.retry_backoff * (2.0 ** (attempt - 1)),
+                   self.retry_backoff_max)
+        return base * (0.5 + random.random())
+
+    def _set_active_send(self, dest: int, sock) -> None:
+        with self._send_lock:
+            if sock is None:
+                self._active_send.pop(dest, None)
+            else:
+                self._active_send[dest] = sock
+
+    def _sever_send(self, dest: int) -> bool:
+        """Fault-injection hook (testing.faults.sever_connection): hard-cut
+        the live outbound connection to ``dest`` so the next/current send
+        fails as a real network partition would. Returns False when no
+        connection is live."""
+        with self._send_lock:
+            sock = self._active_send.get(dest)
+        if sock is None:
+            return False
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        return True
+
     def _send_loop(self, dest: int, q: "queue.Queue"):
         """All sends to ``dest`` go through one connection in post order —
-        the non-overtaking half of the contract. A send failure POISONS the
+        the non-overtaking half of the contract. A transient failure is
+        retried with backoff + jitter (the whole frame is resent on a
+        fresh connection — at-least-once, see the class docstring); only
+        after ``retries`` are exhausted does the failure POISON the
         stream: every later request to this destination fails with the
         original error, so the receiver can never observe a gap (message i
         lost, i+1 delivered)."""
@@ -353,19 +477,38 @@ class HostP2P:
                     f"send stream to rank {dest} poisoned by earlier "
                     f"failure: {poison!r}"))
                 continue
-            try:
-                if sock is None:
-                    sock = self._connect(dest)
-                sock.sendall(_HDR.pack(_MAGIC, self.rank, tag, len(raw)))
-                sock.sendall(ty)
-                sock.sendall(raw)
-                req._finish()
-            except BaseException as e:  # surfaced at wait()
-                req._finish(error=e)
-                poison = e
-                if sock is not None:
-                    self._drop_conn(sock)
-                    sock = None
+            attempt = 0
+            while True:
+                try:
+                    if sock is None:
+                        sock = self._connect(dest)
+                        self._set_active_send(dest, sock)
+                    sock.sendall(_HDR.pack(_MAGIC, self.rank, tag,
+                                           len(raw)))
+                    sock.sendall(ty)
+                    sock.sendall(raw)
+                    req._finish()
+                    break
+                except _EndpointClosed as e:  # closed endpoint: terminal
+                    req._finish(error=e)
+                    poison = e
+                    break
+                except BaseException as e:  # surfaced at wait()
+                    if sock is not None:
+                        self._set_active_send(dest, None)
+                        self._drop_conn(sock)
+                        sock = None
+                    attempt += 1
+                    if attempt > self.retries or self._closed.is_set():
+                        req._finish(error=e)
+                        poison = e
+                        break
+                    # backoff observes _closed so close() stays bounded
+                    if self._closed.wait(self._retry_delay(attempt)):
+                        req._finish(error=e)
+                        poison = e
+                        break
+        self._set_active_send(dest, None)
         if sock is not None:
             self._drop_conn(sock)
         _drain_queue(q, ConnectionError(
@@ -378,7 +521,8 @@ class HostP2P:
             raise ValueError(f"dest {dest} out of range")
         if self._closed.is_set():
             raise ConnectionError("isend on a closed HostP2P endpoint")
-        req = Request("isend", self._match_lock)
+        req = Request("isend", self._match_lock,
+                      default_timeout=self.timeout)
         ty, raw = _encode(payload)  # encode eagerly: caller may mutate
         q = self._sender_for(dest)
         q.put((req, tag, ty, raw))
@@ -397,7 +541,9 @@ class HostP2P:
         """Block on a mix of send/recv requests (comms_t::waitall,
         core/comms.hpp:141). Returns receive payloads in request order
         (None for sends). ``timeout`` is ONE deadline for the whole batch,
-        not per-request: each wait gets only the time remaining."""
+        not per-request: each wait gets only the time remaining.
+        ``timeout=None`` falls back to each request's endpoint timeout —
+        a real deadline either way, never an unbounded hang."""
         if timeout is None:
             return [r.wait() for r in requests]
         deadline = time.monotonic() + timeout

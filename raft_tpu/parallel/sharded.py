@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import functools
 import os
-from concurrent.futures import ThreadPoolExecutor
+import threading
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Optional, Tuple
 
 import jax
@@ -113,10 +114,57 @@ def _map_shards(comms: Comms, fn, res: Resources, spans=None) -> dict:
     if len(rest) == 1:
         run(rest[0])
     elif rest:
-        with ThreadPoolExecutor(max_workers=len(rest)) as ex:
-            # list() propagates the first worker exception
-            list(ex.map(run, rest))
+        _run_parallel_cancelling(run, rest)
     return results
+
+
+def _run_parallel_cancelling(run, ranks) -> None:
+    """One thread per shard with first-failure cancellation: when any
+    shard build raises, unstarted siblings never run and running siblings
+    get a ``core.interruptible`` cancellation token — their next
+    ``yield_now()``/``synchronize()`` raises instead of burning device
+    hours completing builds whose results will be discarded. The FIRST
+    failure propagates; sibling-cancellation fallout is suppressed."""
+    from raft_tpu.core import interruptible
+
+    failure: list = []
+    tids: dict = {}
+    lock = threading.Lock()
+
+    def worker(r):
+        with lock:
+            if failure:
+                return
+            tids[r] = threading.get_ident()
+        try:
+            interruptible.yield_now()
+            run(r)
+        except interruptible.InterruptedException:
+            with lock:
+                if failure:
+                    return  # cancelled because a sibling failed first
+            raise
+        except BaseException as e:
+            with lock:
+                failure.append(e)
+                for rr, tid in tids.items():
+                    if rr != r:
+                        interruptible.cancel(tid)
+            raise
+        finally:
+            with lock:
+                tids.pop(r, None)
+            # never leak an unconsumed token to a reused thread ident
+            interruptible.release_token()
+
+    with ThreadPoolExecutor(max_workers=len(ranks)) as ex:
+        futs = [ex.submit(worker, r) for r in ranks]
+        for f in as_completed(futs):
+            if not f.cancelled() and f.exception() is not None:
+                for other in futs:
+                    other.cancel()
+    if failure:
+        raise failure[0]
 
 
 def _global_max_shape(comms: Comms, local_max: np.ndarray) -> np.ndarray:
@@ -511,6 +559,9 @@ class ShardedIvfFlat:
         # own block alongside its probed lists
         self.overflow_data = overflow_data
         self.overflow_indices = overflow_indices
+        # full-mesh restore always serves every row (degraded restores go
+        # through the elastic classes, which compute a real fraction)
+        self.coverage = 1.0
 
 
 def build_ivf_flat(
@@ -675,6 +726,9 @@ class ShardedIvfPq:
         self.overflow_decoded = overflow_decoded
         self.overflow_norms = overflow_norms
         self.overflow_indices = overflow_indices
+        # full-mesh restore always serves every row (degraded restores go
+        # through the elastic classes, which compute a real fraction)
+        self.coverage = 1.0
 
 
 def build_ivf_pq(
@@ -1051,14 +1105,44 @@ def search_ivf_flat(
 # ------------------------------------------------------------- persistence
 #
 # Checkpoint/resume for sharded indexes (the raft-dask role of per-worker
-# local serialization): ONE file per controller process, containing that
-# process's addressable shard blocks. Single-controller runs produce one
-# file holding every shard; multi-controller runs produce one per process
-# (same prefix), and deserialization collects whichever rank files carry
-# the shards this process can address — a multi-hour from-file build no
-# longer has to be rebuilt to be searched again.
+# local serialization): ONE file per shard rank (``prefix.rank<r>``), each
+# written atomically by the controller process that addresses that shard,
+# plus a per-prefix manifest naming every rank file with its whole-file
+# digest. Deserialization collects whichever rank files carry the shards
+# this process can address — a multi-hour from-file build no longer has to
+# be rebuilt to be searched again. Older checkpoints (one multi-rank file
+# per process) still load: readers key on the rank ids recorded *inside*
+# each file, not on filenames.
+#
+# Fault model (docs/robustness.md): per-record crc + footer
+# (core.serialize v2 framing) classifies a bad file as truncated vs
+# corrupt; the manifest names files that are missing outright; and
+# ``deserialize_*_elastic(..., allow_partial=True)`` restores around any
+# of the three, reporting ``coverage`` instead of refusing the whole
+# checkpoint.
 
 _SHARD_SERIAL_VERSION = 1
+_MANIFEST_VERSION = 1
+
+
+class SearchResult(tuple):
+    """(distances, indices) that still unpacks as a 2-tuple but carries
+    ``coverage`` — the fraction of indexed rows actually searched (1.0 for
+    a full index; < 1 after a degraded-mode restore) — so serving callers
+    can decide whether degraded recall is acceptable per response."""
+
+    def __new__(cls, distances, indices, coverage: float = 1.0):
+        self = super().__new__(cls, (distances, indices))
+        self.coverage = float(coverage)
+        return self
+
+    @property
+    def distances(self):
+        return self[0]
+
+    @property
+    def indices(self):
+        return self[1]
 
 
 def _local_shard_blocks(arr) -> dict:
@@ -1087,27 +1171,121 @@ def _read_field(r) -> np.ndarray:
 
 def _serialize_sharded(prefix: str, kind: str, scalars, fields) -> None:
     """``scalars``: [(value, dtype)], ``fields``: [arr or None] — every
-    process writes its addressable shard blocks to ``prefix.rank<i>``."""
+    process writes one ATOMIC file per addressable shard rank
+    (``prefix.rank<r>``) plus a manifest naming each file and its digest,
+    so a single lost/corrupted file costs one shard, not the checkpoint."""
+    import json
+
     from raft_tpu.core import serialize as ser
 
     present = [a is not None for a in fields]
     blocks = [(_local_shard_blocks(a) if p else None)
               for a, p in zip(fields, present)]
     local_ranks = sorted(next(b for b, p in zip(blocks, present) if p))
-    path = f"{prefix}.rank{jax.process_index()}"
-    with open(path, "wb") as stream:
-        w = ser.IndexWriter(stream, kind, _SHARD_SERIAL_VERSION)
-        for value, dtype in scalars:
-            w.scalar(value, dtype)
-        w.scalar(len(present), "<i4")
-        for p in present:
-            w.scalar(1 if p else 0, "<i4")
-        w.scalar(len(local_ranks), "<i4")
-        for r in local_ranks:
+    size = int(next(a for a, p in zip(fields, present) if p).shape[0])
+    entries = {}
+    for r in local_ranks:
+        path = f"{prefix}.rank{r}"
+        with ser.writer_for(path) as stream:
+            w = ser.IndexWriter(stream, kind, _SHARD_SERIAL_VERSION)
+            for value, dtype in scalars:
+                w.scalar(value, dtype)
+            w.scalar(len(present), "<i4")
+            for p in present:
+                w.scalar(1 if p else 0, "<i4")
+            w.scalar(1, "<i4")  # ranks in this file
             w.scalar(r, "<i4")
             for b, p in zip(blocks, present):
                 if p:
                     _write_field(w, b[r])
+            w.finish()
+        entries[os.path.basename(path)] = {
+            "ranks": [r],
+            "bytes": os.path.getsize(path),
+            "crc32": ser.file_crc32(path),
+        }
+    manifest = {
+        "manifest_version": _MANIFEST_VERSION,
+        "kind": kind,
+        "size": size,
+        "files": entries,
+    }
+    mpath = (f"{prefix}.manifest" if jax.process_count() == 1
+             else f"{prefix}.manifest.p{jax.process_index()}")
+    with ser.writer_for(mpath) as stream:
+        stream.write(json.dumps(manifest, indent=1, sort_keys=True).encode())
+
+
+def load_manifest(prefix: str) -> Optional[dict]:
+    """Merged manifest for a checkpoint prefix (``prefix.manifest`` plus
+    any multi-controller ``prefix.manifest.p<i>`` fragments), or None for
+    pre-manifest checkpoints."""
+    import glob as _glob
+    import json
+
+    paths = sorted(_glob.glob(_glob.escape(prefix) + ".manifest*"))
+    merged: Optional[dict] = None
+    for path in paths:
+        if path.endswith((".tmp", )) or ".tmp." in path:
+            continue
+        with open(path, "rb") as f:
+            m = json.load(f)
+        if merged is None:
+            merged = m
+        else:
+            if (m.get("kind") != merged.get("kind")
+                    or m.get("size") != merged.get("size")):
+                raise ValueError(
+                    f"{path}: manifest fragment disagrees with others "
+                    f"(kind/size) — stale fragments from a previous run?")
+            merged["files"].update(m["files"])
+    return merged
+
+
+def verify_checkpoint(prefix: str) -> dict:
+    """Pre-flight checkpoint validation against the manifest (TPU runbook:
+    run this BEFORE burning a hardware window on a restore). Classifies
+    every rank file as ``ok`` / ``missing`` / ``truncated`` / ``corrupt``
+    and lists shard ranks with no healthy file. Returns
+    ``{"ok": bool, "size": S, "files": {name: status}, "missing_ranks":
+    [...], "coverage_ranks": [...]}``; raises FileNotFoundError when there
+    is no manifest to verify against."""
+    from raft_tpu.core import serialize as ser
+
+    manifest = load_manifest(prefix)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"{prefix}.manifest not found — pre-manifest checkpoint; "
+            f"re-serialize to get one, or restore with allow_partial "
+            f"validation only")
+    dirname = os.path.dirname(prefix) or "."
+    statuses = {}
+    healthy_ranks: set = set()
+    for name, entry in sorted(manifest["files"].items()):
+        path = os.path.join(dirname, name)
+        if not os.path.exists(path):
+            statuses[name] = "missing"
+            continue
+        nbytes = os.path.getsize(path)
+        if nbytes < entry["bytes"]:
+            statuses[name] = "truncated"
+            continue
+        if nbytes != entry["bytes"] or ser.file_crc32(path) != entry["crc32"]:
+            statuses[name] = "corrupt"
+            continue
+        statuses[name] = "ok"
+        healthy_ranks.update(entry["ranks"])
+    size = int(manifest["size"])
+    missing_ranks = sorted(set(range(size)) - healthy_ranks)
+    return {
+        "ok": not missing_ranks and all(
+            s == "ok" for s in statuses.values()),
+        "kind": manifest["kind"],
+        "size": size,
+        "files": statuses,
+        "missing_ranks": missing_ranks,
+        "coverage_ranks": sorted(healthy_ranks),
+    }
 
 
 def _addressable_ranks(comms: Comms) -> set:
@@ -1117,64 +1295,136 @@ def _addressable_ranks(comms: Comms) -> set:
             if _shard_device(comms, r).process_index == me}
 
 
-def _deserialize_sharded(prefix: str, kind: str, n_scalars: int,
-                         want_ranks=None):
-    """Read every ``prefix.rank*`` file; returns (scalars, parts) where
-    ``parts`` is a list of {r: np block} per field (None = absent).
-
-    Only ranks in ``want_ranks`` are RETAINED (non-addressable shards are
-    read file-at-a-time and dropped, bounding host RAM at roughly one
-    rank file instead of the whole index), but EVERY rank seen is
-    validated: a rank appearing twice means stale rank files from a
-    previous run with a different process layout are mixed in, and the
-    union must cover exactly range(size) — both raise instead of
-    silently corrupting the restored index."""
-    import glob as _glob
-
+def _read_rank_file(path: str, kind: str, n_scalars: int, want_ranks):
+    """Parse one rank file → (scalars, present, {rank: [field blocks]}).
+    Blocks for ranks outside ``want_ranks`` are read and dropped (bounding
+    host RAM at roughly one rank file). Raises IntegrityError (truncated/
+    corrupt) or ValueError; never partially merges into shared state."""
     from raft_tpu.core import serialize as ser
 
-    paths = sorted(_glob.glob(_glob.escape(prefix) + ".rank*"))
+    with open(path, "rb") as stream:
+        r = ser.IndexReader(stream, kind, _SHARD_SERIAL_VERSION, name=path)
+        s = [r.scalar() for _ in range(n_scalars)]
+        n_fields = r.scalar()
+        present = [bool(r.scalar()) for _ in range(n_fields)]
+        n_local = r.scalar()
+        local: dict = {}
+        for _ in range(n_local):
+            rank = int(r.scalar())
+            keep = want_ranks is None or rank in want_ranks
+            blocks = []
+            for p in present:
+                if p:
+                    block = _read_field(r)
+                    blocks.append(block if keep else None)
+            local[rank] = blocks if keep else None
+        r.finish()
+    return s, present, local
+
+
+def _deserialize_sharded(prefix: str, kind: str, n_scalars: int,
+                         want_ranks=None, on_error: str = "raise"):
+    """Read every ``prefix.rank*`` file; returns (scalars, parts, seen,
+    errors) where ``parts`` is a list of {r: np block} per field (None =
+    absent field) and ``errors`` maps path -> exception for files skipped
+    under ``on_error="skip"``.
+
+    Only ranks in ``want_ranks`` are RETAINED (non-addressable shards are
+    read file-at-a-time and dropped), but EVERY rank seen is validated: a
+    rank appearing twice means stale rank files from a previous run with a
+    different process layout are mixed in — that raises even in skip mode,
+    because silently picking one copy could resurrect outdated data.
+
+    ``on_error="skip"`` is the degraded-mode path: a file that is
+    truncated, corrupt, or unreadable contributes nothing (its ranks stay
+    missing) instead of failing the restore — each file's blocks merge
+    only after the whole file (footer included) validated."""
+    import glob as _glob
+
+    from raft_tpu.core.errors import IntegrityError
+
+    if on_error not in ("raise", "skip"):
+        raise ValueError(f"on_error={on_error!r}: use 'raise' or 'skip'")
+    paths = sorted(p for p in _glob.glob(_glob.escape(prefix) + ".rank*")
+                   if ".tmp." not in p)
     if not paths:
         raise FileNotFoundError(f"no shard files match {prefix}.rank*")
     scalars = None
     parts = None
     seen: dict = {}  # rank -> path
+    errors: dict = {}  # path -> exception
     for path in paths:
-        with open(path, "rb") as stream:
-            r = ser.IndexReader(stream, kind, _SHARD_SERIAL_VERSION)
-            s = [r.scalar() for _ in range(n_scalars)]
-            n_fields = r.scalar()
-            present = [bool(r.scalar()) for _ in range(n_fields)]
-            if scalars is None:
-                scalars = s
-                parts = [({} if p else None) for p in present]
-            elif s != scalars:
+        try:
+            s, present, local = _read_rank_file(
+                path, kind, n_scalars, want_ranks)
+        except (IntegrityError, ValueError, OSError) as e:
+            if on_error == "raise":
+                raise
+            errors[path] = e
+            continue
+        if scalars is None:
+            scalars = s
+            parts = [({} if p else None) for p in present]
+        elif s != scalars:
+            e = ValueError(f"{path}: header disagrees with other rank files")
+            if on_error == "raise":
+                raise e
+            errors[path] = e
+            continue
+        for rank, blocks in local.items():
+            if rank in seen:
                 raise ValueError(
-                    f"{path}: header disagrees with other rank files")
-            n_local = r.scalar()
-            for _ in range(n_local):
-                rank = int(r.scalar())
-                if rank in seen:
-                    raise ValueError(
-                        f"shard rank {rank} appears in both {seen[rank]} "
-                        f"and {path} — stale rank files from a previous "
-                        f"run? Remove outdated {prefix}.rank* files")
-                seen[rank] = path
-                keep = want_ranks is None or rank in want_ranks
-                for f, p in zip(parts, present):
-                    if p:
-                        block = _read_field(r)
-                        if keep:
-                            f[rank] = block
-    return scalars, parts, seen
+                    f"shard rank {rank} appears in both {seen[rank]} "
+                    f"and {path} — stale rank files from a previous "
+                    f"run? Remove outdated {prefix}.rank* files")
+            seen[rank] = path
+            if blocks is None:
+                continue
+            it = iter(blocks)
+            for f, p in zip(parts, present):
+                if p:
+                    f[rank] = next(it)
+    if scalars is None:
+        raise IntegrityError(
+            f"no readable rank file under {prefix}.rank*: "
+            + "; ".join(f"{p}: {e}" for p, e in errors.items()),
+            path=prefix, reason="corrupt")
+    return scalars, parts, seen, errors
 
 
-def _check_rank_coverage(seen: dict, size: int, prefix: str) -> None:
+def _expected_rank_paths(prefix: str, ranks, manifest=None) -> list:
+    """Best-effort file paths for missing shard ranks: exact names from the
+    manifest when one exists, else the writer's ``prefix.rank<r>``
+    convention."""
+    if manifest:
+        dirname = os.path.dirname(prefix) or "."
+        named = {}
+        for name, entry in manifest.get("files", {}).items():
+            for r in entry.get("ranks", ()):
+                named[r] = os.path.join(dirname, name)
+        return [named.get(r, f"{prefix}.rank{r}") for r in ranks]
+    return [f"{prefix}.rank{r}" for r in ranks]
+
+
+def _check_rank_coverage(seen: dict, size: int, prefix: str,
+                         errors=None) -> None:
     missing = sorted(set(range(size)) - set(seen))
     if missing:
+        try:
+            manifest = load_manifest(prefix)
+        except (OSError, ValueError):
+            manifest = None
+        paths = _expected_rank_paths(prefix, missing, manifest)
+        detail = ""
+        if errors:
+            detail = "; unreadable: " + "; ".join(
+                f"{p} ({e})" for p, e in sorted(errors.items()))
         raise ValueError(
             f"{prefix}.rank* files cover only {sorted(seen)} of "
-            f"{size} shard ranks; missing {missing} (partial checkpoint?)")
+            f"{size} shard ranks; missing {missing} (expected files: "
+            f"{', '.join(paths)}){detail} — partial checkpoint? Pass "
+            f"allow_partial=True to an elastic restore to serve the "
+            f"surviving shards")
 
 
 def serialize_ivf_pq(index: ShardedIvfPq, prefix: str) -> None:
@@ -1194,7 +1444,7 @@ def serialize_ivf_pq(index: ShardedIvfPq, prefix: str) -> None:
 
 
 def deserialize_ivf_pq(prefix: str, comms: Comms) -> ShardedIvfPq:
-    scalars, parts, seen = _deserialize_sharded(
+    scalars, parts, seen, _ = _deserialize_sharded(
         prefix, "sharded_ivf_pq", 7, want_ranks=_addressable_ranks(comms))
     metric, n_rows, size, pq_dim, pq_bits, per_cluster, _engine = scalars
     if size != comms.size:
@@ -1307,14 +1557,18 @@ class ElasticIvfPq:
     """A sharded IVF-PQ checkpoint restored WITHOUT the original mesh —
     shard blocks live stacked [S, ...] on the default device; ``search``
     matches ``sharded.search_ivf_pq`` exactly (same per-shard cores, same
-    merge)."""
+    merge). Under a degraded restore (``allow_partial=True``) S counts
+    only the SURVIVING shards and ``coverage`` < 1.0 reports the fraction
+    of indexed rows still searchable; results carry it (see
+    :class:`SearchResult`)."""
 
     def __init__(self, n_shards, centers, rotation, list_indices,
                  list_sizes, metric, n_rows, list_decoded=None,
                  decoded_norms=None, codebooks=None, list_codes=None,
                  per_cluster=False, pq_dim=0, pq_bits=8,
                  overflow_decoded=None, overflow_norms=None,
-                 overflow_indices=None):
+                 overflow_indices=None, coverage: float = 1.0,
+                 shard_ranks=None):
         self.n_shards = int(n_shards)
         self.centers = centers  # [S, nlist, dim]
         self.rotation = rotation  # [S, rot, dim]
@@ -1332,10 +1586,14 @@ class ElasticIvfPq:
         self.overflow_decoded = overflow_decoded
         self.overflow_norms = overflow_norms
         self.overflow_indices = overflow_indices
+        self.coverage = float(coverage)
+        # original shard-rank ids behind each stacked row (None = all of
+        # range(n_shards), i.e. a full restore)
+        self.shard_ranks = (None if shard_ranks is None
+                            else [int(r) for r in shard_ranks])
 
     def search(self, queries, k: int, params=None,
-               res: Optional[Resources] = None
-               ) -> Tuple[jax.Array, jax.Array]:
+               res: Optional[Resources] = None) -> "SearchResult":
         from raft_tpu.neighbors import ivf_pq
 
         res = ensure_resources(res)
@@ -1364,14 +1622,15 @@ class ElasticIvfPq:
             jnp.dtype(params.lut_dtype).itemsize,
             jnp.dtype(params.internal_distance_dtype).itemsize)
         if mode == "cache":
-            return _elastic_cache_search(
+            v, i = _elastic_cache_search(
                 queries, self.centers, self.rotation, self.list_decoded,
                 self.decoded_norms, self.list_indices, self.list_sizes,
                 *over, metric=self.metric, k=int(k), n_probes=n_probes,
                 q_tile=q_tile, select_recall=select_recall,
                 has_overflow=has_overflow)
+            return SearchResult(v, i, self.coverage)
 
-        return _elastic_lut_search(
+        v, i = _elastic_lut_search(
             queries, self.centers, self.rotation, self.codebooks,
             self.list_codes, self.list_indices, self.list_sizes, *over,
             metric=self.metric, k=int(k), n_probes=n_probes, q_tile=q_tile,
@@ -1380,35 +1639,82 @@ class ElasticIvfPq:
             lut_dtype=jnp.dtype(params.lut_dtype).name,
             dist_dtype=jnp.dtype(params.internal_distance_dtype).name,
             select_recall=select_recall, has_overflow=has_overflow)
+        return SearchResult(v, i, self.coverage)
 
 
-def deserialize_ivf_pq_elastic(prefix: str) -> ElasticIvfPq:
+def _elastic_restore(prefix: str, kind: str, n_scalars: int,
+                     allow_partial: bool):
+    """Shared elastic-restore front half: read rank files (strict, or
+    best-effort when ``allow_partial``), pick the surviving rank order,
+    and return ``(scalars, parts, survivors, size)``."""
+    scalars, parts, seen, errors = _deserialize_sharded(
+        prefix, kind, n_scalars,
+        want_ranks=None, on_error="skip" if allow_partial else "raise")
+    size = int(scalars[2])
+    if allow_partial:
+        survivors = sorted(r for r in seen if r < size)
+        if not survivors:
+            from raft_tpu.core.errors import IntegrityError
+            raise IntegrityError(
+                f"{prefix}: no shard rank survived (of {size})",
+                path=prefix, reason="missing")
+    else:
+        _check_rank_coverage(seen, size, prefix, errors)
+        survivors = list(range(size))
+    return scalars, parts, survivors, size
+
+
+def _stack_survivors(parts, survivors):
+    """Stack each parts dict {rank: np block} over the surviving ranks in
+    order (None fields stay None)."""
+    return [(None if p is None
+             else jnp.asarray(np.stack([p[r] for r in survivors])))
+            for p in parts]
+
+
+def _elastic_coverage(list_indices_parts, overflow_parts, survivors,
+                      n_rows) -> float:
+    """Fraction of indexed rows actually restorable = valid (>= 0) ids
+    across the surviving shards' lists + spill blocks, over ``n_rows``.
+    Exact, not estimated — padding slots hold -1."""
+    rows = 0
+    for r in survivors:
+        rows += int((np.asarray(list_indices_parts[r]) >= 0).sum())
+        if overflow_parts is not None and r in overflow_parts:
+            rows += int((np.asarray(overflow_parts[r]) >= 0).sum())
+    return rows / max(int(n_rows), 1)
+
+
+def deserialize_ivf_pq_elastic(prefix: str,
+                               allow_partial: bool = False) -> ElasticIvfPq:
     """Restore a sharded IVF-PQ checkpoint on ANY device count (vs
     ``deserialize_ivf_pq``, which requires the original mesh size). All
-    rank files are read and every shard is retained on the default
-    device."""
-    scalars, parts, seen = _deserialize_sharded(
-        prefix, "sharded_ivf_pq", 7, want_ranks=None)
-    metric, n_rows, size, pq_dim, pq_bits, per_cluster, _engine = scalars
-    size = int(size)
-    _check_rank_coverage(seen, size, prefix)
+    rank files are read and every shard is retained on the default device.
 
-    def stk(p):
-        if p is None:
-            return None
-        return jnp.asarray(np.stack([p[r] for r in range(size)]))
-
+    ``allow_partial=True`` is the degraded serving mode: rank files that
+    are missing, truncated, or corrupt are skipped instead of failing the
+    restore, and the index serves the surviving shards with
+    ``index.coverage = rows_available / n_rows`` (< 1.0); each
+    ``search`` result carries that coverage. Strict mode (the default)
+    raises — naming the missing file, or the bad file + record."""
+    scalars, parts, survivors, size = _elastic_restore(
+        prefix, "sharded_ivf_pq", 7, allow_partial)
+    metric, n_rows, _size, pq_dim, pq_bits, per_cluster, _engine = scalars
+    coverage = (1.0 if len(survivors) == size
+                else _elastic_coverage(parts[2], parts[10], survivors,
+                                       n_rows))
     (centers, rotation, list_indices, list_sizes, list_decoded,
      decoded_norms, codebooks, list_codes, overflow_decoded,
-     overflow_norms, overflow_indices) = [stk(p) for p in parts]
+     overflow_norms, overflow_indices) = _stack_survivors(parts, survivors)
     return ElasticIvfPq(
-        size, centers, rotation, list_indices, list_sizes,
+        len(survivors), centers, rotation, list_indices, list_sizes,
         DistanceType(metric), int(n_rows), list_decoded=list_decoded,
         decoded_norms=decoded_norms, codebooks=codebooks,
         list_codes=list_codes, per_cluster=bool(per_cluster),
         pq_dim=int(pq_dim), pq_bits=int(pq_bits),
         overflow_decoded=overflow_decoded, overflow_norms=overflow_norms,
-        overflow_indices=overflow_indices)
+        overflow_indices=overflow_indices, coverage=coverage,
+        shard_ranks=survivors)
 
 
 def serialize_ivf_flat(index: ShardedIvfFlat, prefix: str) -> None:
@@ -1421,7 +1727,7 @@ def serialize_ivf_flat(index: ShardedIvfFlat, prefix: str) -> None:
 
 
 def deserialize_ivf_flat(prefix: str, comms: Comms) -> ShardedIvfFlat:
-    scalars, parts, seen = _deserialize_sharded(
+    scalars, parts, seen, _ = _deserialize_sharded(
         prefix, "sharded_ivf_flat", 3, want_ranks=_addressable_ranks(comms))
     metric, n_rows, size = scalars
     if size != comms.size:
@@ -1434,3 +1740,108 @@ def deserialize_ivf_flat(prefix: str, comms: Comms) -> ShardedIvfFlat:
     return ShardedIvfFlat(comms, centers, list_data, list_indices,
                           list_sizes, DistanceType(metric), int(n_rows),
                           overflow_data=o_data, overflow_indices=o_ids)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "metric", "k", "n_probes", "q_tile", "select_recall", "fast_scan",
+    "refine_mult", "has_overflow"))
+def _elastic_flat_search(queries, centers, list_data, list_indices,
+                         list_sizes, overflow_data, overflow_indices, *,
+                         metric, k, n_probes, q_tile, select_recall,
+                         fast_scan, refine_mult, has_overflow):
+    from raft_tpu.neighbors import ivf_flat
+
+    empty_filter = jnp.zeros((0,), jnp.uint32)
+    minimize = metric != DistanceType.InnerProduct
+
+    def per_shard(blocks):
+        c, ld, li, ls, od, oi = blocks
+        kw = (dict(overflow_data=od, overflow_indices=oi, has_overflow=True)
+              if has_overflow else {})
+        return ivf_flat.search_core(
+            queries, c, ld, li, ls, empty_filter, metric, k, n_probes,
+            q_tile, False, fast_scan=fast_scan, select_recall=select_recall,
+            refine_mult=refine_mult, **kw)
+
+    v, i = jax.lax.map(per_shard, (centers, list_data, list_indices,
+                                   list_sizes, overflow_data,
+                                   overflow_indices))
+    return _elastic_merge(v, i, queries.shape[0], k, minimize)
+
+
+class ElasticIvfFlat:
+    """The IVF-Flat twin of :class:`ElasticIvfPq`: a sharded checkpoint
+    restored without the original mesh, searched by running the single-
+    chip core per stacked shard and merging — degraded restores carry
+    ``coverage`` < 1.0."""
+
+    def __init__(self, n_shards, centers, list_data, list_indices,
+                 list_sizes, metric, n_rows, overflow_data=None,
+                 overflow_indices=None, coverage: float = 1.0,
+                 shard_ranks=None):
+        self.n_shards = int(n_shards)
+        self.centers = centers  # [S, L, dim]
+        self.list_data = list_data  # [S, L, pad, dim]
+        self.list_indices = list_indices  # [S, L, pad] global ids
+        self.list_sizes = list_sizes  # [S, L]
+        self.metric = metric
+        self.n_rows = int(n_rows)
+        self.overflow_data = overflow_data
+        self.overflow_indices = overflow_indices
+        self.coverage = float(coverage)
+        self.shard_ranks = (None if shard_ranks is None
+                            else [int(r) for r in shard_ranks])
+
+    def search(self, queries, k: int, params=None,
+               res: Optional[Resources] = None) -> "SearchResult":
+        from raft_tpu.neighbors import ivf_flat
+
+        res = ensure_resources(res)
+        params = params or ivf_flat.SearchParams()
+        queries = jnp.asarray(queries)
+        n_lists = self.centers.shape[1]
+        n_probes = int(min(params.n_probes, n_lists))
+        list_pad = self.list_data.shape[2]
+        dim = self.list_data.shape[3]
+        per_q = n_probes * list_pad * dim * 4 * 2
+        q_tile = int(np.clip(res.workspace_limit_bytes // max(per_q, 1),
+                             1, 1024))
+        if q_tile >= 8:
+            q_tile -= q_tile % 8
+        fast_scan = getattr(params, "scan_dtype", None) is not None
+        select_recall = float(getattr(params, "select_recall", 1.0))
+        refine_mult = refine_multiplier(
+            getattr(params, "refine_ratio", 4.0), fast_scan)
+        has_overflow = self.overflow_data is not None
+        if has_overflow:
+            over = (self.overflow_data, self.overflow_indices)
+        else:
+            # stable zero-size placeholders keep the jit signature uniform
+            over = (jnp.zeros((self.n_shards, 0, dim), self.list_data.dtype),
+                    jnp.zeros((self.n_shards, 0), jnp.int32))
+        v, i = _elastic_flat_search(
+            queries, self.centers, self.list_data, self.list_indices,
+            self.list_sizes, *over, metric=self.metric, k=int(k),
+            n_probes=n_probes, q_tile=q_tile, select_recall=select_recall,
+            fast_scan=fast_scan, refine_mult=refine_mult,
+            has_overflow=has_overflow)
+        return SearchResult(v, i, self.coverage)
+
+
+def deserialize_ivf_flat_elastic(prefix: str, allow_partial: bool = False
+                                 ) -> ElasticIvfFlat:
+    """IVF-Flat twin of :func:`deserialize_ivf_pq_elastic` — restore on any
+    device count; ``allow_partial=True`` serves the surviving shards of a
+    damaged checkpoint with ``coverage = rows_available / n_rows``."""
+    scalars, parts, survivors, size = _elastic_restore(
+        prefix, "sharded_ivf_flat", 3, allow_partial)
+    metric, n_rows, _size = scalars
+    coverage = (1.0 if len(survivors) == size
+                else _elastic_coverage(parts[2], parts[5], survivors,
+                                       n_rows))
+    (centers, list_data, list_indices, list_sizes, o_data,
+     o_ids) = _stack_survivors(parts, survivors)
+    return ElasticIvfFlat(
+        len(survivors), centers, list_data, list_indices, list_sizes,
+        DistanceType(metric), int(n_rows), overflow_data=o_data,
+        overflow_indices=o_ids, coverage=coverage, shard_ranks=survivors)

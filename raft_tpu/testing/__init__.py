@@ -1,0 +1,5 @@
+"""Test-support utilities shipped with the library (not the test suite):
+fault injectors for chaos-testing checkpoint restore, host p2p, and
+memory-budget behavior. See :mod:`raft_tpu.testing.faults`."""
+
+from raft_tpu.testing import faults  # noqa: F401

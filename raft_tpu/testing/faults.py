@@ -1,0 +1,109 @@
+"""Composable fault injectors for chaos tests (tests/test_faults.py).
+
+Each injector perturbs exactly one failure domain the serving stack claims
+to survive (docs/robustness.md):
+
+- checkpoint bytes — :func:`flip_record_byte`, :func:`truncate_record`,
+  :func:`truncate_file` corrupt/cut a specific framed record of a
+  core.serialize v2 file, exercising the crc + footer detection paths;
+- checkpoint files — :func:`delete_rank_file` removes one shard's rank
+  file, exercising degraded-mode (``allow_partial``) restore;
+- the host p2p fabric — :func:`sever_connection` hard-cuts a live
+  outbound connection mid-stream, exercising send retry and peer-death
+  grace logic;
+- memory budget — :func:`shrink_workspace` pins a Resources' workspace
+  ceiling low, exercising the tiled fallbacks that keep results
+  bit-identical under pressure.
+
+All injectors operate on real bytes/sockets — no monkeypatched readers —
+so the detection paths under test are the ones production restores run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional, Tuple
+
+from raft_tpu.core.serialize import record_spans
+
+
+def _span(path: str, record: int) -> Tuple[int, int]:
+    spans = record_spans(path)
+    if not -len(spans) <= record < len(spans):
+        raise IndexError(
+            f"{path}: record {record} out of range ({len(spans)} records, "
+            f"footer included)")
+    return spans[record]
+
+
+def flip_record_byte(path: str, record: int, offset: int = 0) -> int:
+    """XOR one payload byte of record ``record`` (negative indexes from the
+    end; -1 is the footer) so the frame's crc32 no longer matches. Returns
+    the absolute file offset flipped."""
+    off, n = _span(path, record)
+    if n == 0:
+        raise ValueError(f"{path}: record {record} has an empty payload")
+    if not 0 <= offset < n:
+        raise IndexError(
+            f"{path}: offset {offset} outside record {record}'s {n} "
+            f"payload bytes")
+    pos = off + offset
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        b = f.read(1)
+        f.seek(pos)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return pos
+
+
+def truncate_record(path: str, record: int) -> int:
+    """Cut the file mid-way through record ``record``'s payload (half of
+    it survives), as a crash mid-write would. Returns the new size."""
+    off, n = _span(path, record)
+    new_size = off + n // 2
+    with open(path, "r+b") as f:
+        f.truncate(new_size)
+    return new_size
+
+
+def truncate_file(path: str, drop_bytes: int = 1) -> int:
+    """Drop the last ``drop_bytes`` bytes (footer-tail truncation — the
+    torn-write case atomic replace prevents, kept for files that bypassed
+    it). Returns the new size."""
+    size = os.path.getsize(path)
+    new_size = max(size - int(drop_bytes), 0)
+    with open(path, "r+b") as f:
+        f.truncate(new_size)
+    return new_size
+
+
+def delete_rank_file(prefix: str, rank: int) -> str:
+    """Remove shard ``rank``'s checkpoint file (``prefix.rank<rank>``),
+    simulating a lost disk/object. Returns the removed path."""
+    path = f"{prefix}.rank{rank}"
+    os.remove(path)
+    return path
+
+
+def sever_connection(endpoint, dest: int) -> bool:
+    """Hard-cut ``endpoint``'s live outbound connection to rank ``dest``
+    (both directions, like a mid-stream network partition). Returns False
+    when no connection is currently open — callers racing a send should
+    retry until it lands. The endpoint's send retry/backoff is expected to
+    re-deliver."""
+    return endpoint._sever_send(dest)
+
+
+@contextlib.contextmanager
+def shrink_workspace(res, limit_bytes: int = 1 << 20,
+                     restore: Optional[int] = None) -> Iterator:
+    """Temporarily pin ``res.workspace_limit_bytes`` to ``limit_bytes``
+    (default 1 MiB — small enough to force the tiled paths at test sizes).
+    Restores the previous explicit limit (or ``restore``) on exit."""
+    prev = res._workspace_limit
+    res._workspace_limit = int(limit_bytes)
+    try:
+        yield res
+    finally:
+        res._workspace_limit = prev if restore is None else int(restore)

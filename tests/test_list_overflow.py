@@ -315,6 +315,7 @@ def test_deserialize_v1_files_still_load():
     w.array(idx.list_data)
     w.array(idx.list_indices)
     w.array(idx.list_sizes)
+    w.finish()
     buf.seek(0)
     back = ivf_flat.deserialize(buf)
     assert back.n_rows == idx.n_rows
@@ -344,6 +345,7 @@ def test_deserialize_v1_files_still_load():
     w.array(pq.list_codes)
     w.array(pq.list_indices)
     w.array(pq.list_sizes)
+    w.finish()
     buf.seek(0)
     back = ivf_pq.deserialize(buf)
     assert back.n_rows == pq.n_rows

@@ -1,0 +1,185 @@
+"""Checkpoint integrity plumbing (core.serialize format v2): per-record
+crc framing, the footer, atomic writes, and overflow-bearing index
+round-trips through the framed writer."""
+
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import serialize as ser
+from raft_tpu.core.errors import IntegrityError
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+
+def test_framed_roundtrip_and_spans(tmp_path):
+    path = str(tmp_path / "f")
+    with ser.writer_for(path) as stream:
+        w = ser.IndexWriter(stream, "t", 1)
+        w.scalar(7, "<i4").string("hello").array(np.arange(6).reshape(2, 3))
+        w.finish()
+    with ser.reader_for(path) as stream:
+        r = ser.IndexReader(stream, "t", 1, name=path)
+        assert r.fmt_version == 2
+        assert r.scalar() == 7
+        assert r.string() == "hello"
+        np.testing.assert_array_equal(r.array(),
+                                      np.arange(6).reshape(2, 3))
+        r.finish()
+    spans = ser.record_spans(path)
+    assert len(spans) == 4  # 3 records + footer
+    assert all(n > 0 for _, n in spans)
+
+
+def test_scalar_bad_dtype_tag():
+    """A garbage dtype tag must be a typed IntegrityError, not a numpy
+    TypeError deep in a restore stack."""
+    buf = io.BytesIO()
+    buf.write(struct.pack("<B", 4))
+    buf.write(b"\xff\xfe\x00Z")  # not a dtype, not even decodable
+    buf.seek(0)
+    with pytest.raises(IntegrityError) as ei:
+        ser.deserialize_scalar(buf)
+    assert ei.value.reason in ("corrupt", "truncated")
+
+
+def test_scalar_truncated():
+    buf = io.BytesIO()
+    ser.serialize_scalar(buf, 123, "<i8")
+    raw = buf.getvalue()
+    with pytest.raises(IntegrityError) as ei:
+        ser.deserialize_scalar(io.BytesIO(raw[:-3]))
+    assert ei.value.reason == "truncated"
+
+
+def test_missing_footer_reads_truncated(tmp_path):
+    """A writer that never called finish() (crash before the footer) must
+    not read as complete."""
+    path = str(tmp_path / "nofooter")
+    with ser.writer_for(path) as stream:
+        w = ser.IndexWriter(stream, "t", 1)
+        w.scalar(1, "<i4")
+        # no finish()
+    with ser.reader_for(path) as stream:
+        r = ser.IndexReader(stream, "t", 1, name=path)
+        assert r.scalar() == 1
+        with pytest.raises(IntegrityError) as ei:
+            r.finish()
+    assert ei.value.reason == "truncated"
+    assert ei.value.path == path
+
+
+def test_extra_records_rejected_by_footer(tmp_path):
+    """Footer count mismatch (reader consumed fewer records than written —
+    a reader/writer field-set skew) is corrupt, not silently ignored."""
+    path = str(tmp_path / "skew")
+    with ser.writer_for(path) as stream:
+        w = ser.IndexWriter(stream, "t", 1)
+        w.scalar(1, "<i4").scalar(2, "<i4")
+        w.finish()
+    with ser.reader_for(path) as stream:
+        r = ser.IndexReader(stream, "t", 1, name=path)
+        assert r.scalar() == 1
+        with pytest.raises(IntegrityError) as ei:
+            r.finish()  # one record early: next frame is not the footer
+    assert ei.value.reason == "corrupt"
+
+
+def test_atomic_write_failure_leaves_nothing(tmp_path):
+    path = str(tmp_path / "atomic")
+    with pytest.raises(RuntimeError, match="boom"):
+        with ser.writer_for(path) as stream:
+            stream.write(b"partial bytes")
+            raise RuntimeError("boom")
+    assert not os.path.exists(path)
+    assert os.listdir(tmp_path) == []  # tmp file cleaned up too
+
+
+def test_atomic_write_preserves_previous_checkpoint(tmp_path):
+    path = str(tmp_path / "keep")
+    with ser.writer_for(path) as stream:
+        stream.write(b"good v1")
+    with pytest.raises(RuntimeError):
+        with ser.writer_for(path) as stream:
+            stream.write(b"half of v2")
+            raise RuntimeError("crash mid-serialize")
+    with open(path, "rb") as f:
+        assert f.read() == b"good v1"  # old checkpoint intact
+
+
+def _overflow_dataset(rng, n, dim):
+    """One hot blob coarse k-means can't split at small n_lists: with a
+    tight list_pad_expansion the hot lists' tails spill to the overflow
+    block."""
+    n_hot = n // 2
+    hot = rng.standard_normal((n_hot, dim)).astype(np.float32) * 0.05
+    rest = rng.standard_normal((n - n_hot, dim)).astype(np.float32) * 0.05
+    rest += rng.standard_normal((n - n_hot, 1)).astype(np.float32) * 3.0
+    out = np.concatenate([hot, rest])
+    rng.shuffle(out)
+    return out
+
+
+def test_ivf_pq_overflow_roundtrip(tmp_path):
+    rng = np.random.default_rng(21)
+    x = _overflow_dataset(rng, 4096, 16)
+    q = x[:16] + 0.01 * rng.standard_normal((16, 16)).astype(np.float32)
+    res = Resources(seed=0)
+    idx = ivf_pq.build(x, ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                             kmeans_n_iters=3,
+                                             list_pad_expansion=1.01),
+                       res=res)
+    assert idx.overflow_indices is not None
+    assert int(np.sum(np.asarray(idx.overflow_indices) >= 0)) > 0
+    path = str(tmp_path / "pq_over")
+    ivf_pq.serialize(idx, path)
+    idx2 = ivf_pq.deserialize(path, res=res)
+    sp = ivf_pq.SearchParams(n_probes=32, scan_mode="lut")
+    d0, i0 = ivf_pq.search(idx, q, 10, sp, res=res)
+    d1, i1 = ivf_pq.search(idx2, q, 10, sp, res=res)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_ivf_flat_overflow_roundtrip(tmp_path):
+    rng = np.random.default_rng(22)
+    x = _overflow_dataset(rng, 4096, 16)
+    q = x[:16] + 0.01 * rng.standard_normal((16, 16)).astype(np.float32)
+    res = Resources(seed=0)
+    idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=16,
+                                                 kmeans_n_iters=3,
+                                                 list_pad_expansion=1.01),
+                         res=res)
+    assert idx.overflow_indices is not None
+    assert int(np.sum(np.asarray(idx.overflow_indices) >= 0)) > 0
+    path = str(tmp_path / "flat_over")
+    ivf_flat.serialize(idx, path)
+    idx2 = ivf_flat.deserialize(path, res=res)
+    sp = ivf_flat.SearchParams(n_probes=32)
+    d0, i0 = ivf_flat.search(idx, q, 10, sp, res=res)
+    d1, i1 = ivf_flat.search(idx2, q, 10, sp, res=res)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_allclose(np.asarray(d0), np.asarray(d1), rtol=1e-6)
+
+
+def test_index_file_flip_byte_is_typed(tmp_path):
+    """Single-chip index files get the same typed corruption detection as
+    sharded rank files."""
+    from raft_tpu.testing import faults
+
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((512, 16)).astype(np.float32)
+    res = Resources(seed=0)
+    idx = ivf_flat.build(x, ivf_flat.IndexParams(n_lists=8,
+                                                 kmeans_n_iters=2), res=res)
+    path = str(tmp_path / "flat")
+    ivf_flat.serialize(idx, path)
+    faults.flip_record_byte(path, 5)
+    with pytest.raises(IntegrityError) as ei:
+        ivf_flat.deserialize(path, res=res)
+    assert ei.value.reason == "corrupt"
+    assert ei.value.path == path
+    assert ei.value.record == 5

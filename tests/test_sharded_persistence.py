@@ -82,15 +82,24 @@ def test_sharded_deserialize_validation(tmp_path, data):
     with pytest.raises(ValueError, match="stale rank files"):
         sharded.deserialize_ivf_flat(prefix, comms)
 
-    # a partial checkpoint (missing shard ranks) must name the gap
-    with pytest.raises(ValueError, match=r"missing \[1, 3\]"):
+    # a partial checkpoint (missing shard ranks) must name the gap AND the
+    # expected file paths the operator should go look for
+    with pytest.raises(ValueError,
+                       match=r"missing \[1, 3\].*p\.rank1, p\.rank3"):
         sharded._check_rank_coverage({0: "f", 2: "f"}, 4, "p")
 
-    # and absent files fail loudly
     import os
 
+    # dropping some (not all) rank files is a coverage error naming them
     os.remove(prefix + ".rank1")
     os.remove(prefix + ".rank0")
+    with pytest.raises(ValueError, match=r"missing \[0, 1\]"):
+        sharded.deserialize_ivf_flat(prefix, comms)
+
+    # and a prefix with no rank files at all fails loudly
+    for p in os.listdir(tmp_path):
+        if p.startswith("mm.rank"):
+            os.remove(tmp_path / p)
     with pytest.raises(FileNotFoundError):
         sharded.deserialize_ivf_flat(prefix, comms)
 

@@ -81,6 +81,14 @@ def main():
                          "checkpoint prefix (works on any device count, "
                          "e.g. an 8-virtual-shard CPU build on the one "
                          "real chip) and run the sweep")
+    ap.add_argument("--scan-mode",
+                    default=os.environ.get("RAFT_TPU_QUEUE_SCAN_MODE",
+                                           "lut"),
+                    choices=["lut", "cache"],
+                    help="sharded build engine (default lut; the queue "
+                         "runner exports RAFT_TPU_QUEUE_SCAN_MODE=cache "
+                         "as a fallback when a LUT step keeps losing its "
+                         "TPU window)")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM") != "default":
@@ -202,9 +210,10 @@ def main():
         art["pq_dim"] = params.pq_dim
         art["pq_bits"] = params.pq_bits
         t0 = time.monotonic()
+        art["scan_mode"] = args.scan_mode
         idx = sharded.build_ivf_pq_from_file(
             comms, args.data, params, res=Resources(seed=0),
-            scan_mode="lut", max_train_rows=args.train_rows)
+            scan_mode=args.scan_mode, max_train_rows=args.train_rows)
         _fence(idx.list_codes)
         art["ivf_pq_sharded_build_s"] = round(time.monotonic() - t0, 1)
         art["ivf_pq_list_pad"] = int(idx.list_codes.shape[2])

@@ -5,6 +5,16 @@
 # sweep moves last so a dying window can't starve the unique artifacts
 # (DEEP-100M slice, latency decomposition, cagra sweep, pallas/aot
 # verdicts). Markers are shared with tpu_queue.sh v1.
+#
+# Reordered again (robustness round): the two LONG sharded-LUT flagship
+# steps (deepslice ~2h, flagship10m2 ~2h) used to sit between the short
+# unique artifacts — a window dying inside either starved latency/cagra/
+# pallas/aot for the whole round. They now run AFTER every short unique
+# artifact. Both steps checkpoint their build (prefix.rank* next to the
+# fbin) so a killed window resumes the sweep via --from-ckpt rather than
+# rebuilding; export RAFT_TPU_QUEUE_SCAN_MODE=cache before launching as a
+# fallback if a LUT build keeps losing its window (flagship_1m.py
+# --scan-mode picks it up).
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo:${PYTHONPATH:-}
@@ -45,14 +55,6 @@ run_step pareto /tmp/q5_pareto.done timeout 9000 python -m raft_tpu.bench run \
   --algos raft \
   --out BENCH_SIFT1M_tpu.jsonl --csv BENCH_SIFT1M_tpu.csv --pareto
 
-# DEEP-100M per-chip slice (VERDICT #4) — unique, can't be recovered from
-# a partial run as cheaply as the sweeps; data pre-generated off-window
-run_step deepslice /tmp/q5_deepslice.done env RAFT_TPU_BENCH_PLATFORM=default \
-  timeout 7200 python tools/flagship_1m.py --rows 12500000 --dim 96 \
-  --nlist 6250 --pq-dim 64 --pq-bits 5 --train-rows 1000000 \
-  --refine-ratio 4 --probes 20 50 100 200 500 1000 --skip-cagra \
-  --data /tmp/deep_slice.fbin --out DEEP100M_SLICE_tpu.json
-
 # batch-1/10 latency decomposition (VERDICT #8) — quick
 run_step latency /tmp/q5_latency.done timeout 2400 \
   python tools/latency_profile.py --out LATENCY_TPU.json
@@ -65,9 +67,23 @@ run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
 run_step pallas /tmp/q5_pallas.done timeout 1800 python tools/pallas_probe.py
 run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
+# ---- long sharded-LUT builds: after the short unique artifacts above.
+# RAFT_TPU_QUEUE_SCAN_MODE (default lut) flows into flagship_1m.py
+# --scan-mode; set =cache when a LUT build keeps dying mid-window.
+
+# DEEP-100M per-chip slice (VERDICT #4) — unique, can't be recovered from
+# a partial run as cheaply as the sweeps; data pre-generated off-window
+run_step deepslice /tmp/q5_deepslice.done env RAFT_TPU_BENCH_PLATFORM=default \
+  RAFT_TPU_QUEUE_SCAN_MODE=${RAFT_TPU_QUEUE_SCAN_MODE:-lut} \
+  timeout 7200 python tools/flagship_1m.py --rows 12500000 --dim 96 \
+  --nlist 6250 --pq-dim 64 --pq-bits 5 --train-rows 1000000 \
+  --refine-ratio 4 --probes 20 50 100 200 500 1000 --skip-cagra \
+  --data /tmp/deep_slice.fbin --out DEEP100M_SLICE_tpu.json
+
 # 10M flagship at 0.95 (VERDICT #9): restart-lost checkpoint -> fresh
 # single-chip build from the pre-generated fbin (minutes on chip)
 run_step flagship10m2 /tmp/q5_flagship10m2.done env RAFT_TPU_BENCH_PLATFORM=default \
+  RAFT_TPU_QUEUE_SCAN_MODE=${RAFT_TPU_QUEUE_SCAN_MODE:-lut} \
   timeout 7200 python tools/flagship_1m.py --rows 10000000 --dim 96 \
   --nlist 16384 --train-rows 1000000 --data /tmp/flagship_10m.fbin \
   --refine-ratio 4 --probes 32 64 128 256 512 1024 --skip-cagra \
