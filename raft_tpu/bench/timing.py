@@ -41,14 +41,20 @@ __all__ = [
 ]
 
 # Populated by time_dispatches / time_latency_chained after every
-# measurement: {"rtt_bound": bool, "fence_overhead_frac": float}. A loop
-# that is still RTT-dominated when iteration scaling gives up (the
-# _MAX_ITERS / HBM caps) returns a noise-bound number; callers that
-# persist results should record this flag so artifacts distinguish clean
-# from noise-bound measurements (ADVICE r3). Contract: read IMMEDIATELY
-# after the timing call returns — the next timing call (including any
-# nested inside a dispatch fn) overwrites it.
-last_info: dict = {"rtt_bound": False, "fence_overhead_frac": 0.0}
+# measurement: {"rtt_bound": bool, "fence_overhead_frac": float,
+# "samples_s": [per-round per-iter seconds]}. A loop that is still
+# RTT-dominated when iteration scaling gives up (the _MAX_ITERS / HBM
+# caps) returns a noise-bound number; callers that persist results
+# should record this flag so artifacts distinguish clean from
+# noise-bound measurements (ADVICE r3). "samples_s" holds one sample per
+# fenced round (len == the rounds argument), so callers can report
+# percentiles instead of a mean that hides host-contention skew (the r5
+# 37-45 ms b1 outliers sat invisible under a 6 ms mean for a whole
+# round). Contract: read IMMEDIATELY after the timing call returns — the
+# next timing call (including any nested inside a dispatch fn)
+# overwrites it.
+last_info: dict = {"rtt_bound": False, "fence_overhead_frac": 0.0,
+                   "samples_s": []}
 
 
 def fence(out: Any) -> int:
@@ -117,6 +123,7 @@ def _amortize(elapsed: float, iters: int, fenced: bool = True) -> float:
     arrays) paid no readback, so nothing is subtracted — otherwise the
     correction would inflate exactly the CPU-baseline QPS it exists to
     keep honest."""
+    last_info["samples_s"] = []  # a multi-round caller refills after
     if not fenced:
         last_info["rtt_bound"] = False
         last_info["fence_overhead_frac"] = 0.0
@@ -189,23 +196,41 @@ def time_dispatches(dispatch: Callable[[], Any], iters: int = 5,
 
 
 def time_latency_chained(step: Callable[[Any], Any], x0: Any,
-                         iters: int = 8) -> float:
+                         iters: int = 8, rounds: int = 1) -> float:
     """Per-call device latency WITHOUT a per-call readback: each call's
     input depends on the previous call's output (caller encodes the
     dependency, e.g. via :func:`chain_perturb`), so executions serialize
-    on-device; the fence round-trip is paid once and amortized."""
-    fence(step(x0))  # warm / compile (calibration is lazy — see above)
-    while True:
+    on-device; the fence round-trip is paid once and amortized.
+
+    ``rounds > 1`` repeats the converged measurement, each round fenced
+    separately, leaving one per-iter sample per round in
+    ``last_info["samples_s"]`` (read immediately — the next timing call
+    overwrites it) and returning their mean. Round-level samples are the
+    honest tail-latency granularity here: a finer per-call probe would
+    need a per-call readback, which would measure the tunnel instead of
+    the chip (module docstring)."""
+
+    def _one_round(n):
         t0 = time.perf_counter()
         out = x0
-        for _ in range(iters):
+        for _ in range(n):
             out = step(out)
         fenced = fence(out) > 0
-        elapsed = time.perf_counter() - t0
+        return time.perf_counter() - t0, fenced
+
+    fence(step(x0))  # warm / compile (calibration is lazy — see above)
+    while True:
+        elapsed, fenced = _one_round(iters)
         nxt = _scaled_iters(elapsed, iters) if fenced else None
         if nxt is None:
-            return _amortize(elapsed, iters, fenced)
+            break
         iters = nxt  # RTT-dominated: chain more calls
+    samples = [_amortize(elapsed, iters, fenced)]
+    for _ in range(max(int(rounds), 1) - 1):
+        elapsed, fenced = _one_round(iters)
+        samples.append(_amortize(elapsed, iters, fenced))
+    last_info["samples_s"] = list(samples)
+    return sum(samples) / len(samples)
 
 
 def chain_perturb(x: jax.Array, prev_out: Any) -> jax.Array:

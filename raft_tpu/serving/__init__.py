@@ -1,0 +1,48 @@
+"""raft_tpu.serving — async micro-batching serving engine.
+
+Coalesces concurrent single-query searches into AOT-warmed
+``query_bucket`` batch shapes in front of every index family
+(brute_force / ivf_flat / ivf_pq / cagra). See docs/serving.md for the
+anatomy, deadline tuning, and the measured warmup table; drive load
+with tools/serving_bench.py.
+
+Quick start::
+
+    from raft_tpu import serving
+
+    searcher = serving.ivf_pq_searcher(index, params)
+    with serving.Engine(searcher, serving.EngineConfig(
+            max_batch=64, max_wait_us=2000)) as eng:
+        fut = eng.submit(query, k=10)        # -> concurrent.futures.Future
+        distances, indices = fut.result()    # rows, bit-identical to solo
+"""
+
+from raft_tpu.serving.batcher import (Batch, Batcher, EngineStopped,
+                                      QueueFull, Request)
+from raft_tpu.serving.engine import (Engine, EngineConfig, compile_count,
+                                     solo_reference, verify_bit_identity)
+from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
+                                        cagra_searcher, ivf_flat_searcher,
+                                        ivf_pq_searcher, make_searcher)
+from raft_tpu.serving.stats import ServingStats, percentiles
+
+__all__ = [
+    "Batch",
+    "Batcher",
+    "Engine",
+    "EngineConfig",
+    "EngineStopped",
+    "QueueFull",
+    "Request",
+    "Searcher",
+    "ServingStats",
+    "brute_force_searcher",
+    "cagra_searcher",
+    "compile_count",
+    "ivf_flat_searcher",
+    "ivf_pq_searcher",
+    "make_searcher",
+    "percentiles",
+    "solo_reference",
+    "verify_bit_identity",
+]

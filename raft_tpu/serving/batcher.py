@@ -1,0 +1,195 @@
+"""Admission queue + deadline coalescing policy.
+
+The batcher is the host-side half of the serving engine's exactness
+story: it only ever *groups and pads* requests into the same
+``utils.shape.query_bucket`` shapes the public ``search()`` wrappers
+already compile, so a coalesced request's result row is bit-identical to
+a solo search at the same bucket (the row-wise search cores never mix
+rows; the bucketing tests pin that).
+
+Flush policy (the reference's small-batch serving modes — CAGRA
+MULTI_CTA/MULTI_KERNEL, cagra_types.hpp:66-116 — solved the same tension
+kernel-side; on TPU it is a host admission policy):
+
+- flush as soon as ``max_batch`` same-``k`` requests are pending
+  (throughput bound), or
+- when the OLDEST pending request has waited ``max_wait_us``
+  (latency bound — the deadline is per-admission, so a trickle of
+  singletons never waits more than one deadline).
+
+Requests with different ``k`` never coalesce (they would need different
+compiled programs); the queue stays FIFO across ``k`` groups so a rare
+``k`` cannot be starved by a hot one.
+
+All waiting happens against an injectable ``clock`` so the deterministic
+CPU tests drive the policy with a fake clock and no threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "Batch", "Batcher", "QueueFull", "EngineStopped"]
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at capacity and ``block=False``."""
+
+
+class EngineStopped(RuntimeError):
+    """Submitted to / pending in an engine that has been stopped."""
+
+
+class Request:
+    """One in-flight query: payload + future + timing breadcrumbs."""
+
+    __slots__ = ("query", "k", "future", "t_submit", "t_launch")
+
+    def __init__(self, query: np.ndarray, k: int, future, t_submit: float):
+        self.query = query
+        self.k = k
+        self.future = future
+        self.t_submit = t_submit
+        self.t_launch: Optional[float] = None
+
+
+class Batch:
+    """A coalesced, launched batch riding the completion queue."""
+
+    __slots__ = ("requests", "distances", "indices", "t_launch", "bucket")
+
+    def __init__(self, requests: List[Request], distances, indices,
+                 t_launch: float, bucket: int):
+        self.requests = requests
+        self.distances = distances
+        self.indices = indices
+        self.t_launch = t_launch
+        self.bucket = bucket
+
+
+class Batcher:
+    """Thread-safe FIFO admission queue with same-``k`` coalescing.
+
+    ``put`` never blocks past backpressure; ``take`` returns the next
+    batch according to the ``(max_batch, max_wait_us)`` policy. The
+    policy itself (:meth:`select`) is pure given the queue contents and
+    a timestamp, which is what the fake-clock tests exercise.
+    """
+
+    def __init__(self, max_batch: int = 64, max_wait_us: int = 2000,
+                 queue_limit: int = 4096,
+                 clock: Callable[[], float] = time.perf_counter):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = max(int(max_wait_us), 0) * 1e-6
+        self.queue_limit = int(queue_limit)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._space = threading.Condition(self._lock)
+        self._queue: List[Request] = []
+        self._stopping = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ---------------------------------------------------------- admission
+    def put(self, req: Request, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        with self._lock:
+            if self._stopping:
+                raise EngineStopped("engine is stopped; no new requests")
+            if len(self._queue) >= self.queue_limit:
+                if not block:
+                    raise QueueFull(
+                        f"admission queue at capacity ({self.queue_limit})")
+                deadline = None if timeout is None else (
+                    self.clock() + timeout)
+                while len(self._queue) >= self.queue_limit:
+                    if self._stopping:
+                        raise EngineStopped(
+                            "engine stopped while waiting for queue space")
+                    remaining = (None if deadline is None
+                                 else deadline - self.clock())
+                    if remaining is not None and remaining <= 0:
+                        raise QueueFull(
+                            f"admission queue at capacity "
+                            f"({self.queue_limit}) after {timeout}s")
+                    self._space.wait(remaining)
+            self._queue.append(req)
+            self._nonempty.notify()
+
+    # ------------------------------------------------------------- policy
+    def select(self, now: float) -> Optional[List[Request]]:
+        """The pure flush decision: given the current queue and ``now``,
+        return the requests to launch, or None to keep waiting.
+
+        Must be called with the lock held (``take`` does); exposed for
+        the deterministic tests, which call it under :meth:`locked`.
+        """
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        ready = [r for r in self._queue if r.k == head.k][:self.max_batch]
+        if (len(ready) >= self.max_batch
+                or now - head.t_submit >= self.max_wait_s
+                or self._stopping):
+            for r in ready:
+                self._queue.remove(r)
+            self._space.notify_all()
+            return ready
+        return None
+
+    def locked(self):
+        """Context manager over the internal lock (test hook)."""
+        return self._lock
+
+    # -------------------------------------------------------------- take
+    def take(self, block: bool = True) -> Optional[List[Request]]:
+        """Next batch per the flush policy; None when ``block=False`` and
+        nothing is ready, or when stopping and the queue is drained."""
+        with self._lock:
+            while True:
+                if self._stopping and not self._queue:
+                    return None
+                batch = self.select(self.clock())
+                if batch is not None:
+                    return batch
+                if not block:
+                    return None
+                if self._queue:
+                    # sleep only until the oldest request's deadline
+                    head_deadline = (self._queue[0].t_submit
+                                     + self.max_wait_s)
+                    # timeout 0.0 is a valid "re-check immediately" (the
+                    # deadline raced past between select() and here)
+                    self._nonempty.wait(
+                        max(head_deadline - self.clock(), 0.0))
+                else:
+                    self._nonempty.wait()
+
+    # ----------------------------------------------------------- shutdown
+    def stop(self, drain: bool) -> List[Request]:
+        """Mark stopping. With ``drain`` the queued requests stay for the
+        dispatch loop to flush (deadlines are voided — everything pending
+        launches immediately); otherwise they are removed and returned so
+        the caller can fail their futures."""
+        with self._lock:
+            self._stopping = True
+            cancelled: List[Request] = []
+            if not drain:
+                cancelled, self._queue = self._queue, []
+            self._nonempty.notify_all()
+            self._space.notify_all()
+            return cancelled
+
+    @property
+    def stopping(self) -> bool:
+        with self._lock:
+            return self._stopping

@@ -1,0 +1,376 @@
+"""Async micro-batching serving engine.
+
+``Engine`` sits in front of one built index (via a
+:mod:`raft_tpu.serving.searchers` handle) and turns concurrent
+single-query ``submit()`` calls into batched searches at the
+``utils.shape.query_bucket`` shapes the index's public wrapper already
+compiles. The measured case for coalescing: on chip, batch-10 search
+latency equals batch-1 latency (BENCH_r05.json: ivf_flat 6.238 ms b1 vs
+6.259 ms b10), so every solo dispatch forfeits ~10x per-replica QPS at
+iso-latency.
+
+Three mechanisms, each its own thread-or-phase:
+
+1. **Warm start** (:meth:`Engine.start`): pin the index device-resident
+   once, optionally enable the persistent XLA compile cache
+   (AOT_CACHE_tpu.json measured 2-11.8x warm wins), then pre-trace and
+   compile every configured bucket shape with a zeros batch — the first
+   user request compiles nothing (asserted via the
+   :func:`compile_count` jax.monitoring hook in the tests).
+2. **Dispatch thread**: drains the :class:`~raft_tpu.serving.batcher.
+   Batcher` under the ``(max_batch, max_wait_us)`` policy, stacks the
+   coalesced queries on the host, and launches ONE compiled search.
+   JAX dispatch is asynchronous, so the launch returns while the device
+   works; the thread immediately stages the next batch.
+3. **Completion thread**: blocks on the host readback of the oldest
+   in-flight batch (``np.asarray`` — the only honest completion fence,
+   bench/timing.py) and scatters per-request row slices through the
+   futures. With ``max_inflight >= 2`` batch N's readback overlaps
+   batch N+1's staging and device time, so host staging — the thing
+   that ballooned b1 latency to 37-45 ms under host contention in
+   BENCH_TPU_SESSION_r05.json — no longer serializes with the device.
+
+Exactness: a coalesced request's result row is bit-identical to a solo
+search of the same query at the same bucket shape and row (the search
+cores are row-wise; tools/serving_bench.py re-verifies this per run).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.serving.batcher import (Batch, Batcher, EngineStopped,
+                                      Request)
+from raft_tpu.serving.searchers import Searcher
+from raft_tpu.serving.stats import ServingStats
+from raft_tpu.utils.shape import query_bucket
+
+__all__ = ["EngineConfig", "Engine", "compile_count", "EngineStopped",
+           "solo_reference", "verify_bit_identity"]
+
+
+# --------------------------------------------------------------------------
+# compile-count hook (jax.monitoring): lets tests and the warmup report
+# assert "the first submit after start() compiled nothing".
+_compile_lock = threading.Lock()
+_compile_events = 0
+_listener_registered = False
+
+
+def _compile_listener(event: str, duration: float, **kwargs) -> None:
+    global _compile_events
+    if "backend_compile" in event:
+        with _compile_lock:
+            _compile_events += 1
+
+
+def compile_count() -> int:
+    """Process-wide count of XLA backend compiles observed since the
+    first call (jax.monitoring duration events). Monotonic; compare
+    deltas around a region to assert cache hits."""
+    global _listener_registered
+    with _compile_lock:
+        if not _listener_registered:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _compile_listener)
+            _listener_registered = True
+        return _compile_events
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Knobs for one serving engine (docs/serving.md for tuning).
+
+    ``max_batch`` caps coalescing; keep it <= 256 so every reachable
+    batch lands on a warmed power-of-two bucket (``query_bucket`` keeps
+    exact shapes above 256, which cannot all be pre-compiled).
+    ``max_wait_us`` is the latency the slowest rider donates to the
+    batch; with on-chip b1 == b10 latency, a deadline near the device
+    latency converts straight into batch size under load.
+    """
+
+    max_batch: int = 64
+    max_wait_us: int = 2000
+    max_inflight: int = 2
+    queue_limit: int = 4096
+    warm_ks: Tuple[int, ...] = (10,)
+    warm_buckets: Optional[Tuple[int, ...]] = None  # None: derive
+    #: None: enable the persistent XLA cache on non-CPU backends only
+    #: (XLA:CPU cached AOT artifacts have SIGILL'd — tests/conftest.py)
+    persistent_cache: Optional[bool] = None
+    stats_window: int = 8192
+
+
+def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
+    """Every bucket shape a batch of 1..max_batch can land on."""
+    out = []
+    n = 1
+    while True:
+        b = query_bucket(min(n, max_batch))
+        if b not in out:
+            out.append(b)
+        if n >= max_batch:
+            break
+        n = b + 1
+    return tuple(out)
+
+
+class Engine:
+    """Micro-batching front end for one :class:`Searcher` handle."""
+
+    def __init__(self, searcher: Searcher,
+                 config: Optional[EngineConfig] = None,
+                 clock=time.perf_counter):
+        self.searcher = searcher
+        self.config = config or EngineConfig()
+        self.clock = clock
+        self.stats = ServingStats(window=self.config.stats_window)
+        self.batcher = Batcher(self.config.max_batch,
+                               self.config.max_wait_us,
+                               self.config.queue_limit, clock)
+        self._completion: _queue.Queue = _queue.Queue()
+        self._inflight = threading.Semaphore(self.config.max_inflight)
+        self._outstanding = 0
+        self._outstanding_cv = threading.Condition()
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._completion_thread: Optional[threading.Thread] = None
+        self._started = False
+        self._stopped = False
+        self.warmup_info: dict = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Engine":
+        """Warm everything, then start the dispatch/completion threads.
+        After ``start()`` returns, the first ``submit()`` pays no XLA
+        compile and no index upload."""
+        if self._started:
+            return self
+        from raft_tpu.bench.timing import fence
+
+        cfg = self.config
+        t0 = self.clock()
+        use_cache = cfg.persistent_cache
+        if use_cache is None:
+            import jax
+
+            use_cache = jax.default_backend() != "cpu"
+        if use_cache:
+            from raft_tpu.utils.compile_cache import enable_persistent_cache
+
+            enable_persistent_cache()
+        c0 = compile_count()
+        n_placed = self.searcher.place()
+        buckets = cfg.warm_buckets or _default_warm_buckets(cfg.max_batch)
+        for b in buckets:
+            zeros = np.zeros((b, self.searcher.dim),
+                             self.searcher.query_dtype)
+            for k in cfg.warm_ks:
+                fence(self.searcher.search(zeros, int(k)))
+        self.warmup_info = {
+            "warm_s": round(self.clock() - t0, 3),
+            "buckets": list(buckets),
+            "ks": list(cfg.warm_ks),
+            "compiles": compile_count() - c0,
+            "arrays_placed": n_placed,
+            "persistent_cache": bool(use_cache),
+        }
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="raft-tpu-serving-dispatch",
+            daemon=True)
+        self._completion_thread = threading.Thread(
+            target=self._completion_loop, name="raft-tpu-serving-complete",
+            daemon=True)
+        self._dispatch_thread.start()
+        self._completion_thread.start()
+        self._started = True
+        return self
+
+    def __enter__(self) -> "Engine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=exc == (None, None, None))
+
+    # -------------------------------------------------------------- client
+    def submit(self, query, k: int, block: bool = True,
+               timeout: Optional[float] = None) -> Future:
+        """Enqueue one query; the Future resolves to
+        ``(distances [k], indices [k])`` numpy rows, bit-identical to a
+        solo search at the batch's bucket. Raises
+        :class:`EngineStopped` after :meth:`stop`, ``QueueFull`` when
+        ``block=False`` and the admission queue is at capacity."""
+        if not self._started or self._stopped:
+            raise EngineStopped("engine not running; call start()")
+        q = np.asarray(query, self.searcher.query_dtype)
+        if q.ndim == 2 and q.shape[0] == 1:
+            q = q[0]
+        if q.shape != (self.searcher.dim,):
+            raise ValueError(
+                f"query shape {q.shape} != ({self.searcher.dim},)")
+        fut: Future = Future()
+        req = Request(q, int(k), fut, self.clock())
+        with self._outstanding_cv:
+            self._outstanding += 1
+        try:
+            self.batcher.put(req, block=block, timeout=timeout)
+        except BaseException:
+            self._resolve(1)
+            raise
+        self.stats.record_submit()
+        return fut
+
+    def search(self, query, k: int, timeout: Optional[float] = None):
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(query, k).result(timeout)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every admitted request has resolved. True on
+        success, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._outstanding_cv:
+            while self._outstanding > 0:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._outstanding_cv.wait(remaining)
+        return True
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Stop the engine. ``drain=True`` flushes queued + in-flight
+        requests first (deadlines voided — everything launches
+        immediately); ``drain=False`` cancels queued requests (their
+        futures get :class:`EngineStopped`) but still completes batches
+        already launched."""
+        if not self._started or self._stopped:
+            self._stopped = True
+            return
+        self._stopped = True
+        cancelled = self.batcher.stop(drain)
+        for r in cancelled:
+            if not r.future.cancel():
+                r.future.set_exception(
+                    EngineStopped("engine stopped before launch"))
+        if cancelled:
+            self.stats.record_cancelled(len(cancelled))
+            self._resolve(len(cancelled))
+        if self._dispatch_thread is not None:
+            self._dispatch_thread.join(timeout)
+        if self._completion_thread is not None:
+            self._completion_thread.join(timeout)
+
+    # ------------------------------------------------------------- internal
+    def _resolve(self, n: int) -> None:
+        with self._outstanding_cv:
+            self._outstanding -= n
+            if self._outstanding <= 0:
+                self._outstanding_cv.notify_all()
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            reqs = self.batcher.take(block=True)
+            if reqs is None:  # stopping and drained
+                self._completion.put(None)
+                return
+            # honor client-side Future.cancel() before paying the launch
+            live = [r for r in reqs
+                    if r.future.set_running_or_notify_cancel()]
+            if len(live) < len(reqs):
+                self.stats.record_cancelled(len(reqs) - len(live))
+                self._resolve(len(reqs) - len(live))
+            if not live:
+                continue
+            # pipelining cap: at most max_inflight launched-unread batches
+            self._inflight.acquire()
+            t_launch = self.clock()
+            for r in live:
+                r.t_launch = t_launch
+            # pad to the bucket HERE (host-side zeros) rather than letting
+            # the wrapper do it: a full-bucket batch makes the wrapper's
+            # trailing `v[:nq]` a no-op, so the warmed programs cover the
+            # whole request path (a short batch would compile a fresh
+            # eager dynamic_slice per (nq, k) on the first request)
+            bucket = query_bucket(len(live))
+            batch = np.zeros((bucket, self.searcher.dim),
+                             self.searcher.query_dtype)
+            for j, r in enumerate(live):
+                batch[j] = r.query
+            try:
+                d, i = self.searcher.search(batch, live[0].k)
+            except BaseException as e:  # noqa: B036 — relay to callers
+                self._inflight.release()
+                for r in live:
+                    r.future.set_exception(e)
+                self._resolve(len(live))
+                continue
+            self._completion.put(Batch(live, d, i, t_launch, bucket))
+
+    def _completion_loop(self) -> None:
+        while True:
+            b = self._completion.get()
+            if b is None:
+                return
+            try:
+                # the serving host sync BY DESIGN: one readback completes
+                # batch N while the dispatch thread stages batch N+1
+                d_np = np.asarray(b.distances)  # graftcheck: R001
+                i_np = np.asarray(b.indices)  # graftcheck: R001
+            except BaseException as e:  # noqa: B036 — relay to callers
+                self._inflight.release()
+                for r in b.requests:
+                    r.future.set_exception(e)
+                self._resolve(len(b.requests))
+                continue
+            self._inflight.release()
+            t_done = self.clock()
+            for j, r in enumerate(b.requests):
+                # placement breadcrumb for the exactness oracle
+                # (solo_reference needs the row + bucket the request rode)
+                r.future.placement = (j, b.bucket)
+                r.future.set_result((d_np[j], i_np[j]))
+            self.stats.record_batch(
+                len(b.requests), b.bucket,
+                [b.t_launch - r.t_submit for r in b.requests],
+                t_done - b.t_launch,
+                [t_done - r.t_submit for r in b.requests])
+            self._resolve(len(b.requests))
+
+
+def solo_reference(searcher: Searcher, query, k: int, row: int,
+                   bucket: int) -> Tuple[np.ndarray, np.ndarray]:
+    """The engine's exactness oracle: search ``query`` ALONE in a
+    zero-padded batch of ``bucket`` rows at row ``row`` — the same
+    compiled program, shape, and row position a coalesced batch uses,
+    with no other live queries. A coalesced request's result must be
+    bit-identical to this (proves riders never leak into each other's
+    rows). Used by tests and tools/serving_bench.py."""
+    q = np.zeros((bucket, searcher.dim), searcher.query_dtype)
+    q[row] = np.asarray(query, searcher.query_dtype)
+    d, i = searcher.search(q, int(k))
+    return np.asarray(d)[row], np.asarray(i)[row]
+
+
+def verify_bit_identity(searcher: Searcher, queries: Sequence,
+                        results: Sequence, k: int,
+                        placements: Sequence[Tuple[int, int]]) -> int:
+    """Count mismatches between engine ``results`` (rows of (d, i)) and
+    the :func:`solo_reference` oracle; ``placements`` are the futures'
+    ``(row, bucket)`` breadcrumbs."""
+    bad = 0
+    for query, (d_row, i_row), (row, bucket) in zip(queries, results,
+                                                    placements):
+        d_ref, i_ref = solo_reference(searcher, query, k, row, bucket)
+        if not (np.array_equal(d_row, d_ref)
+                and np.array_equal(i_row, i_ref)):
+            bad += 1
+    return bad

@@ -1,0 +1,141 @@
+"""Lock-cheap serving observability: per-request latency decomposition,
+batch/bucket histograms, and percentile snapshots.
+
+Design constraints (the reason this is not a metrics framework):
+
+- ``record_*`` sits on the completion path of every request, so it must
+  be O(1) and hold one uncontended lock for a few appends — no sorting,
+  no allocation beyond the sample ring.
+- Percentiles are computed only in :meth:`snapshot` (the scrape path),
+  over a bounded sample window, so an unbounded run can't grow host
+  memory (the serving analog of the bench artifacts' fixed-size rows).
+- The clock is injectable: the deterministic tests drive a fake clock
+  and assert exact counter/percentile values.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+__all__ = ["ServingStats", "percentiles"]
+
+
+def percentiles(samples: Sequence[float],
+                pcts=(50.0, 95.0, 99.0)) -> Dict[str, float]:
+    """Nearest-rank percentiles of ``samples`` as ``{"p50": ...}``.
+
+    Nearest-rank (ceil(p/100 * n) - 1 on the sorted samples) rather than
+    interpolation: a latency percentile should be a latency that actually
+    happened, and the r5 host-contention skew (37-45 ms b1 outliers) is
+    exactly what interpolation against a 6 ms median would smear away.
+    """
+    if not samples:
+        return {f"p{int(p) if float(p).is_integer() else p}": float("nan")
+                for p in pcts}
+    s = sorted(samples)
+    out = {}
+    for p in pcts:
+        rank = max(int(-(-(p / 100.0) * len(s) // 1)) - 1, 0)  # ceil - 1
+        key = f"p{int(p) if float(p).is_integer() else p}"
+        out[key] = s[min(rank, len(s) - 1)]
+    return out
+
+
+class ServingStats:
+    """Counters + bounded latency samples for one :class:`Engine`.
+
+    Three per-request latency components, all in seconds:
+
+    - ``queue_wait``: admission → batch launch (the coalescing deadline's
+      direct cost; bounded by ``max_wait_us`` under light load).
+    - ``device``: batch launch → results on host (device execution plus
+      readback, amortized over the batch).
+    - ``total``: admission → future resolved.
+    """
+
+    def __init__(self, window: int = 8192):
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self.n_submitted = 0
+        self.n_completed = 0
+        self.n_cancelled = 0
+        self.n_batches = 0
+        self.batch_size_hist: Dict[int, int] = {}
+        self.bucket_hist: Dict[int, int] = {}
+        self._queue_wait = deque(maxlen=self._window)
+        self._device = deque(maxlen=self._window)
+        self._total = deque(maxlen=self._window)
+
+    # ---------------------------------------------------------- recording
+    def record_submit(self, n: int = 1) -> None:
+        with self._lock:
+            self.n_submitted += n
+
+    def record_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self.n_cancelled += n
+
+    def record_batch(self, batch_size: int, bucket: int,
+                     queue_waits: Sequence[float], device_s: float,
+                     totals: Sequence[float]) -> None:
+        """One completed batch: per-request queue-wait/total samples plus
+        the shared device+readback time (every rider pays the same batch
+        execution, so one device sample per request keeps the per-request
+        view honest without pretending per-row timing exists)."""
+        with self._lock:
+            self.n_batches += 1
+            self.n_completed += len(totals)
+            self.batch_size_hist[batch_size] = (
+                self.batch_size_hist.get(batch_size, 0) + 1)
+            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
+            self._queue_wait.extend(queue_waits)
+            self._total.extend(totals)
+            self._device.extend([device_s] * len(totals))
+
+    # ----------------------------------------------------------- scraping
+    def snapshot(self) -> dict:
+        """Point-in-time view: counters, histograms, and p50/p95/p99 (ms)
+        for each latency component over the sample window."""
+        with self._lock:
+            qw = list(self._queue_wait)
+            dv = list(self._device)
+            tt = list(self._total)
+            snap = {
+                "n_submitted": self.n_submitted,
+                "n_completed": self.n_completed,
+                "n_cancelled": self.n_cancelled,
+                "n_batches": self.n_batches,
+                "batch_size_hist": dict(sorted(self.batch_size_hist.items())),
+                "bucket_hist": dict(sorted(self.bucket_hist.items())),
+            }
+        if snap["n_batches"]:
+            snap["mean_batch_size"] = round(
+                sum(k * v for k, v in snap["batch_size_hist"].items())
+                / snap["n_batches"], 2)
+        for name, samples in (("queue_wait_ms", qw), ("device_ms", dv),
+                              ("total_ms", tt)):
+            if samples:
+                ms = [s * 1e3 for s in samples]
+                pct = percentiles(ms)
+                snap[name] = {
+                    "mean": round(sum(ms) / len(ms), 3),
+                    **{k: round(v, 3) for k, v in pct.items()},
+                }
+        return snap
+
+    def reset_samples(self) -> None:
+        """Drop latency samples (keep counters) — lets a load sweep scope
+        percentiles to one offered-load point."""
+        with self._lock:
+            self._queue_wait.clear()
+            self._device.clear()
+            self._total.clear()
+
+    # convenience for tests / artifacts
+    def mean_total_ms(self) -> Optional[float]:
+        with self._lock:
+            if not self._total:
+                return None
+            return sum(self._total) / len(self._total) * 1e3

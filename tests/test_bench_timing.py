@@ -61,3 +61,33 @@ def test_time_latency_chained_serializes_and_returns_positive():
 
     dt = time_latency_chained(step, q0, iters=4)
     assert dt > 0
+
+
+def test_time_latency_chained_rounds_collects_samples():
+    from raft_tpu.bench.timing import last_info
+
+    f = jax.jit(lambda q: q @ q.T)
+    q0 = jnp.ones((4, 4))
+
+    def step(q):
+        return chain_perturb(q0, f(q))
+
+    dt = time_latency_chained(step, q0, iters=4, rounds=5)
+    samples = last_info["samples_s"]
+    assert len(samples) == 5
+    assert all(s > 0 for s in samples)
+    # the return value is the mean of the recorded samples
+    assert dt == pytest.approx(sum(samples) / len(samples))
+    # a single-round call resets the samples to exactly one entry
+    time_latency_chained(step, q0, iters=4)
+    assert len(last_info["samples_s"]) == 1
+
+
+def test_percentile_fields_shape():
+    """The bench extras' latency percentile helper: nearest-rank keys the
+    artifact schema promises (p50/p95/p99)."""
+    from raft_tpu.serving.stats import percentiles
+
+    pct = percentiles([0.001, 0.002, 0.040])  # one contended round
+    assert set(pct) == {"p50", "p95", "p99"}
+    assert pct["p99"] == 0.040  # the outlier survives; a mean hides it

@@ -1,0 +1,307 @@
+"""Deterministic CPU tests for the async micro-batching serving engine.
+
+Covers the batcher flush policy (fake clock, no threads), bucket-padding
+exactness (engine rows bit-identical to solo searches), concurrent
+submitters, drain/shutdown with in-flight requests, fake-clock stats
+accuracy, and the warm-start guarantee (first submit compiles nothing,
+via the jax.monitoring compile hook)."""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.serving.batcher import Batcher, EngineStopped, QueueFull, Request
+from raft_tpu.serving.engine import _default_warm_buckets, compile_count
+from raft_tpu.serving.stats import ServingStats, percentiles
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+def _req(k=10, t=0.0, query=None):
+    return Request(query if query is not None
+                   else np.zeros(DIM, np.float32), k, Future(), t)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------- batcher
+def test_max_batch_flush_ignores_deadline():
+    clock = FakeClock()
+    b = Batcher(max_batch=4, max_wait_us=10_000_000, clock=clock)
+    for _ in range(5):
+        b.put(_req(t=clock.t))
+    with b.locked():
+        batch = b.select(clock())  # t=0: deadline nowhere near
+    assert batch is not None and len(batch) == 4
+    assert len(b) == 1  # the fifth stays queued
+
+
+def test_deadline_flush_of_partial_batch():
+    clock = FakeClock()
+    b = Batcher(max_batch=8, max_wait_us=1000, clock=clock)
+    for _ in range(3):
+        b.put(_req(t=clock.t))
+    with b.locked():
+        assert b.select(clock()) is None          # deadline not reached
+    clock.t = 0.0009
+    with b.locked():
+        assert b.select(clock()) is None          # 0.9 ms < 1 ms
+    clock.t = 0.0011
+    with b.locked():
+        batch = b.select(clock())                 # oldest aged out
+    assert batch is not None and len(batch) == 3
+    assert len(b) == 0
+
+
+def test_distinct_k_never_coalesces_and_fifo_across_groups():
+    clock = FakeClock()
+    b = Batcher(max_batch=8, max_wait_us=0, clock=clock)
+    b.put(_req(k=10, t=0.0))
+    b.put(_req(k=5, t=0.0))
+    b.put(_req(k=10, t=0.0))
+    with b.locked():
+        first = b.select(clock())
+    assert [r.k for r in first] == [10, 10]  # same-k group, FIFO head
+    with b.locked():
+        second = b.select(clock())
+    assert [r.k for r in second] == [5]
+
+
+def test_queue_limit_backpressure():
+    b = Batcher(max_batch=8, max_wait_us=0, queue_limit=2)
+    b.put(_req())
+    b.put(_req())
+    with pytest.raises(QueueFull):
+        b.put(_req(), block=False)
+    with pytest.raises(QueueFull):
+        b.put(_req(), block=True, timeout=0.01)
+
+
+def test_stop_drain_voids_deadline_and_no_drain_returns_cancelled():
+    clock = FakeClock()
+    b = Batcher(max_batch=8, max_wait_us=10_000_000, clock=clock)
+    b.put(_req(t=0.0))
+    assert b.stop(drain=True) == []
+    with b.locked():
+        batch = b.select(clock())  # stopping: flush immediately
+    assert batch is not None and len(batch) == 1
+    assert b.take(block=True) is None  # drained + stopping -> None
+
+    b2 = Batcher(max_batch=8, max_wait_us=10_000_000, clock=clock)
+    r = _req(t=0.0)
+    b2.put(r)
+    cancelled = b2.stop(drain=False)
+    assert cancelled == [r]
+    with pytest.raises(EngineStopped):
+        b2.put(_req())
+
+
+def test_default_warm_buckets_cover_every_batch_size():
+    from raft_tpu.utils.shape import query_bucket
+
+    for max_batch in (1, 7, 8, 64, 256):
+        buckets = _default_warm_buckets(max_batch)
+        reachable = {query_bucket(n) for n in range(1, max_batch + 1)}
+        assert set(buckets) == reachable
+
+
+# ------------------------------------------------------------------ stats
+def test_percentiles_nearest_rank_exact():
+    samples = list(range(1, 101))  # 1..100
+    p = percentiles(samples)
+    assert p == {"p50": 50, "p95": 95, "p99": 99}
+    assert percentiles([7.0]) == {"p50": 7.0, "p95": 7.0, "p99": 7.0}
+
+
+def test_stats_counters_and_latency_under_fake_clock():
+    st = ServingStats()
+    st.record_submit(4)
+    # batch of 3 launched at t=1.0, submitted at t=0.2/0.5/0.9,
+    # results on host at t=1.5
+    waits = [1.0 - 0.2, 1.0 - 0.5, 1.0 - 0.9]
+    totals = [1.5 - 0.2, 1.5 - 0.5, 1.5 - 0.9]
+    st.record_batch(3, 8, waits, 0.5, totals)
+    st.record_batch(1, 8, [0.0], 0.25, [0.25])
+    st.record_cancelled()
+    snap = st.snapshot()
+    assert snap["n_submitted"] == 4
+    assert snap["n_completed"] == 4
+    assert snap["n_cancelled"] == 1
+    assert snap["n_batches"] == 2
+    assert snap["batch_size_hist"] == {1: 1, 3: 1}
+    assert snap["bucket_hist"] == {8: 2}
+    assert snap["mean_batch_size"] == 2.0
+    # nearest-rank over [800, 500, 100, 0] ms queue waits
+    assert snap["queue_wait_ms"]["p50"] == 100.0
+    assert snap["queue_wait_ms"]["p99"] == 800.0
+    assert snap["total_ms"]["p50"] == 600.0
+    assert snap["device_ms"]["mean"] == pytest.approx(437.5)
+    st.reset_samples()
+    snap2 = st.snapshot()
+    assert "total_ms" not in snap2 and snap2["n_completed"] == 4
+
+
+# ----------------------------------------------------------------- engine
+@pytest.fixture(scope="module")
+def flat_searcher():
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+    return serving.ivf_flat_searcher(index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _engine(searcher, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_us", 5000)
+    kw.setdefault("warm_ks", (K,))
+    return serving.Engine(searcher, serving.EngineConfig(**kw))
+
+
+def test_warm_start_first_submit_compiles_nothing(flat_searcher):
+    rng = np.random.default_rng(0)
+    with _engine(flat_searcher) as eng:
+        assert eng.warmup_info["compiles"] >= 0  # hook live from start()
+        c0 = compile_count()
+        futs = [eng.submit(rng.standard_normal(DIM, np.float32)
+                           .astype(np.float32), K) for _ in range(17)]
+        for f in futs:
+            d, i = f.result(timeout=60)
+            assert d.shape == (K,) and i.shape == (K,)
+        assert compile_count() - c0 == 0, (
+            "serving path compiled after Engine.start() warmup")
+
+
+def test_coalesced_results_bit_identical_to_solo(flat_searcher):
+    rng = np.random.default_rng(1)
+    queries = [rng.standard_normal(DIM).astype(np.float32)
+               for _ in range(12)]
+    with _engine(flat_searcher, max_wait_us=50_000) as eng:
+        futs = [eng.submit(q, K) for q in queries]
+        results = [f.result(timeout=60) for f in futs]
+        placements = [f.placement for f in futs]
+    # vs the solo oracle at the same bucket/row (all four families obey)
+    assert serving.verify_bit_identity(
+        flat_searcher, queries, results, K, placements) == 0
+    # stronger, row-position-free claim for the row-independent families:
+    # the engine row equals a plain solo search() of just that query
+    # whenever the coalesced bucket matches the solo bucket
+    for q, (d_row, i_row), (_, bucket) in zip(queries, results, placements):
+        if bucket == 8:  # query_bucket(1) == 8: same compiled program
+            d_solo, i_solo = flat_searcher.search(q[None], K)
+            np.testing.assert_array_equal(i_row, np.asarray(i_solo)[0])
+            np.testing.assert_array_equal(d_row, np.asarray(d_solo)[0])
+
+
+def test_concurrent_submitters_all_complete_and_match(flat_searcher):
+    rng = np.random.default_rng(2)
+    n_threads, per_thread = 6, 8
+    queries = [[rng.standard_normal(DIM).astype(np.float32)
+                for _ in range(per_thread)] for _ in range(n_threads)]
+    out = [[None] * per_thread for _ in range(n_threads)]
+    placements = [[None] * per_thread for _ in range(n_threads)]
+    with _engine(flat_searcher, max_wait_us=2000) as eng:
+        def worker(ti):
+            for j, q in enumerate(queries[ti]):
+                f = eng.submit(q, K)
+                out[ti][j] = f.result(timeout=60)
+                placements[ti][j] = f.placement
+
+        threads = [threading.Thread(target=worker, args=(ti,))
+                   for ti in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = eng.stats.snapshot()
+    total = n_threads * per_thread
+    assert snap["n_submitted"] == total
+    assert snap["n_completed"] == total
+    assert sum(b * c for b, c in snap["batch_size_hist"].items()) == total
+    flat_q = [q for qs in queries for q in qs]
+    flat_r = [r for rs in out for r in rs]
+    flat_p = [p for ps in placements for p in ps]
+    assert serving.verify_bit_identity(
+        flat_searcher, flat_q, flat_r, K, flat_p) == 0
+
+
+def test_stop_with_drain_completes_in_flight(flat_searcher):
+    rng = np.random.default_rng(4)
+    # a deadline far in the future: requests are still queued when stop()
+    # lands, so drain must flush them
+    eng = _engine(flat_searcher, max_wait_us=30_000_000, max_batch=64)
+    eng.start()
+    futs = [eng.submit(rng.standard_normal(DIM).astype(np.float32), K)
+            for _ in range(5)]
+    assert not any(f.done() for f in futs[:1])  # deadline not reachable
+    eng.stop(drain=True)
+    for f in futs:
+        d, i = f.result(timeout=10)  # resolved by the drain flush
+        assert i.shape == (K,)
+    with pytest.raises(EngineStopped):
+        eng.submit(np.zeros(DIM, np.float32), K)
+
+
+def test_stop_without_drain_fails_queued_requests(flat_searcher):
+    eng = _engine(flat_searcher, max_wait_us=30_000_000, max_batch=64)
+    eng.start()
+    futs = [eng.submit(np.zeros(DIM, np.float32), K) for _ in range(3)]
+    eng.stop(drain=False)
+    for f in futs:
+        assert f.cancelled() or isinstance(f.exception(), EngineStopped)
+    snap = eng.stats.snapshot()
+    assert snap["n_cancelled"] == 3
+
+
+def test_drain_waits_for_outstanding(flat_searcher):
+    rng = np.random.default_rng(5)
+    with _engine(flat_searcher, max_wait_us=1000) as eng:
+        futs = [eng.submit(rng.standard_normal(DIM).astype(np.float32), K)
+                for _ in range(9)]
+        assert eng.drain(timeout=60)
+        assert all(f.done() for f in futs)
+
+
+def test_submit_validation_and_distinct_k(flat_searcher):
+    with _engine(flat_searcher, max_wait_us=0) as eng:
+        with pytest.raises(ValueError):
+            eng.submit(np.zeros(DIM + 1, np.float32), K)
+        d5, i5 = eng.submit(np.zeros(DIM, np.float32), K).result(60)
+        d3, i3 = eng.submit(np.zeros(DIM, np.float32), 3).result(60)
+        assert i5.shape == (K,) and i3.shape == (3,)
+
+
+@pytest.mark.slow
+def test_open_loop_soak(flat_searcher):
+    """Open-loop Poisson soak: sustained arrivals, no deadlock, stats
+    account for every request (the serving_bench open-loop mode in
+    miniature)."""
+    rng = np.random.default_rng(6)
+    n = 150
+    with _engine(flat_searcher, max_wait_us=2000) as eng:
+        futs = []
+        for gap in rng.exponential(1 / 200.0, n):
+            time.sleep(gap)
+            futs.append(eng.submit(
+                rng.standard_normal(DIM).astype(np.float32), K))
+        for f in futs:
+            f.result(timeout=60)
+        snap = eng.stats.snapshot()
+    assert snap["n_completed"] == n
+    assert snap["total_ms"]["p50"] > 0
+    assert sum(snap["bucket_hist"].values()) == snap["n_batches"]

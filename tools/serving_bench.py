@@ -1,0 +1,268 @@
+"""Serving-engine load generator: closed-loop and open-loop (Poisson)
+benchmarks of raft_tpu.serving against the b1-dispatch baseline.
+
+Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
+
+- ``baseline_b1``: the naive request path — one query per search, host
+  sync per call (what every concurrent user pays today without the
+  engine). Also a chained-latency variant that amortizes the readback
+  RTT (the fair device-latency floor on a tunnel-attached TPU).
+- ``closed_loop``: N submitter threads, each submit→result→next through
+  one Engine. QPS, speedup vs b1, recall, and a full bit-identity sweep:
+  every coalesced result is compared against a solo search of the same
+  query at the same bucket shape and row (``serving.solo_reference``).
+- ``open_loop``: Poisson arrivals at fractions of the closed-loop QPS;
+  per-rate p50/p95/p99 queue-wait / device / total latency and achieved
+  throughput — the latency-throughput curve whose knee is the per-replica
+  capacity number the ROADMAP's traffic story needs.
+
+Artifact: SERVING_cpu.json / SERVING_tpu.json (name follows the measured
+platform unless --out is given).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serving_bench.py --families ivf_flat
+    python tools/serving_bench.py            # all families, active backend
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_family(family, db, res):
+    """Build one index + serving searcher at bench-shaped parameters."""
+    from raft_tpu import serving
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    t0 = time.perf_counter()
+    if family == "brute_force":
+        index = brute_force.build(db, metric="sqeuclidean", res=res)
+        searcher = serving.brute_force_searcher(index, res=res)
+    elif family == "ivf_flat":
+        index = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=128),
+                               res=res)
+        searcher = serving.ivf_flat_searcher(
+            index, ivf_flat.SearchParams(n_probes=32), res=res)
+    elif family == "ivf_pq":
+        index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=128, pq_dim=32),
+                             res=res)
+        searcher = serving.ivf_pq_searcher(
+            index, ivf_pq.SearchParams(n_probes=32), res=res)
+    elif family == "cagra":
+        index = cagra.build(db, cagra.IndexParams(
+            graph_degree=32, intermediate_graph_degree=64), res=res)
+        searcher = serving.cagra_searcher(
+            index, cagra.SearchParams(itopk_size=64, search_width=4),
+            res=res)
+    else:
+        raise ValueError(f"unknown family {family!r}")
+    return searcher, round(time.perf_counter() - t0, 2)
+
+
+def bench_baseline_b1(searcher, queries, k):
+    """Sequential single-query dispatch with a host sync per call — the
+    per-request path a request handler without the engine runs."""
+    from raft_tpu.bench import timing
+
+    # warm the b1 bucket (engine warmup already compiled it; this is for
+    # a standalone run of only this function)
+    timing.fence(searcher.search(queries[:1], k))
+    indices = []
+    t0 = time.perf_counter()
+    for q in queries:
+        d, i = searcher.search(q[None], k)
+        indices.append(np.asarray(i)[0])  # per-call sync: the naive path
+    elapsed = time.perf_counter() - t0
+    # RTT-amortized chained variant: the device-latency floor (the tunnel
+    # readback is paid once, bench/timing.py)
+    q0 = timing.prepare(queries[:1])
+    chained_s = timing.time_latency_chained(
+        lambda qq: timing.chain_perturb(q0, searcher.search(qq, k)),
+        q0, iters=8)
+    return {
+        "qps": round(len(queries) / elapsed, 1),
+        "mean_ms": round(elapsed / len(queries) * 1e3, 3),
+        "chained_ms": round(chained_s * 1e3, 3),
+    }, np.stack(indices)
+
+
+def bench_closed_loop(engine, queries, k, submitters):
+    """N threads, each submit→result→next over its share of ``queries``.
+    Returns (summary, indices in query order, placements)."""
+    shares = np.array_split(np.arange(len(queries)), submitters)
+    results = [None] * len(queries)
+    placements = [None] * len(queries)
+    barrier = threading.Barrier(submitters + 1)
+
+    def worker(ids):
+        barrier.wait()
+        for qi in ids:
+            fut = engine.submit(queries[qi], k)
+            results[qi] = fut.result()
+            placements[qi] = fut.placement
+
+    threads = [threading.Thread(target=worker, args=(ids,))
+               for ids in shares if len(ids)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    indices = np.stack([r[1] for r in results])
+    summary = {
+        "submitters": submitters,
+        "n": len(queries),
+        "qps": round(len(queries) / elapsed, 1),
+        "mean_ms": round(elapsed / len(queries) * submitters * 1e3, 3),
+    }
+    return summary, indices, results, placements
+
+
+def bench_open_loop(engine, queries, k, rate_qps, n_requests, rng):
+    """Poisson arrivals at ``rate_qps``; per-request latency percentiles
+    from the engine's ServingStats over exactly this run's samples."""
+    engine.stats.reset_samples()
+    futs = []
+    gaps = rng.exponential(1.0 / rate_qps, n_requests)
+    t0 = time.perf_counter()
+    next_t = t0
+    for j in range(n_requests):
+        next_t += gaps[j]
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        futs.append(engine.submit(queries[j % len(queries)], k))
+    for f in futs:
+        f.result()
+    elapsed = time.perf_counter() - t0
+    snap = engine.stats.snapshot()
+    row = {
+        "offered_qps": round(rate_qps, 1),
+        "achieved_qps": round(n_requests / elapsed, 1),
+        "n": n_requests,
+        "mean_batch_size": snap.get("mean_batch_size"),
+    }
+    for key in ("queue_wait_ms", "device_ms", "total_ms"):
+        if key in snap:
+            row[key] = snap[key]
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default SERVING_<platform>.json)")
+    ap.add_argument("--families", nargs="*", default=[
+        "brute_force", "ivf_flat", "ivf_pq", "cagra"])
+    ap.add_argument("--rows", type=int, default=10_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--submitters", type=int, default=8)
+    ap.add_argument("--queries-per-submitter", type=int, default=50)
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-wait-us", type=int, default=2000)
+    ap.add_argument("--max-inflight", type=int, default=2)
+    ap.add_argument("--open-loop-fractions", type=float, nargs="*",
+                    default=[0.25, 0.5, 0.75, 0.9])
+    ap.add_argument("--open-loop-queries", type=int, default=200)
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip the per-request bit-identity sweep")
+    args = ap.parse_args()
+
+    if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from raft_tpu import Resources, serving
+    from raft_tpu.bench.datagen import low_rank_clusters
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.stats import neighborhood_recall
+
+    platform = jax.devices()[0].platform
+    out_path = args.out or f"SERVING_{platform}.json"
+    rng = np.random.default_rng(0)
+    n_q = args.submitters * args.queries_per_submitter
+    both = low_rank_clusters(rng, args.rows + n_q, args.dim, n_centers=64)
+    db, queries = both[:args.rows], both[args.rows:]
+    res = Resources(seed=0)
+    _, gt_j = brute_force.knn(queries, db, k=args.k, metric="sqeuclidean",
+                              res=res)
+    gt = np.asarray(gt_j)
+
+    config = serving.EngineConfig(
+        max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+        max_inflight=args.max_inflight, warm_ks=(args.k,))
+    art = {
+        "platform": platform,
+        "rows": args.rows, "dim": args.dim, "k": args.k,
+        "config": {"max_batch": args.max_batch,
+                   "max_wait_us": args.max_wait_us,
+                   "max_inflight": args.max_inflight},
+        "families": {},
+    }
+
+    for family in args.families:
+        print(f"=== {family}", flush=True)
+        searcher, build_s = build_family(family, db, res)
+        row = {"build_s": build_s}
+        base, base_idx = bench_baseline_b1(searcher, queries, args.k)
+        base["recall"] = round(
+            float(neighborhood_recall(base_idx, gt)), 4)
+        row["baseline_b1"] = base
+        print(f"  b1 baseline: {base}", flush=True)
+
+        engine = serving.Engine(searcher, config)
+        engine.start()
+        row["warmup"] = engine.warmup_info
+        try:
+            closed, idx, results, placements = bench_closed_loop(
+                engine, queries, args.k, args.submitters)
+            closed["recall"] = round(float(neighborhood_recall(idx, gt)), 4)
+            closed["speedup_vs_b1"] = round(closed["qps"] / base["qps"], 2)
+            closed["stats"] = engine.stats.snapshot()
+            if not args.no_verify:
+                mismatches = serving.verify_bit_identity(
+                    searcher, queries, results, args.k, placements)
+                closed["verified"] = len(results)
+                closed["mismatches"] = mismatches
+                closed["bit_identical"] = mismatches == 0
+            row["closed_loop"] = closed
+            print(f"  closed loop: qps={closed['qps']} "
+                  f"({closed['speedup_vs_b1']}x b1), "
+                  f"recall={closed['recall']}, "
+                  f"mismatches={closed.get('mismatches')}", flush=True)
+
+            row["open_loop"] = []
+            for frac in args.open_loop_fractions:
+                rate = max(closed["qps"] * frac, 1.0)
+                ol = bench_open_loop(engine, queries, args.k, rate,
+                                     args.open_loop_queries, rng)
+                row["open_loop"].append(ol)
+                print(f"  open loop @{ol['offered_qps']} qps: "
+                      f"total p99={ol.get('total_ms', {}).get('p99')} ms",
+                      flush=True)
+        finally:
+            engine.stop()
+        art["families"][family] = row
+
+    art["when"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"-> {out_path}")
+    return art
+
+
+if __name__ == "__main__":
+    main()

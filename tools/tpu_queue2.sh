@@ -67,6 +67,12 @@ run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
 run_step pallas /tmp/q5_pallas.done timeout 1800 python tools/pallas_probe.py
 run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
+# micro-batching serving engine: closed-loop QPS vs the sequential-b1
+# baseline + open-loop tail latency at Poisson load (docs/serving.md) —
+# quick; exactness cross-check against solo search is on by default
+run_step serving /tmp/q5_serving.done timeout 2400 \
+  python tools/serving_bench.py --out SERVING_tpu.json
+
 # ---- long sharded-LUT builds: after the short unique artifacts above.
 # RAFT_TPU_QUEUE_SCAN_MODE (default lut) flows into flagship_1m.py
 # --scan-mode; set =cache when a LUT build keeps dying mid-window.
