@@ -15,23 +15,39 @@ Quick start::
             max_batch=64, max_wait_us=2000)) as eng:
         fut = eng.submit(query, k=10)        # -> concurrent.futures.Future
         distances, indices = fut.result()    # rows, bit-identical to solo
+
+Overload & failure semantics (docs/serving.md): per-request
+``deadline_ms`` shed (``DeadlineExceeded``), watermark admission control
+(``Overloaded``), per-batch failure containment (``BatchFailed``), a
+hang watchdog + circuit breaker (``CircuitOpen``, ``Engine.health()``),
+and zero-downtime ``Engine.swap_index``. Chaos-tested in
+tests/test_serving_chaos.py with the injectors in
+``raft_tpu.testing.faults``.
 """
 
-from raft_tpu.serving.batcher import (Batch, Batcher, EngineStopped,
-                                      QueueFull, Request)
-from raft_tpu.serving.engine import (Engine, EngineConfig, compile_count,
+from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
+                                      EngineStopped, QueueFull, Request)
+from raft_tpu.serving.engine import (BatchFailed, CircuitBreaker,
+                                     CircuitOpen, Engine, EngineConfig,
+                                     Overloaded, compile_count,
                                      solo_reference, verify_bit_identity)
 from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
-                                        cagra_searcher, ivf_flat_searcher,
+                                        cagra_searcher, elastic_searcher,
+                                        ivf_flat_searcher,
                                         ivf_pq_searcher, make_searcher)
 from raft_tpu.serving.stats import ServingStats, percentiles
 
 __all__ = [
     "Batch",
+    "BatchFailed",
     "Batcher",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "DeadlineExceeded",
     "Engine",
     "EngineConfig",
     "EngineStopped",
+    "Overloaded",
     "QueueFull",
     "Request",
     "Searcher",
@@ -39,6 +55,7 @@ __all__ = [
     "brute_force_searcher",
     "cagra_searcher",
     "compile_count",
+    "elastic_searcher",
     "ivf_flat_searcher",
     "ivf_pq_searcher",
     "make_searcher",

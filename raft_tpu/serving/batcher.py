@@ -33,7 +33,8 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
-__all__ = ["Request", "Batch", "Batcher", "QueueFull", "EngineStopped"]
+__all__ = ["Request", "Batch", "Batcher", "QueueFull", "EngineStopped",
+           "DeadlineExceeded"]
 
 
 class QueueFull(RuntimeError):
@@ -44,31 +45,53 @@ class EngineStopped(RuntimeError):
     """Submitted to / pending in an engine that has been stopped."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request's ``deadline_ms`` passed before its batch launched (or,
+    for :meth:`Engine.search`, before the result came back). Always a
+    typed failure on the future — a shed request is never silently
+    dropped."""
+
+
 class Request:
-    """One in-flight query: payload + future + timing breadcrumbs."""
+    """One in-flight query: payload + future + timing breadcrumbs.
 
-    __slots__ = ("query", "k", "future", "t_submit", "t_launch")
+    ``t_deadline`` (absolute, engine clock) is the shed deadline derived
+    from the caller's ``deadline_ms``: a request still queued past it is
+    shed at the next launch attempt instead of riding a batch whose
+    result the caller has already given up on."""
 
-    def __init__(self, query: np.ndarray, k: int, future, t_submit: float):
+    __slots__ = ("query", "k", "future", "t_submit", "t_launch",
+                 "t_deadline")
+
+    def __init__(self, query: np.ndarray, k: int, future, t_submit: float,
+                 t_deadline: Optional[float] = None):
         self.query = query
         self.k = k
         self.future = future
         self.t_submit = t_submit
         self.t_launch: Optional[float] = None
+        self.t_deadline = t_deadline
 
 
 class Batch:
-    """A coalesced, launched batch riding the completion queue."""
+    """A coalesced, launched batch riding the completion queue.
 
-    __slots__ = ("requests", "distances", "indices", "t_launch", "bucket")
+    ``searcher`` is the handle that served the launch — snapshotted per
+    batch so a concurrent :meth:`Engine.swap_index` never splits one
+    batch across two indexes, and so the exactness oracle can verify each
+    result against whichever index actually served it."""
+
+    __slots__ = ("requests", "distances", "indices", "t_launch", "bucket",
+                 "searcher")
 
     def __init__(self, requests: List[Request], distances, indices,
-                 t_launch: float, bucket: int):
+                 t_launch: float, bucket: int, searcher=None):
         self.requests = requests
         self.distances = distances
         self.indices = indices
         self.t_launch = t_launch
         self.bucket = bucket
+        self.searcher = searcher
 
 
 class Batcher:
@@ -93,6 +116,7 @@ class Batcher:
         self._nonempty = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)
         self._queue: List[Request] = []
+        self._expired: List[Request] = []
         self._stopping = False
 
     def __len__(self) -> int:
@@ -132,7 +156,18 @@ class Batcher:
 
         Must be called with the lock held (``take`` does); exposed for
         the deterministic tests, which call it under :meth:`locked`.
+
+        Requests whose shed deadline (``t_deadline``) has passed are
+        pruned BEFORE batch selection — they never ride a launch — and
+        parked for :meth:`pop_expired`, where the engine fails their
+        futures with :class:`DeadlineExceeded`.
         """
+        expired = [r for r in self._queue
+                   if r.t_deadline is not None and now >= r.t_deadline]
+        if expired:
+            self._queue = [r for r in self._queue if r not in expired]
+            self._expired.extend(expired)
+            self._space.notify_all()
         if not self._queue:
             return None
         head = self._queue[0]
@@ -150,6 +185,14 @@ class Batcher:
         """Context manager over the internal lock (test hook)."""
         return self._lock
 
+    def pop_expired(self) -> List[Request]:
+        """Drain the requests :meth:`select` pruned for passing their shed
+        deadline. The engine's dispatch loop calls this after every
+        ``take`` and fails the futures with :class:`DeadlineExceeded`."""
+        with self._lock:
+            expired, self._expired = self._expired, []
+            return expired
+
     # -------------------------------------------------------------- take
     def take(self, block: bool = True) -> Optional[List[Request]]:
         """Next batch per the flush policy; None when ``block=False`` and
@@ -161,16 +204,26 @@ class Batcher:
                 batch = self.select(self.clock())
                 if batch is not None:
                     return batch
+                if self._expired and not block:
+                    return None
+                if self._expired:
+                    # wake the dispatch loop so shed futures fail promptly
+                    # (it calls pop_expired after every take)
+                    return []
                 if not block:
                     return None
                 if self._queue:
-                    # sleep only until the oldest request's deadline
-                    head_deadline = (self._queue[0].t_submit
-                                     + self.max_wait_s)
+                    # sleep only until the next actionable instant: the
+                    # oldest request's flush deadline, or the earliest
+                    # shed deadline (a request must fail promptly at its
+                    # deadline_ms even when the flush deadline is far)
+                    wake = self._queue[0].t_submit + self.max_wait_s
+                    for r in self._queue:
+                        if r.t_deadline is not None:
+                            wake = min(wake, r.t_deadline)
                     # timeout 0.0 is a valid "re-check immediately" (the
                     # deadline raced past between select() and here)
-                    self._nonempty.wait(
-                        max(head_deadline - self.clock(), 0.0))
+                    self._nonempty.wait(max(wake - self.clock(), 0.0))
                 else:
                     self._nonempty.wait()
 

@@ -33,26 +33,53 @@ Three mechanisms, each its own thread-or-phase:
 Exactness: a coalesced request's result row is bit-identical to a solo
 search of the same query at the same bucket shape and row (the search
 cores are row-wise; tools/serving_bench.py re-verifies this per run).
+
+Robustness layer (docs/serving.md "Overload & failure semantics"; the
+chaos invariants are pinned in tests/test_serving_chaos.py):
+
+- **Deadlines & load shedding**: per-request ``deadline_ms`` sheds
+  queued requests at launch time with a typed
+  :class:`~raft_tpu.serving.batcher.DeadlineExceeded`; an admission
+  controller latches shed mode between a high/low watermark on queue
+  depth (plus an optional probability ramp) so overload degrades to
+  fast, typed :class:`Overloaded` rejections instead of unbounded wait.
+- **Failure containment**: any exception in the dispatch or completion
+  path fails ONLY that batch's futures with :class:`BatchFailed`
+  (carrying the cause) and the loops keep serving — no stranded futures,
+  no dead engine.
+- **Watchdog + circuit breaker**: a watchdog thread fails any device
+  call exceeding ``hang_timeout_s`` and trips a
+  :class:`CircuitBreaker` (open → half-open probe → closed) so a sick
+  device sheds with :class:`CircuitOpen` instead of queueing;
+  :meth:`Engine.health` summarizes ok/degraded/unhealthy for probes.
+- **Hot swap**: :meth:`Engine.swap_index` replaces the index between
+  batches with zero dropped requests, pre-warming the new index's
+  compile cache off the hot path — including promoting a
+  degraded-coverage elastic restore to a full one (docs/robustness.md).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue as _queue
+import random as _random
 import threading
 import time
-from concurrent.futures import Future
-from typing import Optional, Sequence, Tuple
+from concurrent.futures import Future, InvalidStateError
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from raft_tpu.serving.batcher import (Batch, Batcher, EngineStopped,
-                                      Request)
+from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
+                                      EngineStopped, Request)
 from raft_tpu.serving.searchers import Searcher
 from raft_tpu.serving.stats import ServingStats
 from raft_tpu.utils.shape import query_bucket
 
 __all__ = ["EngineConfig", "Engine", "compile_count", "EngineStopped",
+           "BatchFailed", "Overloaded", "CircuitOpen", "CircuitBreaker",
            "solo_reference", "verify_bit_identity"]
 
 
@@ -86,6 +113,93 @@ def compile_count() -> int:
         return _compile_events
 
 
+# ------------------------------------------------------------ typed errors
+class BatchFailed(RuntimeError):
+    """A batch's device call failed (exception or watchdog-detected hang):
+    every rider's future gets THIS exception, with the underlying cause on
+    ``.cause`` (also chained via ``__cause__``) and ``.hang`` marking a
+    watchdog trip. The engine itself keeps serving — the failure is
+    contained to the one batch."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None,
+                 hang: bool = False):
+        super().__init__(message)
+        self.cause = cause
+        self.hang = bool(hang)
+        if cause is not None:
+            self.__cause__ = cause
+
+
+class Overloaded(RuntimeError):
+    """Admission rejected by the load-shedding controller (queue depth
+    over the watermark or the shed-probability ramp). A fast, typed
+    rejection — the caller should back off or retry elsewhere, not
+    wait."""
+
+
+class CircuitOpen(Overloaded):
+    """Admission rejected because the circuit breaker is open: the device
+    hung within the last ``breaker_cooldown_s`` and has not yet passed a
+    half-open probe. Subclasses :class:`Overloaded` so one handler
+    covers both shed paths."""
+
+
+class CircuitBreaker:
+    """open → half-open probe → closed breaker around the device path.
+
+    - ``trip()`` (watchdog, on a hang) opens the breaker: admission
+      rejects with :class:`CircuitOpen` for ``cooldown_s``.
+    - After the cooldown, the next admission flips to **half-open**: new
+      requests are admitted as probes.
+    - The first probe batch outcome decides: a completed batch closes the
+      breaker; a failed/hung one re-opens it (fresh cooldown).
+    """
+
+    def __init__(self, cooldown_s: float = 5.0,
+                 clock=time.perf_counter):
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._opened_at: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def trip(self) -> None:
+        with self._lock:
+            self._state = "open"
+            self._opened_at = self.clock()
+
+    def admit(self) -> bool:
+        """True when a new request may enter (closed, or half-open probe
+        window — including the open→half-open transition once the
+        cooldown has elapsed)."""
+        with self._lock:
+            if self._state == "open":
+                if self.clock() - self._opened_at >= self.cooldown_s:
+                    self._state = "half_open"
+                    return True
+                return False
+            return True
+
+    def on_batch_result(self, ok: bool) -> None:
+        """Probe verdict: only meaningful in half-open (a closed breaker
+        ignores batch failures — those are contained per-batch, not a
+        device-health signal; only the watchdog's hang verdict opens)."""
+        with self._lock:
+            if self._state != "half_open":
+                return
+            if ok:
+                self._state = "closed"
+                self._opened_at = None
+            else:
+                self._state = "open"
+                self._opened_at = self.clock()
+
+
 @dataclasses.dataclass
 class EngineConfig:
     """Knobs for one serving engine (docs/serving.md for tuning).
@@ -96,6 +210,15 @@ class EngineConfig:
     ``max_wait_us`` is the latency the slowest rider donates to the
     batch; with on-chip b1 == b10 latency, a deadline near the device
     latency converts straight into batch size under load.
+
+    Overload & failure knobs (docs/serving.md "Overload & failure
+    semantics"): admission latches shed mode at ``queue_high_watermark``
+    pending requests and unlatches at ``queue_low_watermark``
+    (defaults: ``min(queue_limit, 16 * max_batch)`` and half of it);
+    ``shed_ramp`` adds a probabilistic shed between the watermarks so
+    rejection ramps instead of cliffing. ``hang_timeout_s`` arms the
+    watchdog (None disables); ``breaker_cooldown_s`` is the open→
+    half-open wait after a hang trips the circuit breaker.
     """
 
     max_batch: int = 64
@@ -108,6 +231,13 @@ class EngineConfig:
     #: (XLA:CPU cached AOT artifacts have SIGILL'd — tests/conftest.py)
     persistent_cache: Optional[bool] = None
     stats_window: int = 8192
+    # ---- overload / failure containment
+    queue_high_watermark: Optional[int] = None  # None: derive
+    queue_low_watermark: Optional[int] = None   # None: high // 2
+    shed_ramp: bool = False
+    shed_seed: int = 0  # deterministic ramp draws (tests)
+    hang_timeout_s: Optional[float] = 30.0
+    breaker_cooldown_s: float = 5.0
 
 
 def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -130,32 +260,68 @@ class Engine:
     def __init__(self, searcher: Searcher,
                  config: Optional[EngineConfig] = None,
                  clock=time.perf_counter):
-        self.searcher = searcher
+        self._searcher = searcher
         self.config = config or EngineConfig()
         self.clock = clock
         self.stats = ServingStats(window=self.config.stats_window)
         self.batcher = Batcher(self.config.max_batch,
                                self.config.max_wait_us,
                                self.config.queue_limit, clock)
+        cfg = self.config
+        high = cfg.queue_high_watermark
+        if high is None:
+            high = min(cfg.queue_limit, 16 * cfg.max_batch)
+        self._high_watermark = max(int(high), 1)
+        low = cfg.queue_low_watermark
+        if low is None:
+            low = self._high_watermark // 2
+        self._low_watermark = min(max(int(low), 0),
+                                  self._high_watermark - 1)
+        self._shed_rng = _random.Random(cfg.shed_seed)
+        self._admission_lock = threading.Lock()
+        self._shedding = False
+        self.breaker = CircuitBreaker(cfg.breaker_cooldown_s, clock)
         self._completion: _queue.Queue = _queue.Queue()
         self._inflight = threading.Semaphore(self.config.max_inflight)
         self._outstanding = 0
         self._outstanding_cv = threading.Condition()
+        self._swap_lock = threading.Lock()
+        self._calls_lock = threading.Lock()
+        self._calls: dict = {}  # id(call) -> live device-call record
+        self._watchdog_stop = threading.Event()
         self._dispatch_thread: Optional[threading.Thread] = None
         self._completion_thread: Optional[threading.Thread] = None
+        self._watchdog_thread: Optional[threading.Thread] = None
         self._started = False
         self._stopped = False
         self.warmup_info: dict = {}
 
+    @property
+    def searcher(self) -> Searcher:
+        """The handle currently serving (atomically replaced by
+        :meth:`swap_index`)."""
+        return self._searcher
+
     # ------------------------------------------------------------ lifecycle
-    def start(self) -> "Engine":
-        """Warm everything, then start the dispatch/completion threads.
-        After ``start()`` returns, the first ``submit()`` pays no XLA
-        compile and no index upload."""
-        if self._started:
-            return self
+    def _warm(self, searcher: Searcher) -> None:
+        """Pre-compile every configured (bucket, k) shape on ``searcher``
+        with a fenced zeros batch — runs on the CALLER's thread, so it is
+        off the dispatch hot path for both start() and swap_index()."""
         from raft_tpu.bench.timing import fence
 
+        cfg = self.config
+        buckets = cfg.warm_buckets or _default_warm_buckets(cfg.max_batch)
+        for b in buckets:
+            zeros = np.zeros((b, searcher.dim), searcher.query_dtype)
+            for k in cfg.warm_ks:
+                fence(searcher.search(zeros, int(k)))
+
+    def start(self) -> "Engine":
+        """Warm everything, then start the dispatch/completion/watchdog
+        threads. After ``start()`` returns, the first ``submit()`` pays
+        no XLA compile and no index upload."""
+        if self._started:
+            return self
         cfg = self.config
         t0 = self.clock()
         use_cache = cfg.persistent_cache
@@ -168,13 +334,10 @@ class Engine:
 
             enable_persistent_cache()
         c0 = compile_count()
-        n_placed = self.searcher.place()
+        n_placed = self._searcher.place()
         buckets = cfg.warm_buckets or _default_warm_buckets(cfg.max_batch)
-        for b in buckets:
-            zeros = np.zeros((b, self.searcher.dim),
-                             self.searcher.query_dtype)
-            for k in cfg.warm_ks:
-                fence(self.searcher.search(zeros, int(k)))
+        self._warm(self._searcher)
+        self.stats.set_coverage(self._searcher.coverage)
         self.warmup_info = {
             "warm_s": round(self.clock() - t0, 3),
             "buckets": list(buckets),
@@ -191,6 +354,11 @@ class Engine:
             daemon=True)
         self._dispatch_thread.start()
         self._completion_thread.start()
+        if cfg.hang_timeout_s is not None:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="raft-tpu-serving-watchdog",
+                daemon=True)
+            self._watchdog_thread.start()
         self._started = True
         return self
 
@@ -202,22 +370,42 @@ class Engine:
 
     # -------------------------------------------------------------- client
     def submit(self, query, k: int, block: bool = True,
-               timeout: Optional[float] = None) -> Future:
+               timeout: Optional[float] = None,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one query; the Future resolves to
         ``(distances [k], indices [k])`` numpy rows, bit-identical to a
-        solo search at the batch's bucket. Raises
-        :class:`EngineStopped` after :meth:`stop`, ``QueueFull`` when
-        ``block=False`` and the admission queue is at capacity."""
+        solo search at the batch's bucket.
+
+        ``timeout`` bounds ADMISSION only (waiting for queue space with
+        ``block=True``); the returned future's ``.result(timeout)`` is a
+        separate completion bound — :meth:`search` ties both to one
+        end-to-end deadline. ``deadline_ms`` is the shed deadline: a
+        request still queued when it expires fails with
+        :class:`~raft_tpu.serving.batcher.DeadlineExceeded` instead of
+        launching (typed, never silent).
+
+        Raises :class:`EngineStopped` after :meth:`stop`, ``QueueFull``
+        when ``block=False`` and the admission queue is at capacity,
+        :class:`Overloaded` when the admission controller is shedding
+        (queue depth latched over ``queue_high_watermark``, or the
+        probability ramp fired), and :class:`CircuitOpen` while the
+        breaker holds the device path open after a hang."""
         if not self._started or self._stopped:
             raise EngineStopped("engine not running; call start()")
-        q = np.asarray(query, self.searcher.query_dtype)
+        self._admit()
+        searcher = self._searcher
+        q = np.asarray(query, searcher.query_dtype)
         if q.ndim == 2 and q.shape[0] == 1:
             q = q[0]
-        if q.shape != (self.searcher.dim,):
+        if q.shape != (searcher.dim,):
             raise ValueError(
-                f"query shape {q.shape} != ({self.searcher.dim},)")
+                f"query shape {q.shape} != ({searcher.dim},)")
         fut: Future = Future()
-        req = Request(q, int(k), fut, self.clock())
+        now = self.clock()
+        t_deadline = None
+        if deadline_ms is not None:
+            t_deadline = now + float(deadline_ms) * 1e-3
+        req = Request(q, int(k), fut, now, t_deadline)
         with self._outstanding_cv:
             self._outstanding += 1
         try:
@@ -228,9 +416,62 @@ class Engine:
         self.stats.record_submit()
         return fut
 
-    def search(self, query, k: int, timeout: Optional[float] = None):
-        """Blocking convenience: ``submit(...).result()``."""
-        return self.submit(query, k).result(timeout)
+    def _admit(self) -> None:
+        """Admission controller: breaker first (a sick device sheds
+        everything), then the latched watermark, then the optional
+        probability ramp. All rejections are typed and counted."""
+        if not self.breaker.admit():
+            self.stats.record_rejected("breaker")
+            raise CircuitOpen(
+                f"circuit breaker open after a device hang; probes resume "
+                f"after breaker_cooldown_s={self.breaker.cooldown_s}")
+        depth = len(self.batcher)
+        high, low = self._high_watermark, self._low_watermark
+        with self._admission_lock:
+            if self._shedding and depth <= low:
+                self._shedding = False
+            elif not self._shedding and depth >= high:
+                self._shedding = True
+            if self._shedding:
+                self.stats.record_rejected("overload")
+                raise Overloaded(
+                    f"shedding: queue depth {depth} latched over high "
+                    f"watermark {high} (resumes at {low})")
+            if self.config.shed_ramp and depth > low:
+                p = (depth - low) / max(high - low, 1)
+                if self._shed_rng.random() < p:
+                    self.stats.record_rejected("overload")
+                    raise Overloaded(
+                        f"shed ramp: queue depth {depth} in "
+                        f"[{low}, {high}), shed probability {p:.2f}")
+
+    def search(self, query, k: int, timeout: Optional[float] = None,
+               deadline_ms: Optional[float] = None):
+        """Blocking convenience with ONE end-to-end deadline.
+
+        The split ``submit`` documents — admission ``timeout`` vs the
+        future's own ``result(timeout)`` — is closed here: with
+        ``deadline_ms`` set, admission wait, queue time, and device time
+        all draw from the same budget and the call NEVER blocks past it.
+        Still queued at expiry → the batcher sheds it
+        (:class:`~raft_tpu.serving.batcher.DeadlineExceeded`); launched
+        but unfinished → the wait is abandoned with the same typed
+        :class:`DeadlineExceeded` (the device result, when it lands, is
+        discarded). ``timeout`` alone keeps the legacy behavior of
+        bounding only the result wait."""
+        if deadline_ms is None:
+            return self.submit(query, k, timeout=timeout).result(timeout)
+        t0 = self.clock()
+        budget_s = float(deadline_ms) * 1e-3
+        fut = self.submit(query, k, timeout=budget_s,
+                          deadline_ms=deadline_ms)
+        remaining = budget_s - (self.clock() - t0)
+        try:
+            return fut.result(max(remaining, 0.0))
+        except _FuturesTimeout:
+            fut.cancel()  # un-launched: dispatch drops it at pickup
+            raise DeadlineExceeded(
+                f"no result within deadline_ms={deadline_ms}") from None
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Block until every admitted request has resolved. True on
@@ -248,10 +489,11 @@ class Engine:
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
         """Stop the engine. ``drain=True`` flushes queued + in-flight
-        requests first (deadlines voided — everything launches
-        immediately); ``drain=False`` cancels queued requests (their
-        futures get :class:`EngineStopped`) but still completes batches
-        already launched."""
+        requests first (flush deadlines voided — everything launches
+        immediately; shed deadlines still apply at launch);
+        ``drain=False`` cancels queued requests (their futures get
+        :class:`EngineStopped`) but still completes batches already
+        launched."""
         if not self._started or self._stopped:
             self._stopped = True
             return
@@ -259,8 +501,9 @@ class Engine:
         cancelled = self.batcher.stop(drain)
         for r in cancelled:
             if not r.future.cancel():
-                r.future.set_exception(
-                    EngineStopped("engine stopped before launch"))
+                with contextlib.suppress(InvalidStateError):
+                    r.future.set_exception(
+                        EngineStopped("engine stopped before launch"))
         if cancelled:
             self.stats.record_cancelled(len(cancelled))
             self._resolve(len(cancelled))
@@ -268,6 +511,73 @@ class Engine:
             self._dispatch_thread.join(timeout)
         if self._completion_thread is not None:
             self._completion_thread.join(timeout)
+        self._watchdog_stop.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_thread.join(timeout)
+
+    # ------------------------------------------------------------ hot swap
+    def swap_index(self, searcher: Searcher, warm: bool = True) -> Searcher:
+        """Atomically replace the serving index with ``searcher`` — zero
+        dropped requests, zero cold compiles on the hot path.
+
+        The new index is placed device-resident and (with ``warm``) every
+        configured (bucket, k) shape is compiled on the CALLER's thread
+        while the old index keeps serving; only then is the handle
+        swapped under the dispatch lock, so every batch runs whole on
+        exactly one index (its identity rides ``future.searcher`` for
+        the exactness oracle). Queued requests simply launch on the new
+        index. Returns the old handle.
+
+        The promotion path (docs/robustness.md): serve a degraded
+        elastic restore (``allow_partial=True``, coverage < 1.0), repair
+        the checkpoint, and once ``sharded.verify_checkpoint`` reports
+        healthy, swap in the full restore — the coverage transition is
+        recorded in ``stats.coverage_transitions``."""
+        if self._stopped:
+            raise EngineStopped("engine is stopped")
+        old = self._searcher
+        if searcher.dim != old.dim:
+            raise ValueError(
+                f"swap_index dim mismatch: {searcher.dim} != {old.dim}")
+        if searcher.query_dtype != old.query_dtype:
+            raise ValueError(
+                f"swap_index query_dtype mismatch: {searcher.query_dtype}"
+                f" != {old.query_dtype}")
+        searcher.place()
+        if warm and self._started:
+            self._warm(searcher)
+        with self._swap_lock:
+            self._searcher = searcher
+        self.stats.record_swap(old.coverage, searcher.coverage)
+        return old
+
+    # -------------------------------------------------------------- health
+    def health(self) -> dict:
+        """Liveness summary for external probes: ``status`` is ``"ok"``
+        (serving, breaker closed, full coverage), ``"degraded"``
+        (serving but shedding, breaker half-open, or coverage < 1.0 from
+        a partial restore), or ``"unhealthy"`` (not running, or breaker
+        open after a hang)."""
+        breaker = self.breaker.state
+        with self._admission_lock:
+            shedding = self._shedding
+        coverage = self._searcher.coverage
+        if not self._started or self._stopped or breaker == "open":
+            status = "unhealthy"
+        elif breaker == "half_open" or shedding or coverage < 1.0:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "running": self._started and not self._stopped,
+            "breaker": breaker,
+            "shedding": shedding,
+            "queue_depth": len(self.batcher),
+            "coverage": coverage,
+            "n_batch_errors": self.stats.n_batch_errors,
+            "n_hangs": self.stats.n_hangs,
+        }
 
     # ------------------------------------------------------------- internal
     def _resolve(self, n: int) -> None:
@@ -276,74 +586,189 @@ class Engine:
             if self._outstanding <= 0:
                 self._outstanding_cv.notify_all()
 
+    def _fail_requests(self, reqs: Sequence[Request], exc: BaseException,
+                       hang: bool = False) -> int:
+        """Resolve ``reqs``'s still-pending futures with ``exc`` (typed,
+        never silent) and settle the outstanding count for exactly the
+        ones this call transitioned — safe to race the watchdog and the
+        completion thread."""
+        failed = 0
+        for r in reqs:
+            with contextlib.suppress(InvalidStateError):
+                r.future.set_exception(exc)
+                failed += 1
+        if failed:
+            self.stats.record_batch_failed(failed, hang=hang)
+            self._resolve(failed)
+        return failed
+
+    def _shed_expired(self) -> None:
+        """Fail the requests the batcher pruned for blowing their
+        ``deadline_ms`` — typed DeadlineExceeded, counted in stats."""
+        expired = self.batcher.pop_expired()
+        if not expired:
+            return
+        now = self.clock()
+        shed = 0
+        for r in expired:
+            waited_ms = (now - r.t_submit) * 1e3
+            with contextlib.suppress(InvalidStateError):
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed before launch (queued "
+                    f"{waited_ms:.1f} ms)"))
+                shed += 1
+        if shed:
+            self.stats.record_shed_deadline(shed)
+            self._resolve(shed)
+
+    # ---- device-call tracking (watchdog protocol): both loops bracket
+    # their blocking device interaction in a call record; the watchdog
+    # fails any record older than hang_timeout_s and marks it hung so the
+    # stuck thread discards the late result when (if) the call returns.
+    def _begin_device_call(self, reqs: List[Request], where: str) -> dict:
+        call = {"t0": self.clock(), "reqs": reqs, "where": where,
+                "hung": False}
+        with self._calls_lock:
+            self._calls[id(call)] = call
+        return call
+
+    def _end_device_call(self, call: dict) -> bool:
+        """Unregister; True when the watchdog already failed this call's
+        batch (the caller must discard the result and not re-resolve)."""
+        with self._calls_lock:
+            self._calls.pop(id(call), None)
+            return call["hung"]
+
+    def _watchdog_loop(self) -> None:
+        timeout = self.config.hang_timeout_s
+        poll = max(min(timeout / 4.0, 0.25), 0.01)
+        while not self._watchdog_stop.wait(poll):
+            now = self.clock()
+            with self._calls_lock:
+                overdue = [c for c in self._calls.values()
+                           if not c["hung"] and now - c["t0"] >= timeout]
+                for c in overdue:
+                    c["hung"] = True
+            for c in overdue:
+                self.breaker.trip()
+                self.stats.record_breaker_trip()
+                self._fail_requests(
+                    c["reqs"],
+                    BatchFailed(
+                        f"device call ({c['where']}) exceeded "
+                        f"hang_timeout_s={timeout}; circuit breaker "
+                        f"opened",
+                        cause=TimeoutError(f"hung > {timeout}s"),
+                        hang=True),
+                    hang=True)
+
+    # ------------------------------------------------------------ the loops
     def _dispatch_loop(self) -> None:
         while True:
             reqs = self.batcher.take(block=True)
             if reqs is None:  # stopping and drained
+                self._shed_expired()  # sheds pruned on the final take
                 self._completion.put(None)
                 return
-            # honor client-side Future.cancel() before paying the launch
-            live = [r for r in reqs
-                    if r.future.set_running_or_notify_cancel()]
-            if len(live) < len(reqs):
-                self.stats.record_cancelled(len(reqs) - len(live))
-                self._resolve(len(reqs) - len(live))
-            if not live:
+            # requests that blew their deadline_ms never launch — they
+            # fail HERE, promptly and typed (take() wakes for them)
+            self._shed_expired()
+            if not reqs:
                 continue
-            # pipelining cap: at most max_inflight launched-unread batches
-            self._inflight.acquire()
-            t_launch = self.clock()
-            for r in live:
-                r.t_launch = t_launch
-            # pad to the bucket HERE (host-side zeros) rather than letting
-            # the wrapper do it: a full-bucket batch makes the wrapper's
-            # trailing `v[:nq]` a no-op, so the warmed programs cover the
-            # whole request path (a short batch would compile a fresh
-            # eager dynamic_slice per (nq, k) on the first request)
-            bucket = query_bucket(len(live))
-            batch = np.zeros((bucket, self.searcher.dim),
-                             self.searcher.query_dtype)
+            try:
+                self._dispatch_batch(reqs)
+            except BaseException as e:  # noqa: B036 — containment: the
+                # loop survives anything; only this batch's riders fail
+                self._fail_requests(
+                    reqs, BatchFailed("dispatch failed", cause=e))
+                self.breaker.on_batch_result(False)
+
+    def _dispatch_batch(self, reqs: List[Request]) -> None:
+        # honor client-side Future.cancel() before paying the launch
+        live = [r for r in reqs
+                if r.future.set_running_or_notify_cancel()]
+        if len(live) < len(reqs):
+            self.stats.record_cancelled(len(reqs) - len(live))
+            self._resolve(len(reqs) - len(live))
+        if not live:
+            return
+        # pipelining cap: at most max_inflight launched-unread batches
+        self._inflight.acquire()
+        t_launch = self.clock()
+        for r in live:
+            r.t_launch = t_launch
+        # snapshot the searcher under the swap lock: a concurrent
+        # swap_index lands BETWEEN batches, never mid-batch
+        with self._swap_lock:
+            searcher = self._searcher
+        # pad to the bucket HERE (host-side zeros) rather than letting
+        # the wrapper do it: a full-bucket batch makes the wrapper's
+        # trailing `v[:nq]` a no-op, so the warmed programs cover the
+        # whole request path (a short batch would compile a fresh
+        # eager dynamic_slice per (nq, k) on the first request)
+        bucket = query_bucket(len(live))
+        try:
+            batch = np.zeros((bucket, searcher.dim), searcher.query_dtype)
             for j, r in enumerate(live):
                 batch[j] = r.query
+            call = self._begin_device_call(live, "dispatch")
             try:
-                d, i = self.searcher.search(batch, live[0].k)
-            except BaseException as e:  # noqa: B036 — relay to callers
-                self._inflight.release()
-                for r in live:
-                    r.future.set_exception(e)
-                self._resolve(len(live))
-                continue
-            self._completion.put(Batch(live, d, i, t_launch, bucket))
+                d, i = searcher.search(batch, live[0].k)
+            finally:
+                hung = self._end_device_call(call)
+        except BaseException as e:  # noqa: B036 — relay to callers
+            self._inflight.release()
+            self._fail_requests(live, BatchFailed("dispatch failed",
+                                                  cause=e))
+            self.breaker.on_batch_result(False)
+            return
+        if hung:
+            # the watchdog already failed these futures and settled the
+            # accounting while the call was stuck; drop the late result
+            self._inflight.release()
+            return
+        self._completion.put(Batch(live, d, i, t_launch, bucket, searcher))
 
     def _completion_loop(self) -> None:
         while True:
             b = self._completion.get()
             if b is None:
                 return
+            call = self._begin_device_call(b.requests, "readback")
             try:
                 # the serving host sync BY DESIGN: one readback completes
                 # batch N while the dispatch thread stages batch N+1
                 d_np = np.asarray(b.distances)  # graftcheck: R001
                 i_np = np.asarray(b.indices)  # graftcheck: R001
             except BaseException as e:  # noqa: B036 — relay to callers
+                self._end_device_call(call)
                 self._inflight.release()
-                for r in b.requests:
-                    r.future.set_exception(e)
-                self._resolve(len(b.requests))
+                self._fail_requests(
+                    b.requests, BatchFailed("readback failed", cause=e))
+                self.breaker.on_batch_result(False)
                 continue
+            hung = self._end_device_call(call)
             self._inflight.release()
+            if hung:
+                continue  # watchdog failed + settled them; discard rows
             t_done = self.clock()
+            resolved = 0
             for j, r in enumerate(b.requests):
-                # placement breadcrumb for the exactness oracle
-                # (solo_reference needs the row + bucket the request rode)
+                # placement breadcrumbs for the exactness oracle
+                # (solo_reference needs the row + bucket + the index
+                # that actually served — swaps change it mid-run)
                 r.future.placement = (j, b.bucket)
-                r.future.set_result((d_np[j], i_np[j]))
+                r.future.searcher = b.searcher
+                with contextlib.suppress(InvalidStateError):
+                    r.future.set_result((d_np[j], i_np[j]))
+                    resolved += 1
+            self.breaker.on_batch_result(True)
             self.stats.record_batch(
                 len(b.requests), b.bucket,
                 [b.t_launch - r.t_submit for r in b.requests],
                 t_done - b.t_launch,
                 [t_done - r.t_submit for r in b.requests])
-            self._resolve(len(b.requests))
+            self._resolve(resolved)
 
 
 def solo_reference(searcher: Searcher, query, k: int, row: int,

@@ -24,7 +24,8 @@ import jax
 import numpy as np
 
 __all__ = ["Searcher", "make_searcher", "brute_force_searcher",
-           "ivf_flat_searcher", "ivf_pq_searcher", "cagra_searcher"]
+           "ivf_flat_searcher", "ivf_pq_searcher", "cagra_searcher",
+           "elastic_searcher"]
 
 
 @dataclasses.dataclass
@@ -50,6 +51,15 @@ class Searcher:
                 setattr(self.index, name, jax.device_put(value))
                 n += 1
         return n
+
+    @property
+    def coverage(self) -> float:
+        """Fraction of indexed rows this handle can actually search: 1.0
+        for a normal index, < 1.0 for a degraded elastic restore
+        (``allow_partial=True``, docs/robustness.md). The engine surfaces
+        it in ``health()``/stats and records transitions across
+        :meth:`Engine.swap_index`."""
+        return float(getattr(self.index, "coverage", 1.0))
 
 
 def brute_force_searcher(index, res=None, scan_dtype=None,
@@ -100,11 +110,36 @@ def cagra_searcher(index, params=None, res=None) -> Searcher:
     return Searcher("cagra", int(index.dim), index, search)
 
 
+def elastic_searcher(index, params=None, res=None) -> Searcher:
+    """Serving handle over an elastic restore (``ElasticIvfPq`` /
+    ``ElasticIvfFlat``, parallel/sharded.py) — the degraded-serving path:
+    a partial checkpoint restored with ``allow_partial=True`` serves its
+    surviving shards here with ``searcher.coverage`` < 1.0, and a later
+    full restore is promoted in-place via :meth:`Engine.swap_index`."""
+    from raft_tpu.parallel import sharded
+
+    if isinstance(index, sharded.ElasticIvfPq):
+        family, dim = "elastic_ivf_pq", int(index.rotation.shape[2])
+    elif isinstance(index, sharded.ElasticIvfFlat):
+        family, dim = "elastic_ivf_flat", int(index.list_data.shape[3])
+    else:
+        raise TypeError(
+            f"elastic_searcher wants ElasticIvfPq/ElasticIvfFlat, got "
+            f"{type(index).__name__}")
+
+    def search(queries: np.ndarray, k: int):
+        r = index.search(queries, k, params, res=res)
+        return r.distances, r.indices
+
+    return Searcher(family, dim, index, search)
+
+
 _FACTORIES = {
     "brute_force": brute_force_searcher,
     "ivf_flat": ivf_flat_searcher,
     "ivf_pq": ivf_pq_searcher,
     "cagra": cagra_searcher,
+    "elastic": elastic_searcher,
 }
 
 

@@ -62,6 +62,19 @@ class ServingStats:
         self.n_completed = 0
         self.n_cancelled = 0
         self.n_batches = 0
+        # --- robustness counters (docs/serving.md "Overload & failure
+        # semantics"): every shed/reject/failure is typed AND counted, so
+        # an operator can tell "we shed load" from "we lost requests"
+        self.n_shed_deadline = 0        # DeadlineExceeded before launch
+        self.n_rejected_overload = 0    # Overloaded at admission
+        self.n_rejected_breaker = 0     # CircuitOpen at admission
+        self.n_failed = 0               # requests failed via BatchFailed
+        self.n_batch_errors = 0         # batches that failed (any cause)
+        self.n_hangs = 0                # watchdog-detected device hangs
+        self.n_breaker_trips = 0        # breaker transitions to open
+        self.n_swaps = 0                # hot index swaps
+        self.coverage: float = 1.0      # current searcher coverage
+        self.coverage_transitions = []  # [(old, new), ...] per swap
         self.batch_size_hist: Dict[int, int] = {}
         self.bucket_hist: Dict[int, int] = {}
         self._queue_wait = deque(maxlen=self._window)
@@ -76,6 +89,43 @@ class ServingStats:
     def record_cancelled(self, n: int = 1) -> None:
         with self._lock:
             self.n_cancelled += n
+
+    def record_shed_deadline(self, n: int = 1) -> None:
+        with self._lock:
+            self.n_shed_deadline += n
+
+    def record_rejected(self, kind: str, n: int = 1) -> None:
+        """``kind`` is ``"overload"`` (watermark/ramp shed) or
+        ``"breaker"`` (circuit open)."""
+        with self._lock:
+            if kind == "breaker":
+                self.n_rejected_breaker += n
+            else:
+                self.n_rejected_overload += n
+
+    def record_batch_failed(self, n_requests: int, hang: bool = False
+                            ) -> None:
+        """One failed batch: its requests resolved with BatchFailed."""
+        with self._lock:
+            self.n_batch_errors += 1
+            self.n_failed += n_requests
+            if hang:
+                self.n_hangs += 1
+
+    def record_breaker_trip(self) -> None:
+        with self._lock:
+            self.n_breaker_trips += 1
+
+    def record_swap(self, old_coverage: float, new_coverage: float) -> None:
+        with self._lock:
+            self.n_swaps += 1
+            self.coverage = float(new_coverage)
+            self.coverage_transitions.append(
+                (round(float(old_coverage), 6), round(float(new_coverage), 6)))
+
+    def set_coverage(self, coverage: float) -> None:
+        with self._lock:
+            self.coverage = float(coverage)
 
     def record_batch(self, batch_size: int, bucket: int,
                      queue_waits: Sequence[float], device_s: float,
@@ -107,6 +157,16 @@ class ServingStats:
                 "n_completed": self.n_completed,
                 "n_cancelled": self.n_cancelled,
                 "n_batches": self.n_batches,
+                "n_shed_deadline": self.n_shed_deadline,
+                "n_rejected_overload": self.n_rejected_overload,
+                "n_rejected_breaker": self.n_rejected_breaker,
+                "n_failed": self.n_failed,
+                "n_batch_errors": self.n_batch_errors,
+                "n_hangs": self.n_hangs,
+                "n_breaker_trips": self.n_breaker_trips,
+                "n_swaps": self.n_swaps,
+                "coverage": self.coverage,
+                "coverage_transitions": list(self.coverage_transitions),
                 "batch_size_hist": dict(sorted(self.batch_size_hist.items())),
                 "bucket_hist": dict(sorted(self.bucket_hist.items())),
             }

@@ -13,16 +13,27 @@ to survive (docs/robustness.md):
   grace logic;
 - memory budget — :func:`shrink_workspace` pins a Resources' workspace
   ceiling low, exercising the tiled fallbacks that keep results
-  bit-identical under pressure.
+  bit-identical under pressure;
+- the serving device path — :func:`fail_next_dispatch`,
+  :func:`hang_next_dispatch`, :func:`slow_searcher` perturb a serving
+  :class:`~raft_tpu.serving.searchers.Searcher` handle's device call,
+  exercising the engine's per-batch failure containment, the hang
+  watchdog + circuit breaker, and deadline/overload shedding
+  (tests/test_serving_chaos.py).
 
 All injectors operate on real bytes/sockets — no monkeypatched readers —
 so the detection paths under test are the ones production restores run.
+The serving injectors wrap the handle's real search callable (the same
+object the dispatch thread calls), so the engine's containment sees the
+exception/hang exactly where a sick device would raise it.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import time
 from typing import Iterator, Optional, Tuple
 
 from raft_tpu.core.serialize import record_spans
@@ -93,6 +104,93 @@ def sever_connection(endpoint, dest: int) -> bool:
     retry until it lands. The endpoint's send retry/backoff is expected to
     re-deliver."""
     return endpoint._sever_send(dest)
+
+
+# ----------------------------------------------------- serving injectors
+
+
+class InjectedFault(RuntimeError):
+    """The exception :func:`fail_next_dispatch` raises by default — a
+    distinctive type so chaos tests can assert the engine relayed THIS
+    cause (via ``BatchFailed.cause``) and not some coincidental error."""
+
+
+def _wrap_search(searcher, wrapper):
+    """Replace ``searcher.search`` with ``wrapper(original, queries, k)``
+    and return a zero-arg restore function. The wrapper is installed on
+    the real handle attribute, so the engine's dispatch thread (and any
+    solo oracle call) goes through it — no engine internals are
+    monkeypatched."""
+    original = searcher.search
+
+    def wrapped(queries, k):
+        return wrapper(original, queries, k)
+
+    searcher.search = wrapped
+
+    def restore():
+        searcher.search = original
+
+    return restore
+
+
+def fail_next_dispatch(searcher, exc: Optional[BaseException] = None,
+                       times: int = 1):
+    """Arm ``searcher`` so its next ``times`` search calls raise (default
+    :class:`InjectedFault`), then pass through untouched — the injected
+    analog of a transient device/runtime error mid-serve. Returns a
+    zero-arg disarm function (idempotent; auto-disarms after ``times``).
+    Thread-safe: the dispatch thread may race the arming."""
+    state = {"left": int(times)}
+    lock = threading.Lock()
+
+    def wrapper(original, queries, k):
+        with lock:
+            armed = state["left"] > 0
+            if armed:
+                state["left"] -= 1
+        if armed:
+            raise exc if exc is not None else InjectedFault(
+                "injected dispatch failure")
+        return original(queries, k)
+
+    return _wrap_search(searcher, wrapper)
+
+
+def hang_next_dispatch(searcher, hang_s: float, times: int = 1):
+    """Arm ``searcher`` so its next ``times`` search calls block for
+    ``hang_s`` seconds before delegating — a device call that stops
+    answering (the watchdog should fail the batch and trip the breaker
+    long before the sleep ends). Returns a zero-arg disarm function."""
+    state = {"left": int(times)}
+    lock = threading.Lock()
+
+    def wrapper(original, queries, k):
+        with lock:
+            armed = state["left"] > 0
+            if armed:
+                state["left"] -= 1
+        if armed:
+            time.sleep(float(hang_s))
+        return original(queries, k)
+
+    return _wrap_search(searcher, wrapper)
+
+
+@contextlib.contextmanager
+def slow_searcher(searcher, delay_s: float) -> Iterator:
+    """Context manager: every search on ``searcher`` pays an extra
+    ``delay_s`` while active — sustained device slowness, the overload
+    injector (drives queue depth past the admission watermarks without
+    needing a flood of real work)."""
+    restore = _wrap_search(
+        searcher,
+        lambda original, queries, k: (time.sleep(float(delay_s)),
+                                      original(queries, k))[1])
+    try:
+        yield searcher
+    finally:
+        restore()
 
 
 @contextlib.contextmanager

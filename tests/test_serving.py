@@ -305,3 +305,65 @@ def test_open_loop_soak(flat_searcher):
     assert snap["n_completed"] == n
     assert snap["total_ms"]["p50"] > 0
     assert sum(snap["bucket_hist"].values()) == snap["n_batches"]
+
+
+# --------------------------------------- deadlines (fake clock + live)
+def test_batcher_prunes_expired_before_selection():
+    """select() sheds deadline-blown requests BEFORE picking a batch —
+    they never launch, and pop_expired() hands them to the engine."""
+    clock = FakeClock()
+    b = Batcher(max_batch=4, max_wait_us=10_000_000, clock=clock)
+    doomed = Request(np.zeros(DIM, np.float32), 10, Future(), 0.0,
+                     t_deadline=0.5)
+    patient = _req(t=0.0)  # no deadline: only the 10 s flush applies
+    b.put(doomed)
+    b.put(patient)
+
+    clock.t = 0.3
+    with b.locked():
+        assert b.select(clock()) is None  # nothing due, nothing expired
+    assert b.pop_expired() == []
+
+    clock.t = 0.6
+    with b.locked():
+        assert b.select(clock()) is None  # doomed pruned, patient waits
+    assert b.pop_expired() == [doomed]
+    assert len(b) == 1
+
+    clock.t = 10.1
+    with b.locked():
+        assert b.select(clock()) == [patient]  # flush deadline reached
+
+
+def test_take_wakes_at_shed_deadline_not_flush_deadline():
+    """A queued request's deadline_ms bounds how long take() sleeps: the
+    shed must fire at ~deadline, not at the (much later) flush wait."""
+    b = Batcher(max_batch=8, max_wait_us=30_000_000)
+    b.put(Request(np.zeros(DIM, np.float32), 10, Future(),
+                  time.perf_counter(),
+                  t_deadline=time.perf_counter() + 0.05))
+    t0 = time.perf_counter()
+    got = b.take(block=True)  # [] = "expired pending", wakes the engine
+    assert got == []
+    assert time.perf_counter() - t0 < 5.0
+    assert len(b.pop_expired()) == 1
+
+
+def test_search_end_to_end_deadline(flat_searcher):
+    """Engine.search(deadline_ms=...) is ONE budget across admission,
+    queueing, and device time — unlike submit(timeout=), which bounds
+    only admission (docs/serving.md). A launched-but-slow batch raises
+    the same typed DeadlineExceeded instead of blocking past it."""
+    from raft_tpu.serving import DeadlineExceeded
+    from raft_tpu.testing import faults
+
+    with _engine(flat_searcher) as eng:
+        # sanity: generous deadline -> normal rows
+        d, i = eng.search(np.zeros(DIM, np.float32), K, deadline_ms=30_000)
+        assert d.shape == (K,)
+        with faults.slow_searcher(flat_searcher, 1.0):
+            t0 = time.perf_counter()
+            with pytest.raises(DeadlineExceeded):
+                eng.search(np.zeros(DIM, np.float32), K, deadline_ms=200)
+            # returned at the deadline, not after the 1 s device stall
+            assert time.perf_counter() - t0 < 0.9

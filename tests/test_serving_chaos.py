@@ -1,0 +1,400 @@
+"""Chaos tests for the serving robustness layer (docs/serving.md
+"Overload & failure semantics").
+
+Each test injects one failure domain through the real dispatch path (the
+``raft_tpu.testing.faults`` serving injectors wrap the searcher handle's
+actual ``search`` callable) and pins an invariant the engine claims:
+
+- an injected dispatch failure fails ONLY that batch's futures, with a
+  typed :class:`BatchFailed` carrying the injected cause, and the engine
+  keeps serving;
+- an injected hang trips the circuit breaker within ``hang_timeout_s``
+  (not after the full hang), admission sheds with :class:`CircuitOpen`,
+  and a half-open probe closes the breaker (or re-opens it on failure);
+- ``swap_index`` under concurrent submitters drops zero requests and
+  every result is bit-identical to a solo search on whichever index
+  actually served it;
+- a degraded elastic restore (PR 3 ``allow_partial``) serves at reduced
+  coverage and is promoted to a full restore via ``swap_index`` once
+  ``verify_checkpoint`` reports the repaired checkpoint healthy;
+- deadline and watermark sheds are typed rejections, never silent drops,
+  and ``stop(drain=True)`` racing live submitters strands no future.
+
+Timing note: on this CPU stack a real warmed search takes ~0.2-0.5 s end
+to end, so every ``hang_timeout_s`` here keeps >= 2x headroom over that
+(a tight timeout makes the watchdog "correctly" fail healthy batches).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.core.resources import Resources
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.parallel import comms as comms_mod
+from raft_tpu.parallel import sharded
+from raft_tpu.serving.engine import solo_reference
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+
+
+@pytest.fixture()
+def searcher(flat_index):
+    # fresh handle per test: the injectors rebind .search on the handle,
+    # so sharing one across tests would leak an armed fault
+    return serving.ivf_flat_searcher(flat_index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _engine(s, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_us", 5000)
+    kw.setdefault("warm_ks", (K,))
+    return serving.Engine(s, serving.EngineConfig(**kw))
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+# ------------------------------------------------- failure containment
+def test_dispatch_failure_fails_only_that_batch(searcher):
+    rng = np.random.default_rng(0)
+    with _engine(searcher, hang_timeout_s=None) as eng:
+        d, i = eng.search(_q(rng), K)
+        assert d.shape == (K,)
+
+        faults.fail_next_dispatch(searcher)
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed) as ei:
+            victim.result(timeout=60)
+        assert isinstance(ei.value.cause, faults.InjectedFault)
+        assert ei.value.__cause__ is ei.value.cause
+        assert ei.value.hang is False
+
+        # the loop survived: subsequent requests ride fresh batches
+        futs = [eng.submit(_q(rng), K) for _ in range(12)]
+        for f in futs:
+            d, i = f.result(timeout=60)
+            assert d.shape == (K,) and i.shape == (K,)
+        eng.drain(60)
+
+        # exactly the one batch failed; ordinary errors never open the
+        # breaker (that verdict belongs to the hang watchdog alone)
+        snap = eng.stats.snapshot()
+        assert snap["n_failed"] == 1
+        assert snap["n_batch_errors"] == 1
+        assert snap["n_hangs"] == 0
+        assert eng.breaker.state == "closed"
+        assert eng.health()["status"] == "ok"
+
+
+def test_dispatch_failure_spares_concurrent_other_k_batch(searcher):
+    """Two same-instant batches (distinct k never coalesces): the armed
+    fault kills whichever launches first; every rider of the OTHER batch
+    still resolves with rows."""
+    rng = np.random.default_rng(1)
+    with _engine(searcher, hang_timeout_s=None, max_wait_us=20000) as eng:
+        faults.fail_next_dispatch(searcher)
+        a = [eng.submit(_q(rng), K) for _ in range(3)]
+        b = [eng.submit(_q(rng), K + 2) for _ in range(3)]
+        outcomes = {"failed": 0, "ok": 0}
+        for f in a + b:
+            try:
+                d, i = f.result(timeout=60)
+                assert d.shape[0] in (K, K + 2)
+                outcomes["ok"] += 1
+            except serving.BatchFailed as e:
+                assert isinstance(e.cause, faults.InjectedFault)
+                outcomes["failed"] += 1
+        # one whole batch (3 riders) failed, the other completed
+        assert outcomes == {"failed": 3, "ok": 3}
+        eng.drain(60)
+        assert eng.stats.snapshot()["n_batch_errors"] == 1
+
+
+# ----------------------------------------------- watchdog + breaker
+def test_hang_trips_breaker_then_half_open_probe_closes(searcher):
+    rng = np.random.default_rng(2)
+    with _engine(searcher, hang_timeout_s=1.0, breaker_cooldown_s=0.5,
+                 max_wait_us=0) as eng:
+        eng.search(_q(rng), K)
+        assert eng.health()["status"] == "ok"
+
+        faults.hang_next_dispatch(searcher, hang_s=3.0)
+        t0 = time.perf_counter()
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed) as ei:
+            victim.result(timeout=60)
+        elapsed = time.perf_counter() - t0
+        assert ei.value.hang is True
+        # the watchdog's verdict, not the hang's end: the 3 s sleep is
+        # still in progress when the future fails
+        assert elapsed < 2.5, f"hang verdict took {elapsed:.2f}s"
+        assert eng.breaker.state == "open"
+        assert eng.health()["status"] == "unhealthy"
+
+        with pytest.raises(serving.CircuitOpen):
+            eng.submit(np.zeros(DIM, np.float32), K)
+        snap = eng.stats.snapshot()
+        assert snap["n_hangs"] == 1
+        assert snap["n_breaker_trips"] == 1
+        assert snap["n_rejected_breaker"] == 1
+
+        # let the stuck dispatch thread drain its sleep, then probe:
+        # open -> half_open at admission, a completed batch closes it
+        time.sleep(max(0.0, t0 + 3.4 - time.perf_counter()))
+        probe = eng.submit(_q(rng), K)
+        d, i = probe.result(timeout=60)
+        assert d.shape == (K,)
+        eng.drain(60)
+        assert eng.breaker.state == "closed"
+        assert eng.health()["status"] == "ok"
+
+
+def test_half_open_probe_failure_reopens_breaker(searcher):
+    rng = np.random.default_rng(4)
+    with _engine(searcher, hang_timeout_s=0.8, breaker_cooldown_s=0.4,
+                 max_wait_us=0) as eng:
+        eng.search(_q(rng), K)
+        faults.hang_next_dispatch(searcher, hang_s=2.0)
+        t0 = time.perf_counter()
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed):
+            victim.result(timeout=60)
+        assert eng.breaker.state == "open"
+
+        # hang drained + cooldown elapsed -> next admission is the probe;
+        # arm a plain failure so the probe batch fails
+        time.sleep(max(0.0, t0 + 2.5 - time.perf_counter()))
+        faults.fail_next_dispatch(searcher)
+        probe = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed) as ei:
+            probe.result(timeout=60)
+        assert isinstance(ei.value.cause, faults.InjectedFault)
+        eng.drain(60)
+        assert eng.breaker.state == "open"  # probe verdict re-opened
+        with pytest.raises(serving.CircuitOpen):
+            eng.submit(np.zeros(DIM, np.float32), K)
+
+
+# ----------------------------------------------------------- hot swap
+def test_swap_under_concurrent_load_zero_drops_bit_identical(flat_index):
+    rng = np.random.default_rng(5)
+    db2 = rng.standard_normal((1500, DIM)).astype(np.float32)
+    index2 = ivf_flat.build(db2, ivf_flat.IndexParams(n_lists=16))
+    s1 = serving.ivf_flat_searcher(flat_index,
+                                   ivf_flat.SearchParams(n_probes=8))
+    s2 = serving.ivf_flat_searcher(index2,
+                                   ivf_flat.SearchParams(n_probes=8))
+    n_threads, n_per = 6, 8
+    results = [[] for _ in range(n_threads)]
+    errors = []
+
+    with _engine(s1) as eng:
+        def worker(ti):
+            trng = np.random.default_rng(100 + ti)
+            for _ in range(n_per):
+                q = _q(trng)
+                try:
+                    f = eng.submit(q, K)
+                    d, i = f.result(timeout=120)
+                    results[ti].append((q, d, i, f.searcher, f.placement))
+                except BaseException as e:  # noqa: B036 — any failure
+                    errors.append(e)       # breaks the zero-drop claim
+
+        threads = [threading.Thread(target=worker, args=(ti,))
+                   for ti in range(n_threads)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        old = eng.swap_index(s2)  # warm + swap while the load runs
+        assert old is s1
+        for t in threads:
+            t.join()
+
+        assert errors == []
+        flat = [row for rows in results for row in rows]
+        assert len(flat) == n_threads * n_per  # zero dropped requests
+
+        # after the swap every new request serves from the new index
+        q = _q(rng)
+        f = eng.submit(q, K)
+        f.result(timeout=120)
+        assert f.searcher is s2
+        snap = eng.stats.snapshot()
+        assert snap["n_swaps"] == 1
+        assert snap["coverage_transitions"] == [(1.0, 1.0)]
+
+    # exactness oracle: each result bit-identical to a solo search on
+    # whichever index actually served it, at the same (row, bucket)
+    for q, d, i, served_by, (row, bucket) in flat:
+        assert served_by in (s1, s2)
+        d_ref, i_ref = solo_reference(served_by, q, K, row, bucket)
+        assert np.array_equal(d, d_ref)
+        assert np.array_equal(i, i_ref)
+
+
+def test_swap_rejects_mismatched_index(searcher, flat_index):
+    rng = np.random.default_rng(6)
+    db = rng.standard_normal((300, DIM * 2)).astype(np.float32)
+    wrong = serving.ivf_flat_searcher(
+        ivf_flat.build(db, ivf_flat.IndexParams(n_lists=4)),
+        ivf_flat.SearchParams(n_probes=4))
+    with _engine(searcher) as eng:
+        with pytest.raises(ValueError, match="dim mismatch"):
+            eng.swap_index(wrong)
+        assert eng.searcher is searcher  # unchanged after the reject
+
+
+# ------------------------------------- degraded restore -> promotion
+def test_degraded_elastic_restore_promotion(tmp_path):
+    """Serve a partial restore (coverage 7/8) and promote it to the full
+    index once the repaired checkpoint verifies healthy — the PR 3
+    degraded-restore story closed end to end through the engine."""
+    n_rows, n_shards = 2048, 8
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((n_rows, DIM)).astype(np.float32)
+    comms = comms_mod.init_comms(axis="serving_chaos")
+    idx = sharded.build_ivf_flat(
+        comms, x, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=2),
+        res=Resources(seed=0))
+    prefix = str(tmp_path / "flat")
+    sharded.serialize_ivf_flat(idx, prefix)
+    assert sharded.verify_checkpoint(prefix)["ok"]
+
+    dead = 3
+    faults.delete_rank_file(prefix, dead)
+    assert not sharded.verify_checkpoint(prefix)["ok"]
+    el = sharded.deserialize_ivf_flat_elastic(prefix, allow_partial=True)
+    degraded = serving.elastic_searcher(
+        el, ivf_flat.SearchParams(n_probes=16))
+    assert degraded.coverage == (n_shards - 1) / n_shards
+
+    with _engine(degraded, max_wait_us=1000) as eng:
+        h = eng.health()
+        assert h["status"] == "degraded"
+        assert h["coverage"] == (n_shards - 1) / n_shards
+
+        d, i = eng.search(x[0], K)
+        lo, hi = dead * (n_rows // n_shards), (dead + 1) * (n_rows // n_shards)
+        assert not np.any((np.asarray(i) >= lo) & (np.asarray(i) < hi))
+
+        # repair: rewrite the checkpoint, verify, THEN promote
+        sharded.serialize_ivf_flat(idx, prefix)
+        assert sharded.verify_checkpoint(prefix)["ok"]
+        el_full = sharded.deserialize_ivf_flat_elastic(prefix)
+        full = serving.elastic_searcher(
+            el_full, ivf_flat.SearchParams(n_probes=16))
+        assert full.coverage == 1.0
+        eng.swap_index(full)
+
+        assert eng.health()["status"] == "ok"
+        snap = eng.stats.snapshot()
+        assert snap["coverage"] == 1.0
+        assert snap["coverage_transitions"] == [
+            ((n_shards - 1) / n_shards, 1.0)]
+
+        # query 0's nearest row is itself; reachable again post-promotion
+        d2, i2 = eng.search(x[0], K)
+        assert 0 in np.asarray(i2)
+
+
+# ------------------------------------------------ shedding is typed
+def test_deadline_shed_is_typed_never_silent(searcher):
+    with _engine(searcher, max_batch=64, max_wait_us=30_000_000) as eng:
+        # the flush policy alone would hold this request for 30 s
+        fut = eng.submit(np.zeros(DIM, np.float32), K, deadline_ms=60)
+        t0 = time.perf_counter()
+        with pytest.raises(serving.DeadlineExceeded):
+            fut.result(timeout=60)
+        assert time.perf_counter() - t0 < 5.0  # shed at the deadline
+        snap = eng.stats.snapshot()
+        assert snap["n_shed_deadline"] == 1
+        assert eng.health()["status"] == "ok"  # shed != sick
+        eng.stop(drain=False)
+
+
+def test_overload_watermark_shed_and_recovery(searcher):
+    with faults.slow_searcher(searcher, 0.15):
+        with _engine(searcher, max_batch=1, max_wait_us=0, max_inflight=1,
+                     queue_high_watermark=4, queue_low_watermark=1,
+                     hang_timeout_s=None) as eng:
+            futs, rejected = [], 0
+            for _ in range(12):
+                try:
+                    futs.append(eng.submit(np.zeros(DIM, np.float32), K))
+                except serving.Overloaded:
+                    rejected += 1
+            assert rejected > 0
+            assert eng.health()["status"] == "degraded"  # latched
+            assert eng.stats.snapshot()["n_rejected_overload"] == rejected
+
+            # every ADMITTED request still completes normally
+            for f in futs:
+                d, i = f.result(timeout=120)
+                assert d.shape == (K,)
+            eng.drain(120)
+
+            # drained under the low watermark -> admission unlatches
+            f = eng.submit(np.zeros(DIM, np.float32), K)
+            assert f.result(timeout=120)[0].shape == (K,)
+            assert eng.health()["status"] == "ok"
+
+
+# --------------------------------------------- stop() vs submitters
+def test_stop_drain_races_concurrent_submitters(searcher):
+    """6 threads submit in a loop while the main thread stops the
+    engine: late submits get a typed EngineStopped, every future handed
+    out resolves with rows (drain launches the whole queue), and no
+    future is left pending — the stranded-future invariant."""
+    # watermark at the queue cap: this test targets the stop race, and
+    # 6 unthrottled submitters would otherwise latch overload shedding
+    eng = _engine(searcher, queue_high_watermark=4096).start()
+    futures = []
+    lock = threading.Lock()
+    stopped_submitters = []
+
+    def worker(ti):
+        trng = np.random.default_rng(200 + ti)
+        for _ in range(1000):
+            try:
+                f = eng.submit(_q(trng), K)
+            except serving.EngineStopped:
+                stopped_submitters.append(ti)
+                return
+            with lock:
+                futures.append(f)
+            time.sleep(0.002)
+        raise AssertionError("engine never stopped")
+
+    threads = [threading.Thread(target=worker, args=(ti,))
+               for ti in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    eng.stop(drain=True)
+    for t in threads:
+        t.join()
+
+    assert len(stopped_submitters) == 6  # every late submit was typed
+    assert len(futures) > 0
+    for f in futures:
+        assert f.done()  # stop() returned -> nothing still pending
+        d, i = f.result(timeout=0)
+        assert d.shape == (K,) and i.shape == (K,)
+    assert eng.stats.snapshot()["n_completed"] == len(futures)

@@ -15,6 +15,13 @@ Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
   per-rate p50/p95/p99 queue-wait / device / total latency and achieved
   throughput — the latency-throughput curve whose knee is the per-replica
   capacity number the ROADMAP's traffic story needs.
+- ``overload``: Poisson arrivals at a MULTIPLE of capacity (default 2x)
+  against an engine with tight admission watermarks and per-request
+  deadlines — the docs/serving.md "Overload & failure semantics" story
+  measured: shed rate, goodput, and the p99 of ADMITTED requests, which
+  must stay within ~2x of the at-capacity p99 instead of diverging with
+  the queue. Every shed is a typed rejection (Overloaded / QueueFull /
+  DeadlineExceeded); an untyped wait-timeout fails the run.
 
 Artifact: SERVING_cpu.json / SERVING_tpu.json (name follows the measured
 platform unless --out is given).
@@ -158,6 +165,75 @@ def bench_open_loop(engine, queries, k, rate_qps, n_requests, rng):
     return row
 
 
+def bench_overload(engine, queries, k, rate_qps, n_requests, rng,
+                   deadline_ms=None):
+    """Open-loop Poisson at ``rate_qps`` with non-blocking admission and
+    an optional per-request deadline. Unlike :func:`bench_open_loop`,
+    arrivals past capacity are EXPECTED to shed — the contract measured
+    here is that every shed is a typed rejection, never a silent drop or
+    an untyped timeout, and that the admitted requests' latency stays
+    bounded by the admission watermarks + deadline instead of growing
+    with the backlog."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from raft_tpu import serving
+    from raft_tpu.serving.batcher import DeadlineExceeded, QueueFull
+
+    engine.stats.reset_samples()
+    shed = {"breaker": 0, "overload": 0, "queue_full": 0, "deadline": 0}
+    futs = []
+    gaps = rng.exponential(1.0 / rate_qps, n_requests)
+    t0 = time.perf_counter()
+    next_t = t0
+    for j in range(n_requests):
+        next_t += gaps[j]
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        try:
+            futs.append(engine.submit(queries[j % len(queries)], k,
+                                      block=False,
+                                      deadline_ms=deadline_ms))
+        except serving.CircuitOpen:
+            shed["breaker"] += 1
+        except serving.Overloaded:
+            shed["overload"] += 1
+        except QueueFull:
+            shed["queue_full"] += 1
+    served = 0
+    for f in futs:
+        try:
+            # generous completion bound: the engine must resolve every
+            # admitted future (served or typed-shed) long before this —
+            # hitting it means a request was neither, which is the bug
+            # the chaos suite exists to prevent
+            f.result(timeout=120)
+            served += 1
+        except DeadlineExceeded:
+            shed["deadline"] += 1
+        except FutTimeout:
+            raise AssertionError(
+                "admitted request neither served nor typed-shed within "
+                "120 s — untyped timeout, shed contract broken") from None
+    elapsed = time.perf_counter() - t0
+    snap = engine.stats.snapshot()
+    n_shed = sum(shed.values())
+    assert served + n_shed == n_requests  # no silent drops
+    row = {
+        "offered_qps": round(rate_qps, 1),
+        "n": n_requests,
+        "served": served,
+        "shed": shed,
+        "shed_rate": round(n_shed / n_requests, 4),
+        "goodput_qps": round(served / elapsed, 1),
+        "deadline_ms": deadline_ms,
+        "mean_batch_size": snap.get("mean_batch_size"),
+    }
+    if "total_ms" in snap:
+        row["admitted_total_ms"] = snap["total_ms"]
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None,
@@ -175,6 +251,15 @@ def main():
     ap.add_argument("--open-loop-fractions", type=float, nargs="*",
                     default=[0.25, 0.5, 0.75, 0.9])
     ap.add_argument("--open-loop-queries", type=int, default=200)
+    ap.add_argument("--overload-factors", type=float, nargs="*",
+                    default=[2.0, 12.0],
+                    help="overload scenario offered loads as multiples "
+                         "of measured closed-loop capacity (2x is the "
+                         "acceptance point; the deep factor pushes past "
+                         "what coalescing + max_inflight*max_batch "
+                         "in-flight slots absorb, so the watermark shed "
+                         "actually engages; empty disables)")
+    ap.add_argument("--overload-queries", type=int, default=300)
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the per-request bit-identity sweep")
     args = ap.parse_args()
@@ -255,6 +340,62 @@ def main():
                       flush=True)
         finally:
             engine.stop()
+
+        if args.overload_factors and "closed_loop" in row:
+            # fresh engine with the shedding knobs engaged: the high
+            # watermark admits ONE full batch of backlog, so an admitted
+            # request waits at most ~one batch-time behind the one in
+            # flight — queue latency stays bounded by design, not luck.
+            # (The serving default of 16*max_batch is for engines sized
+            # well below capacity; max_batch-64 coalescing absorbs many
+            # multiples of the closed-loop rate before a deep queue
+            # would even move, as the factor sweep below shows.)
+            overload_cfg = serving.EngineConfig(
+                max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+                max_inflight=args.max_inflight, warm_ks=(args.k,),
+                queue_limit=max(4 * args.max_batch, 64),
+                queue_high_watermark=args.max_batch)
+            ov_engine = serving.Engine(searcher, overload_cfg)
+            ov_engine.start()
+            try:
+                cap = row["closed_loop"]["qps"]
+                at_cap = bench_overload(ov_engine, queries, args.k, cap,
+                                        args.overload_queries, rng)
+                p99_cap = at_cap.get("admitted_total_ms", {}).get("p99")
+                deadline_ms = (round(1.5 * p99_cap, 1) if p99_cap
+                               else None)
+                row["overload"] = {
+                    "capacity_qps": cap,
+                    "queue_high_watermark":
+                        overload_cfg.queue_high_watermark,
+                    "queue_limit": overload_cfg.queue_limit,
+                    "deadline_ms": deadline_ms,
+                    "at_capacity": at_cap,
+                    "runs": [],
+                }
+                for factor in args.overload_factors:
+                    over = bench_overload(
+                        ov_engine, queries, args.k, factor * cap,
+                        args.overload_queries, rng,
+                        deadline_ms=deadline_ms)
+                    p99_over = over.get("admitted_total_ms", {}).get(
+                        "p99")
+                    # the load-shedding claim: the p99 an ADMITTED
+                    # request sees stays bounded as offered load grows —
+                    # overload turns into shed rate, not tail latency
+                    over["factor"] = factor
+                    over["admitted_p99_ratio_vs_capacity"] = (
+                        round(p99_over / p99_cap, 2)
+                        if p99_cap and p99_over else None)
+                    row["overload"]["runs"].append(over)
+                    print(f"  overload @{factor}x: "
+                          f"shed_rate={over['shed_rate']}, "
+                          f"goodput={over['goodput_qps']} qps, "
+                          f"admitted p99 {p99_over} ms "
+                          f"({over['admitted_p99_ratio_vs_capacity']}x "
+                          f"of at-capacity {p99_cap} ms)", flush=True)
+            finally:
+                ov_engine.stop()
         art["families"][family] = row
 
     art["when"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
