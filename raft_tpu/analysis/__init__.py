@@ -2,10 +2,11 @@
 
 Two tiers:
 
-* **Tier A** (pure AST, no JAX import): rules R001–R005 over every
+* **Tier A** (pure AST, no JAX import): rules R001–R006 over every
   ``raft_tpu``/``tools``/``tests`` module — host-sync in jit-reachable
   code, Python control flow on traced values, recompilation hazards,
-  cross-package private imports, unguarded broadcasts.
+  cross-package private imports, unguarded broadcasts, untraced
+  search/build entry points.
 * **Tier B** (``--jaxpr-audit``): abstract-evals the public search/build
   entrypoints at canonical shapes (no device memory is allocated), walks
   the closed jaxpr for a peak-live-set upper bound and fails when an
@@ -82,7 +83,7 @@ def collect_modules(root: str,
 def run_tier_a(root: str,
                dirs: Iterable[str] = DEFAULT_SCAN_DIRS,
                rules: Optional[Iterable] = None) -> List[Finding]:
-    """Run every Tier-A rule (R001–R005) over the tree at ``root``."""
+    """Run every Tier-A rule (R001–R006) over the tree at ``root``."""
     modules, findings = collect_modules(root, dirs)
     for mod in modules:
         for rule in (rules if rules is not None else AST_RULES):

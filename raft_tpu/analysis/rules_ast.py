@@ -1,4 +1,4 @@
-"""Tier-A rules R001/R002/R003/R005 — pure-AST, no JAX import.
+"""Tier-A rules R001/R002/R003/R005/R006 — pure-AST, no JAX import.
 
 Each rule is a function ``(ModuleInfo) -> list[Finding]``. Precision over
 recall: every pattern here is one that has actually burned a TPU window
@@ -380,6 +380,51 @@ def rule_unguarded_broadcast(mod: ModuleInfo) -> list:
     return out
 
 
+# ----------------------------------------------------------------- R006
+#: module-level entry-point names that must run under a tracing scope
+TRACED_ENTRY_NAMES = frozenset({"search", "build", "knn"})
+#: decorators that satisfy R006 — each enters jax.named_scope (and, for
+#: ``range``, a profiler TraceAnnotation) so xprof rows carry the
+#: algorithm name
+TRACING_DECORATORS = frozenset({
+    "raft_tpu.core.tracing.range", "raft_tpu.core.tracing.annotate",
+})
+
+
+def rule_untraced_entry_point(mod: ModuleInfo) -> list:
+    """R006: public search/build entry point without a tracing scope.
+
+    Every module-level ``search``/``build``/``knn`` in a
+    ``raft_tpu.neighbors`` submodule must be decorated with
+    ``core.tracing.range`` (or ``annotate``): the span → xprof
+    correlation in docs/observability.md relies on those scopes to
+    attribute device time to an algorithm, and an undecorated entry
+    point is invisible in every profile.
+    """
+    if not mod.modname.startswith("raft_tpu.neighbors."):
+        return []
+    out = []
+    for qual, info in sorted(mod.functions.items()):
+        if (info.parent is not None or "." in qual
+                or info.name not in TRACED_ENTRY_NAMES
+                or info.name.startswith("_")):
+            continue
+        decorated = False
+        for dec in info.node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if mod.resolve(target) in TRACING_DECORATORS:
+                decorated = True
+                break
+        if decorated or mod.suppressed(info.lineno, "R006"):
+            continue
+        out.append(Finding(
+            "R006", mod.relfile, qual, info.lineno,
+            f"public entry point {info.name}() lacks a tracing scope; "
+            "decorate with @tracing.range(...) so profiles attribute "
+            "device time to the algorithm"))
+    return out
+
+
 def _enclosing_qualname(mod: ModuleInfo, node) -> str:
     """Innermost function whose span contains ``node`` (by line)."""
     best, best_span = "<module>", None
@@ -393,4 +438,4 @@ def _enclosing_qualname(mod: ModuleInfo, node) -> str:
 
 
 AST_RULES = (rule_host_sync, rule_traced_branch, rule_recompile_hazard,
-             rule_unguarded_broadcast)
+             rule_unguarded_broadcast, rule_untraced_entry_point)

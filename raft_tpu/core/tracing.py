@@ -5,9 +5,16 @@ Reference: NVTX RAII ranges at every nontrivial entry point
 CMake flag, cpp/CMakeLists.txt:262-263) consumed by Nsight.
 
 TPU-native design: ``jax.named_scope`` tags the HLO so ranges appear in
-XLA/xprof traces; ``jax.profiler`` start/stop covers the Nsight role.
+XLA/xprof traces, and ``jax.profiler.TraceAnnotation`` marks the host
+timeline so the Python-side interval (queue wait, pad/copy) lines up
+with the device stream in the same capture. ``jax.profiler`` start/stop
+covers the Nsight role (see also :func:`raft_tpu.obs.profile_session`,
+which adds session accounting on the metrics registry).
+
 ``range`` works as both a context manager and a decorator, like the
-reference's RAII type + RAFT_NVTX_FUNC_RANGE macro."""
+reference's RAII type + RAFT_NVTX_FUNC_RANGE macro. graftcheck rule
+R006 requires it on every public neighbors ``search``/``build``/``knn``
+entry point (docs/analysis.md)."""
 
 from __future__ import annotations
 
@@ -21,27 +28,33 @@ import jax
 class range:  # noqa: A001 — mirrors nvtx::range naming
     """Named trace scope (context manager or decorator).
 
-    Analog of ``common::nvtx::range`` (core/nvtx.hpp:25-91): inside jit the
-    scope names the emitted HLO ops (visible in xprof); outside jit it
-    annotates the host timeline via TraceAnnotation."""
+    Analog of ``common::nvtx::range`` (core/nvtx.hpp:25-91): inside jit
+    the scope names the emitted HLO ops (visible in xprof); the
+    TraceAnnotation marks the wall-clock interval on the host timeline.
+    Exceptions propagate unchanged; one instance nests and re-enters
+    safely (each ``__enter__`` pushes its own scope pair)."""
 
     def __init__(self, name: str):
         self.name = name
-        self._scope = None
+        self._stack = []
+
+    def _scopes(self) -> contextlib.ExitStack:
+        stack = contextlib.ExitStack()
+        stack.enter_context(jax.named_scope(self.name))
+        stack.enter_context(jax.profiler.TraceAnnotation(self.name))
+        return stack
 
     def __enter__(self):
-        self._scope = jax.named_scope(self.name)
-        self._scope.__enter__()
+        self._stack.append(self._scopes())
         return self
 
     def __exit__(self, *exc):
-        scope, self._scope = self._scope, None
-        return scope.__exit__(*exc)
+        return self._stack.pop().__exit__(*exc)
 
     def __call__(self, fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with jax.named_scope(self.name):
+            with self._scopes():
                 return fn(*args, **kwargs)
 
         return wrapper

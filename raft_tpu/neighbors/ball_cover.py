@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -68,6 +69,7 @@ def _rooted_dist(q, pts, metric: DistanceType):
     return l2_expanded(q, pts, sqrt=True)
 
 
+@tracing.range("ball_cover.build")
 def build(
     dataset,
     metric="euclidean",
@@ -166,6 +168,7 @@ def _finalize(out_d, out_i, k: int, metric: DistanceType):
     return out_d, out_i
 
 
+@tracing.range("ball_cover.knn")
 def knn(
     index: BallCoverIndex,
     queries,
@@ -241,6 +244,7 @@ def _eps_nn_jit(queries, list_data, list_valid, list_indices, eps,
     return adj, jnp.sum(adj, axis=1).astype(jnp.int32)
 
 
+@tracing.range("ball_cover.eps_nn")
 def eps_nn(index: BallCoverIndex, queries, eps: float,
            res: Optional[Resources] = None) -> Tuple[jax.Array, jax.Array]:
     """All neighbors within ``eps`` (reference: ball_cover::eps_nn,

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -63,6 +64,7 @@ class Index:
         return self.dataset.shape[1]
 
 
+@tracing.range("brute_force.build")
 def build(dataset, metric="euclidean", metric_arg: float = 2.0,
           res: Optional[Resources] = None) -> Index:
     """Build = store dataset + precompute norms for expanded metrics
@@ -248,6 +250,7 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
 knn_core = _knn_jit
 
 
+@tracing.range("brute_force.search")
 def search(index: Index, queries, k: int, filter=None,
            res: Optional[Resources] = None, scan_dtype=None,
            refine_ratio: float = 4.0,
@@ -310,6 +313,7 @@ def search(index: Index, queries, k: int, filter=None,
     return v[:nq], i[:nq]
 
 
+@tracing.range("brute_force.knn")
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
         res: Optional[Resources] = None, scan_dtype=None,
         refine_ratio: float = 4.0,

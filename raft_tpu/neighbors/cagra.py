@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -231,6 +232,7 @@ def _augment_reverse_jit(pruned, rev):
     return out
 
 
+@tracing.range("cagra.optimize")
 def optimize(knn_graph, graph_degree: int,
              res: Optional[Resources] = None) -> jax.Array:
     """Prune an intermediate kNN graph to ``graph_degree`` (reference:
@@ -256,6 +258,7 @@ def optimize(knn_graph, graph_degree: int,
 # --------------------------------------------------------------------- build
 
 
+@tracing.range("cagra.build")
 def build(
     dataset,
     params: Optional[IndexParams] = None,
@@ -501,6 +504,7 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
 search_core = _search_jit
 
 
+@tracing.range("cagra.search")
 def search(
     index: Index,
     queries,

@@ -15,10 +15,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import pairwise_distance
 
 
+@tracing.range("epsilon_neighborhood.eps_neighbors")
 def eps_neighbors(
     x,
     y,

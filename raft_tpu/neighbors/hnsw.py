@@ -19,6 +19,7 @@ from typing import Tuple
 import numpy as np
 
 from raft_tpu import native
+from raft_tpu.core import tracing
 from raft_tpu.ops.distance import DistanceType
 
 
@@ -66,6 +67,7 @@ def load(path: str) -> Index:
     return Index(data, graph)
 
 
+@tracing.range("hnsw.search")
 def search(
     index: Index,
     queries,

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import tracing
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.bitset import filter_mask as bitset_filter_mask
 from raft_tpu.core.resources import Resources, ensure_resources
@@ -187,6 +188,7 @@ def _pack_lists(dataset: np.ndarray, labels: np.ndarray, n_lists: int,
     return data, idxs, sizes, over_rows, over_ids
 
 
+@tracing.range("ivf_flat.build")
 def build(
     dataset,
     params: Optional[IndexParams] = None,
@@ -216,6 +218,7 @@ def build(
     return index
 
 
+@tracing.range("ivf_flat.extend")
 def extend(index: Index, new_vectors, new_indices=None,
            res: Optional[Resources] = None) -> Index:
     """Add vectors (reference: ivf_flat::extend, ivf_flat-inl.cuh:195;
@@ -547,6 +550,7 @@ def plan_scan_tiles(n_probes: int, list_pad: int, dim: int,
     return q_tile
 
 
+@tracing.range("ivf_flat.search")
 def search(
     index: Index,
     queries,

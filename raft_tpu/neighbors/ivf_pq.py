@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import serialize as ser
+from raft_tpu.core import tracing
 from raft_tpu.core.bitset import Bitset
 from raft_tpu.core.bitset import filter_mask as bitset_filter_mask
 from raft_tpu.core.resources import (Resources, ensure_resources,
@@ -576,6 +577,7 @@ def _group_rows_jit(rows, labels, n_lists: int, cap: int):
 # --------------------------------------------------------------------- build
 
 
+@tracing.range("ivf_pq.build")
 def build(
     dataset,
     params: Optional[IndexParams] = None,
@@ -669,6 +671,7 @@ def encode_batch(index: Index, vectors, labels,
     return _pack_codes_jit(codes, index.pq_dim, index.pq_bits)
 
 
+@tracing.range("ivf_pq.extend")
 def extend(index: Index, new_vectors, new_indices=None,
            res: Optional[Resources] = None) -> Index:
     """Encode + add vectors (reference: ivf_pq::extend, ivf_pq-inl.cuh:355 →
@@ -1246,6 +1249,7 @@ def resolve_scan_mode(n_lists: int, list_pad: int, rot_dim: int,
     return "cache" if packed_bytes + cache_bytes <= budget else "lut"
 
 
+@tracing.range("ivf_pq.search")
 def search(
     index: Index,
     queries,

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -246,6 +247,7 @@ class Index:
         self.metric = metric
 
 
+@tracing.range("nn_descent.build")
 def build(
     dataset,
     params: Optional[IndexParams] = None,

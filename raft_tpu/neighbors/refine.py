@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -75,6 +76,7 @@ def _refine_jit(dataset, queries, candidates, metric: DistanceType, k: int,
     return vals[:nq], idxs[:nq]
 
 
+@tracing.range("refine.refine")
 def refine(
     dataset,
     queries,

@@ -1,0 +1,40 @@
+"""raft_tpu.obs — unified telemetry: metrics, trace spans, exposition.
+
+The TPU-native analog of RAFT's NVTX-everywhere convention, split into
+four pieces (docs/observability.md):
+
+- :mod:`~raft_tpu.obs.metrics` — lock-cheap Counter/Gauge/Histogram
+  registry with Prometheus text + JSON exposition (stdlib-only);
+- :mod:`~raft_tpu.obs.spans` — per-request trace span records and
+  pluggable JSONL/in-memory sinks (stdlib-only);
+- :mod:`~raft_tpu.obs.device` — jax.monitoring compile counters and
+  ``profile_session()`` (imports jax lazily);
+- :mod:`~raft_tpu.obs.httpd` — the ``/metrics`` + ``/healthz`` server
+  an Engine exposes.
+
+Layering: obs sits beside ``core`` — serving/parallel/neighbors import
+obs, never the reverse.
+"""
+
+from raft_tpu.obs.device import (compile_count, compile_seconds,
+                                 install_compile_metrics, profile_session)
+from raft_tpu.obs.httpd import MetricsServer
+from raft_tpu.obs.metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter,
+                                  Gauge, Histogram, HistogramSnapshot,
+                                  Registry, exponential_buckets)
+from raft_tpu.obs.spans import (JsonlSink, ListSink, NullSink, new_trace_id,
+                                read_jsonl, safe_emit, timed_span)
+
+__all__ = [
+    # metrics
+    "Counter", "Gauge", "Histogram", "HistogramSnapshot", "Registry",
+    "REGISTRY", "DEFAULT_LATENCY_BUCKETS", "exponential_buckets",
+    # spans
+    "JsonlSink", "ListSink", "NullSink", "new_trace_id", "read_jsonl",
+    "safe_emit", "timed_span",
+    # device
+    "compile_count", "compile_seconds", "install_compile_metrics",
+    "profile_session",
+    # exposition
+    "MetricsServer",
+]

@@ -1,0 +1,106 @@
+"""Device-side attribution: compile counters and profiler sessions.
+
+Replaces the serving engine's one-off ``jax.monitoring`` listener with
+registry-backed counters, and wraps ``jax.profiler`` start/stop in
+:func:`profile_session` so xprof captures are themselves observable
+(how many sessions ran, whether one is live now).
+
+``jax`` is imported lazily inside the functions — the rest of
+:mod:`raft_tpu.obs` stays stdlib-only, so the metrics registry and span
+sinks are importable in tooling that never touches a device.
+
+Families (all on the default registry — jax.monitoring events are
+process-global, so a per-engine registry would be a lie):
+
+- ``raft_tpu_xla_compile_total`` — XLA backend compile events. The
+  serving warmup invariant ("the first submit after ``start()`` compiles
+  nothing", docs/serving.md) is asserted as a zero delta on this.
+- ``raft_tpu_xla_compile_seconds_total`` — cumulative compile seconds.
+- ``raft_tpu_profile_sessions_total`` / ``raft_tpu_profile_active`` —
+  profiler start/stop accounting around :func:`profile_session`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+__all__ = ["install_compile_metrics", "compile_count", "compile_seconds",
+           "profile_session"]
+
+_install_lock = threading.Lock()
+_installed = False
+
+_COMPILES = _metrics.REGISTRY.counter(
+    "raft_tpu_xla_compile_total",
+    "XLA backend compile events (jax.monitoring duration events matching "
+    "'backend_compile'). A nonzero delta across a serving request means "
+    "a shape escaped warmup.")
+_COMPILE_SECONDS = _metrics.REGISTRY.counter(
+    "raft_tpu_xla_compile_seconds_total",
+    "Cumulative seconds spent in XLA backend compiles.")
+_PROFILE_SESSIONS = _metrics.REGISTRY.counter(
+    "raft_tpu_profile_sessions_total",
+    "jax.profiler capture sessions opened via obs.profile_session().")
+_PROFILE_ACTIVE = _metrics.REGISTRY.gauge(
+    "raft_tpu_profile_active",
+    "1 while an obs.profile_session() capture is running.")
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    if "backend_compile" in event:
+        _COMPILES.inc()
+        _COMPILE_SECONDS.inc(max(float(duration), 0.0))
+
+
+def install_compile_metrics() -> None:
+    """Register the jax.monitoring compile listener once (idempotent,
+    thread-safe). Events before the first call are not counted — callers
+    comparing deltas must install before the baseline read, which
+    :func:`compile_count` does implicitly."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Process-wide count of XLA backend compiles observed since the
+    first call. Monotonic; compare deltas, not absolutes. (Kept as the
+    serving layer's historical API; re-exported from raft_tpu.serving.)"""
+    install_compile_metrics()
+    return int(_COMPILES.value)
+
+
+def compile_seconds() -> float:
+    """Cumulative seconds spent compiling since the first call."""
+    install_compile_metrics()
+    return float(_COMPILE_SECONDS.value)
+
+
+@contextlib.contextmanager
+def profile_session(log_dir: str = "/tmp/raft_tpu_trace",
+                    host_tracer_level: int = 2,
+                    ) -> Iterator[str]:
+    """xprof capture with session accounting: wraps
+    :func:`raft_tpu.core.tracing.profile` and ticks the session
+    counter/active gauge so a scrape shows whether a capture is live.
+    Yields the log dir; open it with xprof/TensorBoard and correlate via
+    the ``tracing.range`` names (docs/observability.md)."""
+    from raft_tpu.core import tracing
+
+    install_compile_metrics()
+    _PROFILE_SESSIONS.inc()
+    _PROFILE_ACTIVE.inc()
+    try:
+        with tracing.profile(log_dir, host_tracer_level) as d:
+            yield d
+    finally:
+        _PROFILE_ACTIVE.dec()

@@ -1,0 +1,144 @@
+"""Tiny stdlib HTTP exposition server: ``/metrics`` + ``/healthz``.
+
+One ``ThreadingHTTPServer`` on a daemon thread per :class:`MetricsServer`
+— no framework, no dependency, good enough for a scraper hitting it a
+few times a minute. The serving :class:`~raft_tpu.serving.engine.Engine`
+owns one when ``EngineConfig.metrics_port`` is set (or via
+``Engine.serve_metrics()``); anything else with a registry and an
+optional health callable can run one too.
+
+Routes:
+
+- ``GET /metrics``  → Prometheus text exposition (0.0.4), 200.
+- ``GET /metrics.json`` → the registry's JSON dump, 200.
+- ``GET /healthz``  → JSON health doc; 200 for ``ok``/``degraded``
+  (alive but shedding is still alive), 503 for anything else — the
+  TPU_RUNBOOK pre-flight curls this before pointing traffic at a host.
+- anything else → 404.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from raft_tpu.obs import metrics as _metrics
+
+__all__ = ["MetricsServer"]
+
+_OK_STATUSES = ("ok", "degraded")
+
+
+class MetricsServer:
+    """Serve ``registry`` (default: the global one) on ``host:port``.
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    ``start()``. ``health_fn`` returns the health doc — typically
+    ``Engine.health`` — and its ``"status"`` picks the HTTP code."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Optional[_metrics.Registry] = None,
+                 health_fn: Optional[Callable[[], dict]] = None) -> None:
+        self._registry = registry if registry is not None else \
+            _metrics.REGISTRY
+        self._health_fn = health_fn
+        self._requested = (host, int(port))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # exposed after start()
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            raise RuntimeError("MetricsServer not started")
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._requested[0]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # stay quiet
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        text = server._registry.to_prometheus_text()
+                        self._send(200,
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8", text.encode())
+                    elif path == "/metrics.json":
+                        doc = server._registry.to_json()
+                        self._send(200, "application/json",
+                                   json.dumps(doc, sort_keys=True).encode())
+                    elif path == "/healthz":
+                        self._do_healthz()
+                    else:
+                        self._send(404, "text/plain", b"not found\n")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    try:
+                        self._send(500, "text/plain",
+                                   f"{type(e).__name__}: {e}\n".encode())
+                    except Exception:
+                        pass
+
+            def _do_healthz(self):
+                if server._health_fn is None:
+                    doc, code = {"status": "ok"}, 200
+                else:
+                    try:
+                        doc = dict(server._health_fn())
+                        code = 200 if doc.get("status") in _OK_STATUSES \
+                            else 503
+                    except Exception as e:
+                        doc = {"status": "error",
+                               "error": f"{type(e).__name__}: {e}"}
+                        code = 503
+                self._send(code, "application/json",
+                           (json.dumps(doc, sort_keys=True, default=str)
+                            + "\n").encode())
+
+        host, port = self._requested
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="raft-tpu-metrics-httpd", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -42,8 +42,40 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from raft_tpu.core import logger
+from raft_tpu.obs import metrics as obs_metrics
+
 _MAGIC = 0x52465450  # "RFTP"
 _HDR = struct.Struct("<iiiQ")
+
+# fabric counters (docs/observability.md), labeled by the REMOTE rank:
+# `peer` is the destination for send-side families, the source for
+# receive-side ones — so one scrape shows which link is sick
+_SENT_MSGS = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_messages_sent_total",
+    "Frames delivered to a peer (after any retries).", ("peer",))
+_SENT_BYTES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_bytes_sent_total",
+    "Wire bytes sent (header + type byte + payload).", ("peer",))
+_RECV_MSGS = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_messages_received_total",
+    "Frames received from a peer.", ("peer",))
+_RECV_BYTES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_bytes_received_total",
+    "Wire bytes received (header + type byte + payload).", ("peer",))
+_SEND_RETRIES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_send_retries_total",
+    "Send attempts that failed and were retried with backoff.", ("peer",))
+_BACKOFF_SECONDS = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_backoff_seconds_total",
+    "Cumulative seconds slept in send retry backoff.", ("peer",))
+_STREAMS_POISONED = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_streams_poisoned_total",
+    "Send streams poisoned after exhausting retries.", ("peer",))
+_PEER_DEATHS = obs_metrics.REGISTRY.counter(
+    "raft_tpu_p2p_peer_deaths_total",
+    "Peer-death verdicts (grace timer expiry or mark_peer_dead).",
+    ("peer",))
 
 
 class _EndpointClosed(ConnectionError):
@@ -242,6 +274,8 @@ class HostP2P:
                     last_src = src
                     ty = _read_exact(conn, 1)
                     raw = _read_exact(conn, nbytes)
+                    _RECV_MSGS.labels(src).inc()
+                    _RECV_BYTES.labels(src).inc(_HDR.size + 1 + nbytes)
                     self._deliver(src, tag, _decode(ty, raw))
         except (ConnectionError, OSError):
             abnormal = True
@@ -286,6 +320,11 @@ class HostP2P:
                 f"peer rank {src} presumed dead: connection dropped "
                 f"mid-frame and nothing arrived within "
                 f"peer_grace={self.peer_grace}s"))
+        _PEER_DEATHS.labels(src).inc()
+        logger.warn(
+            "host_p2p rank %d: peer rank %d presumed dead (dropped "
+            "mid-frame, nothing delivered within peer_grace=%.1fs)",
+            self.rank, src, self.peer_grace)
 
     def mark_peer_dead(self, src: int,
                        error: Optional[BaseException] = None) -> None:
@@ -295,6 +334,9 @@ class HostP2P:
         with self._match_lock:
             self._fail_src_locked(src, error or ConnectionError(
                 f"peer rank {src} marked dead"))
+        _PEER_DEATHS.labels(src).inc()
+        logger.warn("host_p2p rank %d: peer rank %d marked dead (%s)",
+                    self.rank, src, error or "external failure detector")
 
     def _fail_src_locked(self, src: int, error: BaseException) -> None:
         for key in [k for k in self._waiting if k[0] == src]:
@@ -478,6 +520,8 @@ class HostP2P:
                     f"failure: {poison!r}"))
                 continue
             attempt = 0
+            slept_s = 0.0  # cumulative backoff this frame (logged below)
+            nbytes = _HDR.size + 1 + len(raw)
             while True:
                 try:
                     if sock is None:
@@ -488,6 +532,8 @@ class HostP2P:
                     sock.sendall(ty)
                     sock.sendall(raw)
                     req._finish()
+                    _SENT_MSGS.labels(dest).inc()
+                    _SENT_BYTES.labels(dest).inc(nbytes)
                     break
                 except _EndpointClosed as e:  # closed endpoint: terminal
                     req._finish(error=e)
@@ -502,9 +548,25 @@ class HostP2P:
                     if attempt > self.retries or self._closed.is_set():
                         req._finish(error=e)
                         poison = e
+                        _STREAMS_POISONED.labels(dest).inc()
+                        logger.error(
+                            "host_p2p rank %d: send to rank %d failed "
+                            "after %d attempt(s), %.3f s cumulative "
+                            "backoff; stream poisoned: %r",
+                            self.rank, dest, attempt, slept_s, e)
                         break
+                    delay = self._retry_delay(attempt)
+                    slept_s += delay
+                    _SEND_RETRIES.labels(dest).inc()
+                    _BACKOFF_SECONDS.labels(dest).inc(delay)
+                    logger.warn(
+                        "host_p2p rank %d: send to rank %d failed "
+                        "(attempt %d/%d): %r; backing off %.3f s "
+                        "(%.3f s cumulative)",
+                        self.rank, dest, attempt, self.retries, e,
+                        delay, slept_s)
                     # backoff observes _closed so close() stays bounded
-                    if self._closed.wait(self._retry_delay(attempt)):
+                    if self._closed.wait(delay):
                         req._finish(error=e)
                         poison = e
                         break

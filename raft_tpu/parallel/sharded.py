@@ -28,11 +28,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.ops.distance import DistanceType, resolve_metric, pairwise_core
 from raft_tpu.ops.select_k import refine_multiplier, select_k
 from raft_tpu.parallel.comms import Comms
 from raft_tpu.utils.shape import cdiv
+
+# MNMG observability (docs/observability.md): entry-point call counters
+# plus checkpoint verify/restore outcomes — the numbers the runbook's
+# pre-flight reads off /metrics after a restore drill
+_SHARDED_SEARCHES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_sharded_search_total",
+    "Sharded search/knn entry-point calls by family.", ("family",))
+_CKPT_VERIFY = obs_metrics.REGISTRY.counter(
+    "raft_tpu_checkpoint_verify_total",
+    "verify_checkpoint runs by overall result.", ("result",))
+_CKPT_FILES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_checkpoint_file_status_total",
+    "Rank-file statuses observed by verify_checkpoint.", ("status",))
+_CKPT_RESTORES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_checkpoint_restore_total",
+    "Sharded checkpoint restores by kind and coverage mode.",
+    ("kind", "mode"))
 
 
 # ------------------------------------------------- shard build orchestration
@@ -222,6 +241,7 @@ def _stack_sharded(comms: Comms, parts: dict, fill=0):
 # ----------------------------------------------------------- sharded knn
 
 
+@tracing.range("sharded.knn")
 def knn(
     comms: Comms,
     queries,
@@ -237,6 +257,7 @@ def knn(
     placed with row sharding here. Returns replicated (distances, indices)
     with global row ids.
     """
+    _SHARDED_SEARCHES.labels("brute_force").inc()
     ensure_resources(res)
     m = resolve_metric(metric)
     minimize = m != DistanceType.InnerProduct
@@ -277,6 +298,7 @@ def knn(
 # ---------------------------------------------- sharded pairwise distance
 
 
+@tracing.range("sharded.pairwise_distance")
 def pairwise_distance(
     comms: Comms,
     x,
@@ -341,6 +363,7 @@ def pairwise_distance(
 # ------------------------------------------------------- sharded k-means
 
 
+@tracing.range("sharded.kmeans_fit")
 def kmeans_fit(
     comms: Comms,
     x,
@@ -425,6 +448,7 @@ class ShardedCagra:
         return self._datasets_bf16
 
 
+@tracing.range("sharded.build_cagra")
 def build_cagra(
     comms: Comms,
     dataset,
@@ -459,6 +483,7 @@ def build_cagra(
         params.metric, n, bounds)
 
 
+@tracing.range("sharded.search_cagra")
 def search_cagra(
     index: ShardedCagra,
     queries,
@@ -471,6 +496,7 @@ def search_cagra(
     over ICI."""
     from raft_tpu.neighbors import cagra
 
+    _SHARDED_SEARCHES.labels("cagra").inc()
     ensure_resources(res)
     params = params or cagra.SearchParams()
     comms = index.comms
@@ -564,6 +590,7 @@ class ShardedIvfFlat:
         self.coverage = 1.0
 
 
+@tracing.range("sharded.build_ivf_flat")
 def build_ivf_flat(
     comms: Comms,
     dataset,
@@ -607,6 +634,7 @@ def _globalize_overflow_ids(idx, lo: int) -> np.ndarray:
     return np.where(over >= 0, over + lo, -1).astype(np.int32)
 
 
+@tracing.range("sharded.build_ivf_flat_from_file")
 def build_ivf_flat_from_file(
     comms: Comms,
     path: str,
@@ -731,6 +759,7 @@ class ShardedIvfPq:
         self.coverage = 1.0
 
 
+@tracing.range("sharded.build_ivf_pq")
 def build_ivf_pq(
     comms: Comms,
     dataset,
@@ -780,6 +809,7 @@ def build_ivf_pq(
                                     scan_cache_dtype=scan_cache_dtype)
 
 
+@tracing.range("sharded.build_ivf_pq_from_file")
 def build_ivf_pq_from_file(
     comms: Comms,
     path: str,
@@ -917,6 +947,7 @@ def _pq_tiles(mode: str, n_probes: int, res: Resources, list_decoded,
         res.workspace_limit_bytes, lut_itemsize, dist_itemsize)
 
 
+@tracing.range("sharded.search_ivf_pq")
 def search_ivf_pq(
     index: ShardedIvfPq,
     queries,
@@ -930,6 +961,7 @@ def search_ivf_pq(
     over ICI (knn_merge_parts across ranks)."""
     from raft_tpu.neighbors import ivf_pq
 
+    _SHARDED_SEARCHES.labels("ivf_pq").inc()
     res = ensure_resources(res)
     params = params or ivf_pq.SearchParams()
     comms = index.comms
@@ -1016,6 +1048,7 @@ def search_ivf_pq(
                        index.list_sizes, *over_ops)
 
 
+@tracing.range("sharded.search_ivf_flat")
 def search_ivf_flat(
     index: ShardedIvfFlat,
     queries,
@@ -1028,6 +1061,7 @@ def search_ivf_flat(
     all_gather + top-k merges the per-shard candidates over ICI."""
     from raft_tpu.neighbors import ivf_flat
 
+    _SHARDED_SEARCHES.labels("ivf_flat").inc()
     res = ensure_resources(res)
     params = params or ivf_flat.SearchParams()
     comms = index.comms
@@ -1277,9 +1311,12 @@ def verify_checkpoint(prefix: str) -> dict:
         healthy_ranks.update(entry["ranks"])
     size = int(manifest["size"])
     missing_ranks = sorted(set(range(size)) - healthy_ranks)
+    for s in statuses.values():
+        _CKPT_FILES.labels(s).inc()
+    ok = not missing_ranks and all(s == "ok" for s in statuses.values())
+    _CKPT_VERIFY.labels("ok" if ok else "unhealthy").inc()
     return {
-        "ok": not missing_ranks and all(
-            s == "ok" for s in statuses.values()),
+        "ok": ok,
         "kind": manifest["kind"],
         "size": size,
         "files": statuses,
@@ -1451,6 +1488,7 @@ def deserialize_ivf_pq(prefix: str, comms: Comms) -> ShardedIvfPq:
         raise ValueError(
             f"index was sharded over {size} devices, comms has {comms.size}")
     _check_rank_coverage(seen, int(size), prefix)
+    _CKPT_RESTORES.labels("ivf_pq", "strict").inc()
     arrs = [(_stack_sharded(comms, p) if p is not None else None)
             for p in parts]
     (centers, rotation, list_indices, list_sizes, list_decoded,
@@ -1703,6 +1741,8 @@ def deserialize_ivf_pq_elastic(prefix: str,
     coverage = (1.0 if len(survivors) == size
                 else _elastic_coverage(parts[2], parts[10], survivors,
                                        n_rows))
+    _CKPT_RESTORES.labels(
+        "ivf_pq", "full" if coverage >= 1.0 else "degraded").inc()
     (centers, rotation, list_indices, list_sizes, list_decoded,
      decoded_norms, codebooks, list_codes, overflow_decoded,
      overflow_norms, overflow_indices) = _stack_survivors(parts, survivors)
@@ -1734,6 +1774,7 @@ def deserialize_ivf_flat(prefix: str, comms: Comms) -> ShardedIvfFlat:
         raise ValueError(
             f"index was sharded over {size} devices, comms has {comms.size}")
     _check_rank_coverage(seen, int(size), prefix)
+    _CKPT_RESTORES.labels("ivf_flat", "strict").inc()
     arrs = [(_stack_sharded(comms, p) if p is not None else None)
             for p in parts]
     centers, list_data, list_indices, list_sizes, o_data, o_ids = arrs
@@ -1839,6 +1880,8 @@ def deserialize_ivf_flat_elastic(prefix: str, allow_partial: bool = False
     coverage = (1.0 if len(survivors) == size
                 else _elastic_coverage(parts[2], parts[5], survivors,
                                        n_rows))
+    _CKPT_RESTORES.labels(
+        "ivf_flat", "full" if coverage >= 1.0 else "degraded").inc()
     (centers, list_data, list_indices, list_sizes, o_data,
      o_ids) = _stack_survivors(parts, survivors)
     return ElasticIvfFlat(

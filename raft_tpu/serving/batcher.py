@@ -58,19 +58,27 @@ class Request:
     ``t_deadline`` (absolute, engine clock) is the shed deadline derived
     from the caller's ``deadline_ms``: a request still queued past it is
     shed at the next launch attempt instead of riding a batch whose
-    result the caller has already given up on."""
+    result the caller has already given up on.
+
+    ``trace_id`` is the span id minted at ``Engine.submit()`` and
+    propagated through every phase record (docs/observability.md);
+    ``t_admit`` marks when admission finished (``put`` returned), so the
+    span can split admission wait from queue wait."""
 
     __slots__ = ("query", "k", "future", "t_submit", "t_launch",
-                 "t_deadline")
+                 "t_deadline", "trace_id", "t_admit")
 
     def __init__(self, query: np.ndarray, k: int, future, t_submit: float,
-                 t_deadline: Optional[float] = None):
+                 t_deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.query = query
         self.k = k
         self.future = future
         self.t_submit = t_submit
         self.t_launch: Optional[float] = None
         self.t_deadline = t_deadline
+        self.trace_id = trace_id
+        self.t_admit: Optional[float] = None
 
 
 class Batch:
@@ -79,19 +87,24 @@ class Batch:
     ``searcher`` is the handle that served the launch — snapshotted per
     batch so a concurrent :meth:`Engine.swap_index` never splits one
     batch across two indexes, and so the exactness oracle can verify each
-    result against whichever index actually served it."""
+    result against whichever index actually served it.
+
+    ``meta`` carries the batch breadcrumbs for the span records (batch
+    id, searcher generation, coverage, pad/copy time) from dispatch to
+    the completion thread."""
 
     __slots__ = ("requests", "distances", "indices", "t_launch", "bucket",
-                 "searcher")
+                 "searcher", "meta")
 
     def __init__(self, requests: List[Request], distances, indices,
-                 t_launch: float, bucket: int, searcher=None):
+                 t_launch: float, bucket: int, searcher=None, meta=None):
         self.requests = requests
         self.distances = distances
         self.indices = indices
         self.t_launch = t_launch
         self.bucket = bucket
         self.searcher = searcher
+        self.meta = meta
 
 
 class Batcher:

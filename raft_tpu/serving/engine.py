@@ -56,12 +56,25 @@ chaos invariants are pinned in tests/test_serving_chaos.py):
   batches with zero dropped requests, pre-warming the new index's
   compile cache off the hot path — including promoting a
   degraded-coverage elastic restore to a full one (docs/robustness.md).
+
+Telemetry (docs/observability.md): every ``submit()`` mints a trace id
+and the request's whole life — admission wait, queue wait, pad/copy,
+device, readback, and its typed outcome — is emitted as one span record
+to ``EngineConfig.span_sink`` (plus a per-batch record carrying batch
+id, bucket, searcher generation, and coverage). Counters and latency
+histograms live on the :mod:`raft_tpu.obs.metrics` registry via
+:class:`ServingStats`; ``EngineConfig.metrics_port`` (or
+:meth:`Engine.serve_metrics`) exposes ``/metrics`` + ``/healthz``, and
+the autoscale pressure gauge (p99 queue wait ÷ deadline budget) is
+derived from the registry at scrape time. Telemetry never fails the
+serving path: a raising sink is counted and silenced.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import queue as _queue
 import random as _random
 import threading
@@ -72,8 +85,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from raft_tpu.obs import device as obs_device
+from raft_tpu.obs import spans as obs_spans
+from raft_tpu.obs.httpd import MetricsServer
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
-                                      EngineStopped, Request)
+                                      EngineStopped, QueueFull, Request)
 from raft_tpu.serving.searchers import Searcher
 from raft_tpu.serving.stats import ServingStats
 from raft_tpu.utils.shape import query_bucket
@@ -83,34 +99,14 @@ __all__ = ["EngineConfig", "Engine", "compile_count", "EngineStopped",
            "solo_reference", "verify_bit_identity"]
 
 
-# --------------------------------------------------------------------------
-# compile-count hook (jax.monitoring): lets tests and the warmup report
-# assert "the first submit after start() compiled nothing".
-_compile_lock = threading.Lock()
-_compile_events = 0
-_listener_registered = False
-
-
-def _compile_listener(event: str, duration: float, **kwargs) -> None:
-    global _compile_events
-    if "backend_compile" in event:
-        with _compile_lock:
-            _compile_events += 1
-
-
 def compile_count() -> int:
     """Process-wide count of XLA backend compiles observed since the
     first call (jax.monitoring duration events). Monotonic; compare
-    deltas around a region to assert cache hits."""
-    global _listener_registered
-    with _compile_lock:
-        if not _listener_registered:
-            import jax.monitoring
-
-            jax.monitoring.register_event_duration_secs_listener(
-                _compile_listener)
-            _listener_registered = True
-        return _compile_events
+    deltas around a region to assert cache hits. Backed by the
+    ``raft_tpu_xla_compile_total`` registry counter
+    (:func:`raft_tpu.obs.device.compile_count`); kept here because the
+    serving tests and warmup report grew up calling it."""
+    return obs_device.compile_count()
 
 
 # ------------------------------------------------------------ typed errors
@@ -219,6 +215,15 @@ class EngineConfig:
     rejection ramps instead of cliffing. ``hang_timeout_s`` arms the
     watchdog (None disables); ``breaker_cooldown_s`` is the open→
     half-open wait after a hang trips the circuit breaker.
+
+    Telemetry knobs (docs/observability.md): ``span_sink`` is any object
+    with ``emit(dict)`` (e.g. :class:`raft_tpu.obs.JsonlSink`; None
+    disables span records, the default); ``metrics_port`` starts the
+    ``/metrics`` + ``/healthz`` server on ``start()`` (0 = ephemeral,
+    read ``engine.metrics_server.port``); ``registry`` overrides the
+    process-global metrics registry (tests); ``deadline_budget_ms`` is
+    the autoscale pressure denominator — the per-request latency budget
+    the deployment promises (None derives 10x the flush deadline).
     """
 
     max_batch: int = 64
@@ -238,6 +243,12 @@ class EngineConfig:
     shed_seed: int = 0  # deterministic ramp draws (tests)
     hang_timeout_s: Optional[float] = 30.0
     breaker_cooldown_s: float = 5.0
+    # ---- telemetry
+    span_sink: Optional[object] = None
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
+    registry: Optional[object] = None
+    deadline_budget_ms: Optional[float] = None
 
 
 def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -263,7 +274,8 @@ class Engine:
         self._searcher = searcher
         self.config = config or EngineConfig()
         self.clock = clock
-        self.stats = ServingStats(window=self.config.stats_window)
+        self.stats = ServingStats(window=self.config.stats_window,
+                                  registry=self.config.registry)
         self.batcher = Batcher(self.config.max_batch,
                                self.config.max_wait_us,
                                self.config.queue_limit, clock)
@@ -295,6 +307,31 @@ class Engine:
         self._started = False
         self._stopped = False
         self.warmup_info: dict = {}
+        # ---- telemetry (docs/observability.md)
+        self._span_sink = cfg.span_sink
+        self._batch_seq = itertools.count(1)
+        self._searcher_gen = 0
+        self.metrics_server: Optional[MetricsServer] = None
+        budget_ms = cfg.deadline_budget_ms
+        if budget_ms is None:
+            budget_ms = max(10.0 * cfg.max_wait_us * 1e-3, 1.0)
+        #: autoscale pressure denominator, ms (docs/observability.md)
+        self.autoscale_budget_ms = float(budget_ms)
+        reg = self.stats.registry
+        label = self.stats.engine_label
+        reg.gauge(
+            "raft_tpu_serving_autoscale_pressure",
+            "p99 queue wait / deadline budget — the documented autoscale "
+            "signal: sustained > 1.0 means coalescing cannot keep up and "
+            "the replica set should grow.",
+            ("engine",)).labels(label).set_function(
+                lambda: self.stats.queue_wait_p99_s() * 1e3
+                / self.autoscale_budget_ms)
+        reg.gauge(
+            "raft_tpu_serving_queue_depth",
+            "Requests admitted but not yet launched.",
+            ("engine",)).labels(label).set_function(
+                lambda: float(len(self.batcher)))
 
     @property
     def searcher(self) -> Searcher:
@@ -359,8 +396,23 @@ class Engine:
                 target=self._watchdog_loop, name="raft-tpu-serving-watchdog",
                 daemon=True)
             self._watchdog_thread.start()
+        if cfg.metrics_port is not None:
+            self.serve_metrics(cfg.metrics_port, cfg.metrics_host)
         self._started = True
         return self
+
+    def serve_metrics(self, port: int = 0,
+                      host: str = "127.0.0.1") -> MetricsServer:
+        """Expose this engine's registry at ``/metrics`` (Prometheus
+        text), ``/metrics.json``, and its :meth:`health` at ``/healthz``
+        (200 for ok/degraded, 503 otherwise — the TPU_RUNBOOK pre-flight
+        curl). ``port=0`` binds an ephemeral port; read
+        ``engine.metrics_server.port``. Stopped by :meth:`stop`."""
+        if self.metrics_server is None:
+            self.metrics_server = MetricsServer(
+                port, host, registry=self.stats.registry,
+                health_fn=self.health).start()
+        return self.metrics_server
 
     def __enter__(self) -> "Engine":
         return self.start()
@@ -390,9 +442,17 @@ class Engine:
         (queue depth latched over ``queue_high_watermark``, or the
         probability ramp fired), and :class:`CircuitOpen` while the
         breaker holds the device path open after a hang."""
-        if not self._started or self._stopped:
-            raise EngineStopped("engine not running; call start()")
-        self._admit()
+        # trace id minted HERE — rejections are traced too, so a span
+        # file reconciles 1:1 with the typed-outcome counters
+        trace_id = obs_spans.new_trace_id()
+        t0 = self.clock()
+        try:
+            if not self._started or self._stopped:
+                raise EngineStopped("engine not running; call start()")
+            self._admit()
+        except (EngineStopped, Overloaded) as e:
+            self._emit_reject(trace_id, t0, k, e)
+            raise
         searcher = self._searcher
         q = np.asarray(query, searcher.query_dtype)
         if q.ndim == 2 and q.shape[0] == 1:
@@ -401,18 +461,22 @@ class Engine:
             raise ValueError(
                 f"query shape {q.shape} != ({searcher.dim},)")
         fut: Future = Future()
+        fut.trace_id = trace_id
         now = self.clock()
         t_deadline = None
         if deadline_ms is not None:
             t_deadline = now + float(deadline_ms) * 1e-3
-        req = Request(q, int(k), fut, now, t_deadline)
+        req = Request(q, int(k), fut, now, t_deadline, trace_id=trace_id)
         with self._outstanding_cv:
             self._outstanding += 1
         try:
             self.batcher.put(req, block=block, timeout=timeout)
-        except BaseException:
+        except BaseException as e:
             self._resolve(1)
+            if isinstance(e, (QueueFull, EngineStopped)):
+                self._emit_reject(trace_id, t0, k, e)
             raise
+        req.t_admit = self.clock()
         self.stats.record_submit()
         return fut
 
@@ -504,6 +568,8 @@ class Engine:
                 with contextlib.suppress(InvalidStateError):
                     r.future.set_exception(
                         EngineStopped("engine stopped before launch"))
+        for r in cancelled:
+            self._emit_request_outcome(r, "cancelled", where="stop")
         if cancelled:
             self.stats.record_cancelled(len(cancelled))
             self._resolve(len(cancelled))
@@ -514,6 +580,9 @@ class Engine:
         self._watchdog_stop.set()
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout)
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
 
     # ------------------------------------------------------------ hot swap
     def swap_index(self, searcher: Searcher, warm: bool = True) -> Searcher:
@@ -548,7 +617,13 @@ class Engine:
             self._warm(searcher)
         with self._swap_lock:
             self._searcher = searcher
+            self._searcher_gen += 1
+            gen = self._searcher_gen
         self.stats.record_swap(old.coverage, searcher.coverage)
+        self._emit({"kind": "swap", "engine": self.stats.engine_label,
+                    "searcher_gen": gen,
+                    "old_coverage": round(float(old.coverage), 6),
+                    "new_coverage": round(float(searcher.coverage), 6)})
         return old
 
     # -------------------------------------------------------------- health
@@ -586,20 +661,81 @@ class Engine:
             if self._outstanding <= 0:
                 self._outstanding_cv.notify_all()
 
+    # ---- span emission: every emitter funnels through safe_emit, so a
+    # raising sink is counted + silenced — telemetry never fails serving
+    def _emit(self, record: dict) -> None:
+        obs_spans.safe_emit(self._span_sink, record)
+
+    def _emit_reject(self, trace_id: str, t_start: float, k: int,
+                     exc: BaseException) -> None:
+        """Request span for a submission that never entered the queue —
+        the typed admission rejections, reconciled 1:1 with the
+        ``rejected_*`` counters."""
+        if self._span_sink is None:
+            return
+        if isinstance(exc, CircuitOpen):
+            outcome = "rejected_breaker"
+        elif isinstance(exc, Overloaded):
+            outcome = "rejected_overload"
+        elif isinstance(exc, QueueFull):
+            outcome = "rejected_queue_full"
+        else:
+            outcome = "rejected_stopped"
+        self._emit({
+            "kind": "request", "trace_id": trace_id,
+            "engine": self.stats.engine_label, "k": int(k),
+            "outcome": outcome,
+            "total_ms": round((self.clock() - t_start) * 1e3, 3),
+            "error": f"{type(exc).__name__}: {exc}"})
+
+    def _emit_request_outcome(self, req: Request, outcome: str,
+                              **extra) -> None:
+        """Terminal span record for an admitted request: the phase
+        decomposition (admission/queue, plus whatever ``extra`` the
+        call site knows — pad/copy, device, readback, batch
+        breadcrumbs) and the typed outcome."""
+        if self._span_sink is None:
+            return
+        rec = {"kind": "request", "trace_id": req.trace_id,
+               "engine": self.stats.engine_label, "k": req.k,
+               "outcome": outcome,
+               "total_ms": round((self.clock() - req.t_submit) * 1e3, 3)}
+        if req.t_admit is not None:
+            rec["admission_ms"] = round(
+                (req.t_admit - req.t_submit) * 1e3, 3)
+        if req.t_launch is not None:
+            t_q0 = req.t_admit if req.t_admit is not None else req.t_submit
+            rec["queue_ms"] = round((req.t_launch - t_q0) * 1e3, 3)
+        rec.update(extra)
+        self._emit(rec)
+
     def _fail_requests(self, reqs: Sequence[Request], exc: BaseException,
-                       hang: bool = False) -> int:
+                       hang: bool = False,
+                       meta: Optional[dict] = None) -> int:
         """Resolve ``reqs``'s still-pending futures with ``exc`` (typed,
         never silent) and settle the outstanding count for exactly the
         ones this call transitioned — safe to race the watchdog and the
-        completion thread."""
+        completion thread. ``meta`` is the batch breadcrumb dict for the
+        span records (may be None before padding built one)."""
         failed = 0
+        outcome = "hang" if hang else "batch_failed"
+        err = f"{type(exc).__name__}: {exc}"
         for r in reqs:
             with contextlib.suppress(InvalidStateError):
                 r.future.set_exception(exc)
                 failed += 1
+                self._emit_request_outcome(r, outcome, error=err,
+                                           **(meta or {}))
         if failed:
             self.stats.record_batch_failed(failed, hang=hang)
             self._resolve(failed)
+            if self._span_sink is not None:
+                rec = {"kind": "batch",
+                       "engine": self.stats.engine_label,
+                       "outcome": outcome, "error": err,
+                       "trace_ids": [r.trace_id for r in reqs]}
+                rec.update(meta or {})
+                self._emit(rec)
         return failed
 
     def _shed_expired(self) -> None:
@@ -617,6 +753,9 @@ class Engine:
                     f"deadline passed before launch (queued "
                     f"{waited_ms:.1f} ms)"))
                 shed += 1
+                self._emit_request_outcome(
+                    r, "shed_deadline",
+                    shed_after_ms=round(waited_ms, 3))
         if shed:
             self.stats.record_shed_deadline(shed)
             self._resolve(shed)
@@ -625,9 +764,10 @@ class Engine:
     # their blocking device interaction in a call record; the watchdog
     # fails any record older than hang_timeout_s and marks it hung so the
     # stuck thread discards the late result when (if) the call returns.
-    def _begin_device_call(self, reqs: List[Request], where: str) -> dict:
+    def _begin_device_call(self, reqs: List[Request], where: str,
+                           meta: Optional[dict] = None) -> dict:
         call = {"t0": self.clock(), "reqs": reqs, "where": where,
-                "hung": False}
+                "hung": False, "meta": meta}
         with self._calls_lock:
             self._calls[id(call)] = call
         return call
@@ -660,7 +800,7 @@ class Engine:
                         f"opened",
                         cause=TimeoutError(f"hung > {timeout}s"),
                         hang=True),
-                    hang=True)
+                    hang=True, meta=c["meta"])
 
     # ------------------------------------------------------------ the loops
     def _dispatch_loop(self) -> None:
@@ -685,8 +825,12 @@ class Engine:
 
     def _dispatch_batch(self, reqs: List[Request]) -> None:
         # honor client-side Future.cancel() before paying the launch
-        live = [r for r in reqs
-                if r.future.set_running_or_notify_cancel()]
+        live: List[Request] = []
+        for r in reqs:
+            if r.future.set_running_or_notify_cancel():
+                live.append(r)
+            else:
+                self._emit_request_outcome(r, "cancelled", where="pickup")
         if len(live) < len(reqs):
             self.stats.record_cancelled(len(reqs) - len(live))
             self._resolve(len(reqs) - len(live))
@@ -701,17 +845,25 @@ class Engine:
         # swap_index lands BETWEEN batches, never mid-batch
         with self._swap_lock:
             searcher = self._searcher
+            gen = self._searcher_gen
         # pad to the bucket HERE (host-side zeros) rather than letting
         # the wrapper do it: a full-bucket batch makes the wrapper's
         # trailing `v[:nq]` a no-op, so the warmed programs cover the
         # whole request path (a short batch would compile a fresh
         # eager dynamic_slice per (nq, k) on the first request)
         bucket = query_bucket(len(live))
+        # batch breadcrumbs: ride Batch.meta to the completion thread
+        # and into every rider's span record
+        meta = {"batch_id": next(self._batch_seq), "bucket": bucket,
+                "batch_size": len(live), "searcher_gen": gen,
+                "coverage": round(float(searcher.coverage), 6)}
         try:
+            t_pad0 = self.clock()
             batch = np.zeros((bucket, searcher.dim), searcher.query_dtype)
             for j, r in enumerate(live):
                 batch[j] = r.query
-            call = self._begin_device_call(live, "dispatch")
+            meta["pad_copy_ms"] = round((self.clock() - t_pad0) * 1e3, 3)
+            call = self._begin_device_call(live, "dispatch", meta)
             try:
                 d, i = searcher.search(batch, live[0].k)
             finally:
@@ -719,7 +871,7 @@ class Engine:
         except BaseException as e:  # noqa: B036 — relay to callers
             self._inflight.release()
             self._fail_requests(live, BatchFailed("dispatch failed",
-                                                  cause=e))
+                                                  cause=e), meta=meta)
             self.breaker.on_batch_result(False)
             return
         if hung:
@@ -727,14 +879,16 @@ class Engine:
             # accounting while the call was stuck; drop the late result
             self._inflight.release()
             return
-        self._completion.put(Batch(live, d, i, t_launch, bucket, searcher))
+        self._completion.put(Batch(live, d, i, t_launch, bucket, searcher,
+                                   meta))
 
     def _completion_loop(self) -> None:
         while True:
             b = self._completion.get()
             if b is None:
                 return
-            call = self._begin_device_call(b.requests, "readback")
+            call = self._begin_device_call(b.requests, "readback", b.meta)
+            t_read0 = self.clock()
             try:
                 # the serving host sync BY DESIGN: one readback completes
                 # batch N while the dispatch thread stages batch N+1
@@ -744,14 +898,23 @@ class Engine:
                 self._end_device_call(call)
                 self._inflight.release()
                 self._fail_requests(
-                    b.requests, BatchFailed("readback failed", cause=e))
+                    b.requests, BatchFailed("readback failed", cause=e),
+                    meta=b.meta)
                 self.breaker.on_batch_result(False)
                 continue
+            t_read1 = self.clock()
             hung = self._end_device_call(call)
             self._inflight.release()
             if hung:
                 continue  # watchdog failed + settled them; discard rows
             t_done = self.clock()
+            # phase decomposition for the span records: device is
+            # launch → readback start (JAX dispatch is async, so the
+            # wait happens inside np.asarray; the split is honest at
+            # batch granularity), readback is the host copy itself
+            meta = dict(b.meta or {})
+            meta["device_ms"] = round((t_read0 - b.t_launch) * 1e3, 3)
+            meta["readback_ms"] = round((t_read1 - t_read0) * 1e3, 3)
             resolved = 0
             for j, r in enumerate(b.requests):
                 # placement breadcrumbs for the exactness oracle
@@ -762,12 +925,20 @@ class Engine:
                 with contextlib.suppress(InvalidStateError):
                     r.future.set_result((d_np[j], i_np[j]))
                     resolved += 1
+                    self._emit_request_outcome(r, "ok", **meta)
             self.breaker.on_batch_result(True)
             self.stats.record_batch(
                 len(b.requests), b.bucket,
                 [b.t_launch - r.t_submit for r in b.requests],
                 t_done - b.t_launch,
                 [t_done - r.t_submit for r in b.requests])
+            if self._span_sink is not None:
+                rec = {"kind": "batch",
+                       "engine": self.stats.engine_label, "outcome": "ok",
+                       "trace_ids": [r.trace_id for r in b.requests],
+                       "batch_ms": round((t_done - b.t_launch) * 1e3, 3)}
+                rec.update(meta)
+                self._emit(rec)
             self._resolve(resolved)
 
 

@@ -1,25 +1,44 @@
-"""Lock-cheap serving observability: per-request latency decomposition,
-batch/bucket histograms, and percentile snapshots.
+"""Serving observability as a thin view over the obs metrics registry.
 
-Design constraints (the reason this is not a metrics framework):
+Historically this module owned its own counters and sliding-window
+sample deques; PR 6 migrated the storage onto
+:mod:`raft_tpu.obs.metrics` so the same numbers a test asserts are the
+ones ``GET /metrics`` scrapes — one source of truth, no parallel
+bookkeeping. :class:`ServingStats` keeps its entire old API (``n_*``
+counters, ``record_*`` methods, ``snapshot()``, ``reset_samples()``)
+as properties/views over registry families labeled by engine:
 
-- ``record_*`` sits on the completion path of every request, so it must
-  be O(1) and hold one uncontended lock for a few appends — no sorting,
-  no allocation beyond the sample ring.
-- Percentiles are computed only in :meth:`snapshot` (the scrape path),
-  over a bounded sample window, so an unbounded run can't grow host
-  memory (the serving analog of the bench artifacts' fixed-size rows).
-- The clock is injectable: the deterministic tests drive a fake clock
-  and assert exact counter/percentile values.
+- ``raft_tpu_serving_requests_total{engine,event}`` — submitted,
+  completed, cancelled, shed_deadline, rejected_overload,
+  rejected_breaker, failed (every typed outcome is a labeled child,
+  pre-touched to 0 so a scrape shows the full outcome vocabulary).
+- ``raft_tpu_serving_batches_total`` / ``_batch_errors_total`` /
+  ``_hangs_total`` / ``_breaker_trips_total`` / ``_swaps_total``.
+- ``raft_tpu_serving_batches_by_size_total{engine,size}`` and
+  ``_by_bucket_total{engine,bucket}`` — the exact batch/bucket
+  histograms the coalescing tests assert.
+- ``raft_tpu_serving_queue_wait_seconds`` / ``_device_seconds`` /
+  ``_total_seconds`` — exponential-bucket histograms replacing the old
+  sample deques. ``snapshot()`` percentiles are bucket-interpolated
+  over the window since the last ``reset_samples()`` (snapshot diff);
+  means stay exact (sums are exact).
+
+The nearest-rank :func:`percentiles` helper stays: bench tooling ranks
+raw sample lists with it, where "a latency that actually happened" is
+the right semantics.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
-from collections import deque
 from typing import Dict, Optional, Sequence
 
+from raft_tpu.obs import metrics as obs_metrics
+
 __all__ = ["ServingStats", "percentiles"]
+
+_engine_seq = itertools.count()
 
 
 def percentiles(samples: Sequence[float],
@@ -43,89 +62,193 @@ def percentiles(samples: Sequence[float],
     return out
 
 
-class ServingStats:
-    """Counters + bounded latency samples for one :class:`Engine`.
+#: the typed request outcomes (requests_total's ``event`` vocabulary)
+_REQUEST_EVENTS = ("submitted", "completed", "cancelled", "shed_deadline",
+                   "rejected_overload", "rejected_breaker", "failed")
 
-    Three per-request latency components, all in seconds:
+
+class ServingStats:
+    """Counters + latency histograms for one :class:`Engine`, stored on a
+    metrics registry (default: the process-global one).
+
+    Three per-request latency components, all observed in seconds:
 
     - ``queue_wait``: admission → batch launch (the coalescing deadline's
       direct cost; bounded by ``max_wait_us`` under light load).
     - ``device``: batch launch → results on host (device execution plus
       readback, amortized over the batch).
     - ``total``: admission → future resolved.
+
+    ``window`` is kept for API compatibility; windowing is now by
+    snapshot diff (``reset_samples()`` re-baselines), so it is unused.
     """
 
-    def __init__(self, window: int = 8192):
-        self._lock = threading.Lock()
-        self._window = int(window)
-        self.n_submitted = 0
-        self.n_completed = 0
-        self.n_cancelled = 0
-        self.n_batches = 0
-        # --- robustness counters (docs/serving.md "Overload & failure
-        # semantics"): every shed/reject/failure is typed AND counted, so
-        # an operator can tell "we shed load" from "we lost requests"
-        self.n_shed_deadline = 0        # DeadlineExceeded before launch
-        self.n_rejected_overload = 0    # Overloaded at admission
-        self.n_rejected_breaker = 0     # CircuitOpen at admission
-        self.n_failed = 0               # requests failed via BatchFailed
-        self.n_batch_errors = 0         # batches that failed (any cause)
-        self.n_hangs = 0                # watchdog-detected device hangs
-        self.n_breaker_trips = 0        # breaker transitions to open
-        self.n_swaps = 0                # hot index swaps
-        self.coverage: float = 1.0      # current searcher coverage
+    def __init__(self, window: int = 8192,
+                 registry: Optional[obs_metrics.Registry] = None,
+                 engine_label: Optional[str] = None):
+        self.registry = registry if registry is not None \
+            else obs_metrics.REGISTRY
+        self.engine_label = engine_label or f"engine{next(_engine_seq)}"
+        self._lock = threading.Lock()   # guards coverage_transitions only
         self.coverage_transitions = []  # [(old, new), ...] per swap
-        self.batch_size_hist: Dict[int, int] = {}
-        self.bucket_hist: Dict[int, int] = {}
-        self._queue_wait = deque(maxlen=self._window)
-        self._device = deque(maxlen=self._window)
-        self._total = deque(maxlen=self._window)
+        r, e = self.registry, self.engine_label
+
+        req = r.counter(
+            "raft_tpu_serving_requests_total",
+            "Serving requests by typed outcome event.", ("engine", "event"))
+        # pre-touch every outcome child: a scrape must show the shed /
+        # reject counters at 0, not omit them until the first incident
+        self._req = {ev: req.labels(e, ev) for ev in _REQUEST_EVENTS}
+
+        self._batches = r.counter(
+            "raft_tpu_serving_batches_total",
+            "Coalesced batches completed.", ("engine",)).labels(e)
+        self._batch_errors = r.counter(
+            "raft_tpu_serving_batch_errors_total",
+            "Batches failed (any cause).", ("engine",)).labels(e)
+        self._hangs = r.counter(
+            "raft_tpu_serving_hangs_total",
+            "Watchdog-detected device hangs.", ("engine",)).labels(e)
+        self._breaker_trips = r.counter(
+            "raft_tpu_serving_breaker_trips_total",
+            "Circuit breaker transitions to open.", ("engine",)).labels(e)
+        self._swaps = r.counter(
+            "raft_tpu_serving_swaps_total",
+            "Hot index swaps.", ("engine",)).labels(e)
+        self._by_size = r.counter(
+            "raft_tpu_serving_batches_by_size_total",
+            "Completed batches by coalesced size.", ("engine", "size"))
+        self._by_bucket = r.counter(
+            "raft_tpu_serving_batches_by_bucket_total",
+            "Completed batches by padded shape bucket.", ("engine", "bucket"))
+        self._coverage = r.gauge(
+            "raft_tpu_serving_coverage",
+            "Current searcher shard coverage (1.0 = full index).",
+            ("engine",)).labels(e)
+        self._coverage.set(1.0)
+
+        self._hists = {
+            "queue_wait": r.histogram(
+                "raft_tpu_serving_queue_wait_seconds",
+                "Admission to batch launch.", ("engine",)).labels(e),
+            "device": r.histogram(
+                "raft_tpu_serving_device_seconds",
+                "Batch launch to results on host (per rider).",
+                ("engine",)).labels(e),
+            "total": r.histogram(
+                "raft_tpu_serving_total_seconds",
+                "Admission to future resolved.", ("engine",)).labels(e),
+        }
+        # windowing: snapshot() diffs against these baselines
+        self._base = {k: h.snapshot() for k, h in self._hists.items()}
+
+    # --------------------------------------------------- counter views
+    @property
+    def n_submitted(self) -> int:
+        return int(self._req["submitted"].value)
+
+    @property
+    def n_completed(self) -> int:
+        return int(self._req["completed"].value)
+
+    @property
+    def n_cancelled(self) -> int:
+        return int(self._req["cancelled"].value)
+
+    @property
+    def n_shed_deadline(self) -> int:
+        return int(self._req["shed_deadline"].value)
+
+    @property
+    def n_rejected_overload(self) -> int:
+        return int(self._req["rejected_overload"].value)
+
+    @property
+    def n_rejected_breaker(self) -> int:
+        return int(self._req["rejected_breaker"].value)
+
+    @property
+    def n_failed(self) -> int:
+        return int(self._req["failed"].value)
+
+    @property
+    def n_batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def n_batch_errors(self) -> int:
+        return int(self._batch_errors.value)
+
+    @property
+    def n_hangs(self) -> int:
+        return int(self._hangs.value)
+
+    @property
+    def n_breaker_trips(self) -> int:
+        return int(self._breaker_trips.value)
+
+    @property
+    def n_swaps(self) -> int:
+        return int(self._swaps.value)
+
+    @property
+    def coverage(self) -> float:
+        return float(self._coverage.value)
+
+    @property
+    def batch_size_hist(self) -> Dict[int, int]:
+        # the registry family is shared process-wide; keep only THIS
+        # engine's children (labels are (engine, size))
+        return {int(k[1]): int(c.value)
+                for k, c in sorted(self._by_size.collect(),
+                                   key=lambda kv: int(kv[0][1]))
+                if k[0] == self.engine_label}
+
+    @property
+    def bucket_hist(self) -> Dict[int, int]:
+        return {int(k[1]): int(c.value)
+                for k, c in sorted(self._by_bucket.collect(),
+                                   key=lambda kv: int(kv[0][1]))
+                if k[0] == self.engine_label}
 
     # ---------------------------------------------------------- recording
     def record_submit(self, n: int = 1) -> None:
-        with self._lock:
-            self.n_submitted += n
+        self._req["submitted"].inc(n)
 
     def record_cancelled(self, n: int = 1) -> None:
-        with self._lock:
-            self.n_cancelled += n
+        self._req["cancelled"].inc(n)
 
     def record_shed_deadline(self, n: int = 1) -> None:
-        with self._lock:
-            self.n_shed_deadline += n
+        self._req["shed_deadline"].inc(n)
 
     def record_rejected(self, kind: str, n: int = 1) -> None:
         """``kind`` is ``"overload"`` (watermark/ramp shed) or
         ``"breaker"`` (circuit open)."""
-        with self._lock:
-            if kind == "breaker":
-                self.n_rejected_breaker += n
-            else:
-                self.n_rejected_overload += n
+        key = "rejected_breaker" if kind == "breaker" else \
+            "rejected_overload"
+        self._req[key].inc(n)
 
     def record_batch_failed(self, n_requests: int, hang: bool = False
                             ) -> None:
         """One failed batch: its requests resolved with BatchFailed."""
-        with self._lock:
-            self.n_batch_errors += 1
-            self.n_failed += n_requests
-            if hang:
-                self.n_hangs += 1
+        self._batch_errors.inc()
+        self._req["failed"].inc(n_requests)
+        if hang:
+            self._hangs.inc()
 
     def record_breaker_trip(self) -> None:
-        with self._lock:
-            self.n_breaker_trips += 1
+        self._breaker_trips.inc()
 
     def record_swap(self, old_coverage: float, new_coverage: float) -> None:
+        self._swaps.inc()
+        self._coverage.set(float(new_coverage))
         with self._lock:
-            self.n_swaps += 1
-            self.coverage = float(new_coverage)
             self.coverage_transitions.append(
-                (round(float(old_coverage), 6), round(float(new_coverage), 6)))
+                (round(float(old_coverage), 6),
+                 round(float(new_coverage), 6)))
 
     def set_coverage(self, coverage: float) -> None:
-        with self._lock:
-            self.coverage = float(coverage)
+        self._coverage.set(float(coverage))
 
     def record_batch(self, batch_size: int, bucket: int,
                      queue_waits: Sequence[float], device_s: float,
@@ -134,68 +257,77 @@ class ServingStats:
         the shared device+readback time (every rider pays the same batch
         execution, so one device sample per request keeps the per-request
         view honest without pretending per-row timing exists)."""
-        with self._lock:
-            self.n_batches += 1
-            self.n_completed += len(totals)
-            self.batch_size_hist[batch_size] = (
-                self.batch_size_hist.get(batch_size, 0) + 1)
-            self.bucket_hist[bucket] = self.bucket_hist.get(bucket, 0) + 1
-            self._queue_wait.extend(queue_waits)
-            self._total.extend(totals)
-            self._device.extend([device_s] * len(totals))
+        self._batches.inc()
+        self._req["completed"].inc(len(totals))
+        self._by_size.labels(self.engine_label, batch_size).inc()
+        self._by_bucket.labels(self.engine_label, bucket).inc()
+        qh, dh, th = (self._hists["queue_wait"], self._hists["device"],
+                      self._hists["total"])
+        for w in queue_waits:
+            qh.observe(w)
+        for t in totals:
+            th.observe(t)
+            dh.observe(device_s)
 
     # ----------------------------------------------------------- scraping
+    def _window_diffs(self):
+        return {k: h.snapshot() - self._base[k]
+                for k, h in self._hists.items()}
+
     def snapshot(self) -> dict:
         """Point-in-time view: counters, histograms, and p50/p95/p99 (ms)
-        for each latency component over the sample window."""
+        for each latency component since the last ``reset_samples()``.
+        Percentiles are histogram-bucket interpolated (exact to within
+        one exponential bucket); means are exact."""
+        snap = {
+            "n_submitted": self.n_submitted,
+            "n_completed": self.n_completed,
+            "n_cancelled": self.n_cancelled,
+            "n_batches": self.n_batches,
+            "n_shed_deadline": self.n_shed_deadline,
+            "n_rejected_overload": self.n_rejected_overload,
+            "n_rejected_breaker": self.n_rejected_breaker,
+            "n_failed": self.n_failed,
+            "n_batch_errors": self.n_batch_errors,
+            "n_hangs": self.n_hangs,
+            "n_breaker_trips": self.n_breaker_trips,
+            "n_swaps": self.n_swaps,
+            "coverage": self.coverage,
+            "batch_size_hist": self.batch_size_hist,
+            "bucket_hist": self.bucket_hist,
+        }
         with self._lock:
-            qw = list(self._queue_wait)
-            dv = list(self._device)
-            tt = list(self._total)
-            snap = {
-                "n_submitted": self.n_submitted,
-                "n_completed": self.n_completed,
-                "n_cancelled": self.n_cancelled,
-                "n_batches": self.n_batches,
-                "n_shed_deadline": self.n_shed_deadline,
-                "n_rejected_overload": self.n_rejected_overload,
-                "n_rejected_breaker": self.n_rejected_breaker,
-                "n_failed": self.n_failed,
-                "n_batch_errors": self.n_batch_errors,
-                "n_hangs": self.n_hangs,
-                "n_breaker_trips": self.n_breaker_trips,
-                "n_swaps": self.n_swaps,
-                "coverage": self.coverage,
-                "coverage_transitions": list(self.coverage_transitions),
-                "batch_size_hist": dict(sorted(self.batch_size_hist.items())),
-                "bucket_hist": dict(sorted(self.bucket_hist.items())),
-            }
+            snap["coverage_transitions"] = list(self.coverage_transitions)
         if snap["n_batches"]:
             snap["mean_batch_size"] = round(
                 sum(k * v for k, v in snap["batch_size_hist"].items())
                 / snap["n_batches"], 2)
-        for name, samples in (("queue_wait_ms", qw), ("device_ms", dv),
-                              ("total_ms", tt)):
-            if samples:
-                ms = [s * 1e3 for s in samples]
-                pct = percentiles(ms)
+        for key, name in (("queue_wait", "queue_wait_ms"),
+                          ("device", "device_ms"), ("total", "total_ms")):
+            diff = self._hists[key].snapshot() - self._base[key]
+            if diff.count > 0:
                 snap[name] = {
-                    "mean": round(sum(ms) / len(ms), 3),
-                    **{k: round(v, 3) for k, v in pct.items()},
+                    "mean": round(diff.mean * 1e3, 3),
+                    "p50": round(diff.quantile(0.50) * 1e3, 3),
+                    "p95": round(diff.quantile(0.95) * 1e3, 3),
+                    "p99": round(diff.quantile(0.99) * 1e3, 3),
                 }
         return snap
 
     def reset_samples(self) -> None:
-        """Drop latency samples (keep counters) — lets a load sweep scope
-        percentiles to one offered-load point."""
-        with self._lock:
-            self._queue_wait.clear()
-            self._device.clear()
-            self._total.clear()
+        """Re-baseline the latency window (keep counters) — lets a load
+        sweep scope percentiles to one offered-load point."""
+        self._base = {k: h.snapshot() for k, h in self._hists.items()}
+
+    def queue_wait_p99_s(self) -> float:
+        """Cumulative (not windowed) p99 queue wait in seconds — the
+        autoscale pressure numerator (docs/observability.md). 0.0 until
+        the first completed batch."""
+        return self._hists["queue_wait"].snapshot().quantile(0.99)
 
     # convenience for tests / artifacts
     def mean_total_ms(self) -> Optional[float]:
-        with self._lock:
-            if not self._total:
-                return None
-            return sum(self._total) / len(self._total) * 1e3
+        diff = self._hists["total"].snapshot() - self._base["total"]
+        if not diff.count:
+            return None
+        return diff.mean * 1e3

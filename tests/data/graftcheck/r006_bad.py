@@ -1,0 +1,27 @@
+"""R006 fixture: undecorated public entry points in a neighbors module
+(analysed under modname ``raft_tpu.neighbors.r006_bad``)."""
+
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+
+
+def build(dataset):
+    # flagged: public build entry point with no tracing scope
+    return jnp.asarray(dataset)
+
+
+def search(index, queries, k):
+    # flagged: the decorator is missing even though tracing is imported
+    del tracing
+    return jnp.asarray(queries)[:k]
+
+
+def _private_search(index, queries, k):
+    # not flagged: private helper, not an entry point
+    return jnp.asarray(queries)[:k]
+
+
+def extend(index, vectors):
+    # not flagged: `extend` is not in the required-name set
+    return index

@@ -1,0 +1,23 @@
+"""R006 fixture: every public entry point carries a tracing scope
+(analysed under modname ``raft_tpu.neighbors.r006_clean``)."""
+
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.tracing import annotate
+
+
+@tracing.range("fixture.build")
+def build(dataset):
+    return jnp.asarray(dataset)
+
+
+@annotate("fixture.search")
+def search(index, queries, k):
+    # `annotate` also satisfies the rule (named_scope without the
+    # profiler annotation)
+    return jnp.asarray(queries)[:k]
+
+
+def knn(queries, dataset, k):  # graftcheck: R006 (wrapper delegates)
+    return search(build(dataset), queries, k)

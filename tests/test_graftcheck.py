@@ -13,7 +13,8 @@ from raft_tpu.analysis import (AST_RULES, ModuleInfo, check_layering,
                                split_by_baseline)
 from raft_tpu.analysis.rules_ast import (rule_host_sync, rule_recompile_hazard,
                                          rule_traced_branch,
-                                         rule_unguarded_broadcast)
+                                         rule_unguarded_broadcast,
+                                         rule_untraced_entry_point)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
@@ -50,6 +51,53 @@ def test_clean_twins_pass_every_rule():
         mod = _mod(fname, f"raft_tpu.fixture_pkg_b.{fname[:-3]}")
         for rule in AST_RULES:
             assert rule(mod) == [], (fname, rule.__name__)
+
+
+def test_r006_flags_untraced_entry_points_in_neighbors_scope():
+    # R006 is scoped to raft_tpu.neighbors submodules, so the fixtures
+    # are analysed under that modname rather than fixture_pkg_b
+    found = rule_untraced_entry_point(
+        _mod("r006_bad.py", "raft_tpu.neighbors.r006_bad"))
+    assert [(f.rule, f.qualname) for f in found] == [
+        ("R006", "build"), ("R006", "search")]
+    assert "tracing" in found[0].message
+    assert rule_untraced_entry_point(
+        _mod("r006_clean.py", "raft_tpu.neighbors.r006_clean")) == []
+
+
+def test_r006_ignores_modules_outside_neighbors():
+    # the same undecorated entry points are fine anywhere else
+    for modname in ("raft_tpu.fixture_pkg_b.r006_bad",
+                    "raft_tpu.neighbors",  # the package __init__ itself
+                    "tools.r006_bad"):
+        assert rule_untraced_entry_point(_mod("r006_bad.py", modname)) == []
+
+
+def test_r006_suppression_on_def_line(tmp_path):
+    src = open(os.path.join(FIXDIR, "r006_bad.py")).read()
+    src = src.replace("def build(dataset):",
+                      "def build(dataset):  # graftcheck: R006")
+    p = tmp_path / "r006_suppressed.py"
+    p.write_text(src)
+    mod = ModuleInfo(str(p), "r006_suppressed.py",
+                     "raft_tpu.neighbors.r006_suppressed")
+    assert [f.qualname for f in rule_untraced_entry_point(mod)] == ["search"]
+
+
+def test_r006_repo_entry_points_are_all_traced():
+    # the live neighbors package must satisfy R006 with zero baseline
+    # entries — the instrumentation is the contract, not an exception
+    import raft_tpu.neighbors as npkg
+    pkg_dir = os.path.dirname(npkg.__file__)
+    findings = []
+    for fn in sorted(os.listdir(pkg_dir)):
+        if not fn.endswith(".py"):
+            continue
+        mod = ModuleInfo(os.path.join(pkg_dir, fn),
+                         f"raft_tpu/neighbors/{fn}",
+                         f"raft_tpu.neighbors.{fn[:-3]}")
+        findings.extend(rule_untraced_entry_point(mod))
+    assert findings == [], [f.format() for f in findings]
 
 
 def test_layering_flags_cross_package_private_import():

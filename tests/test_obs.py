@@ -1,0 +1,322 @@
+"""obs/ — metrics registry, span sinks, scrape server (CPU-checked).
+
+Every assertion here is against a private Registry instance (the global
+one is shared with the serving stats and the p2p counters, so tests
+never mutate it), except the device compile counters, which are
+process-global by nature."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import pytest
+
+from raft_tpu.obs import metrics as obm
+from raft_tpu.obs import spans as obs
+from raft_tpu.obs.httpd import MetricsServer
+
+pytestmark = pytest.mark.fast
+
+
+# ------------------------------------------------------------- registry
+
+def test_counter_basics_and_monotonicity():
+    reg = obm.Registry()
+    c = reg.counter("x_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_get_or_create_is_idempotent_and_schema_checked():
+    reg = obm.Registry()
+    a = reg.counter("x_total", "h", ("peer",))
+    b = reg.counter("x_total", "different help", ("peer",))
+    assert a is b
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("x_total", "h", ("rank",))
+    with pytest.raises(ValueError, match="counter"):
+        reg.gauge("x_total")
+    assert reg.get("x_total") is a
+    assert reg.get("nope") is None
+
+
+def test_labeled_children_are_distinct_series():
+    reg = obm.Registry()
+    c = reg.counter("msgs_total", "", ("peer",))
+    c.labels(0).inc(5)
+    c.labels("1").inc(7)
+    assert c.labels("0").value == 5      # values stringify
+    assert c.labels(1).value == 7
+    with pytest.raises(ValueError, match="label"):
+        c.labels("a", "b")
+
+
+def test_gauge_set_inc_dec_and_callback():
+    reg = obm.Registry()
+    g = reg.gauge("depth")
+    g.set(10)
+    g.dec(3)
+    assert g.value == 7.0
+    g.set_function(lambda: 42.0)
+    assert g.value == 42.0
+    g.set(1.0)  # set clears the callback
+    assert g.value == 1.0
+    g.set_function(lambda: 1 / 0)  # a raising callback reads as NaN
+    assert math.isnan(g.value)
+
+
+def test_exponential_buckets():
+    b = obm.exponential_buckets(1.0, 2.0, 4)
+    assert b == (1.0, 2.0, 4.0, 8.0)
+    with pytest.raises(ValueError):
+        obm.exponential_buckets(0.0, 2.0, 4)
+    with pytest.raises(ValueError):
+        obm.exponential_buckets(1.0, 1.0, 4)
+    assert len(obm.DEFAULT_LATENCY_BUCKETS) == 20
+    assert obm.DEFAULT_LATENCY_BUCKETS[0] == pytest.approx(5e-5)
+
+
+def test_histogram_observe_quantile_and_mean():
+    reg = obm.Registry()
+    h = reg.histogram("lat_seconds", "", buckets=(0.1, 0.2, 0.4, 0.8))
+    for v in (0.05, 0.15, 0.15, 0.3):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap.count == 4
+    assert snap.mean == pytest.approx(0.1625)
+    # rank-2 of 4 falls in the (0.1, 0.2] bucket holding 2 obs
+    assert 0.1 <= snap.quantile(0.5) <= 0.2
+    # p100 lands in (0.2, 0.4]
+    assert 0.2 <= snap.quantile(1.0) <= 0.4
+    assert snap.quantile(0.0) == 0.0 or snap.quantile(0.0) <= 0.1
+    with pytest.raises(ValueError):
+        snap.quantile(1.5)
+
+
+def test_histogram_overflow_clamps_to_last_finite_bound():
+    reg = obm.Registry()
+    h = reg.histogram("big_seconds", "", buckets=(0.1, 0.2))
+    h.observe(99.0)
+    snap = h.snapshot()
+    assert snap.counts[-1] == 1  # overflow bucket
+    assert snap.quantile(0.99) == 0.2
+
+
+def test_snapshot_diff_is_the_windowing_primitive():
+    reg = obm.Registry()
+    h = reg.histogram("w_seconds", "", buckets=(0.1, 0.2, 0.4))
+    h.observe(0.05)
+    before = h.snapshot()
+    h.observe(0.3)
+    h.observe(0.3)
+    window = h.snapshot() - before
+    assert window.count == 2
+    assert window.mean == pytest.approx(0.3)
+    assert 0.2 <= window.quantile(0.5) <= 0.4
+    # empty window is all zeros, quantile 0.0
+    empty = h.snapshot() - h.snapshot()
+    assert empty.count == 0 and empty.quantile(0.99) == 0.0
+    other = reg.histogram("other_seconds", "", buckets=(1.0,))
+    with pytest.raises(ValueError):
+        h.snapshot() - other.snapshot()
+
+
+def test_histogram_threaded_observers_lose_nothing():
+    reg = obm.Registry()
+    h = reg.histogram("t_seconds", "", buckets=(0.5,))
+    child = h.labels()
+
+    def worker():
+        for _ in range(1000):
+            child.observe(0.1)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.snapshot().count == 4000
+
+
+# ----------------------------------------------------------- exposition
+
+def test_prometheus_text_format():
+    reg = obm.Registry()
+    reg.counter("req_total", "requests served", ("engine",)) \
+       .labels("e0").inc(3)
+    reg.gauge("cov", "coverage").set(0.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 0.2))
+    h.observe(0.05)
+    h.observe(0.15)
+    h.observe(9.0)
+    text = reg.to_prometheus_text()
+    assert "# HELP req_total requests served" in text
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{engine="e0"} 3' in text
+    assert "# TYPE cov gauge" in text
+    assert "cov 0.5" in text
+    # buckets are CUMULATIVE and +Inf equals the count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="0.2"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_label_escaping():
+    reg = obm.Registry()
+    reg.counter("esc_total", "", ("path",)).labels('a"b\\c\nd').inc()
+    text = reg.to_prometheus_text()
+    assert 'path="a\\"b\\\\c\\nd"' in text
+
+
+def test_json_dump_round_trips(tmp_path):
+    reg = obm.Registry()
+    reg.counter("c_total", "h").inc(2)
+    h = reg.histogram("l_seconds", "", buckets=(0.1, 0.2))
+    h.observe(0.15)
+    doc = reg.to_json()
+    assert doc["c_total"]["series"][0]["value"] == 2.0
+    hs = doc["l_seconds"]["series"][0]
+    assert hs["count"] == 1 and 100.0 <= hs["p50_ms"] <= 200.0
+    p = tmp_path / "metrics.json"
+    reg.dump_json(str(p))
+    assert json.loads(p.read_text())["c_total"]["kind"] == "counter"
+
+
+# ---------------------------------------------------------------- spans
+
+def test_trace_ids_are_unique_16_hex():
+    ids = {obs.new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+
+def test_list_sink_and_safe_emit():
+    sink = obs.ListSink()
+    obs.safe_emit(sink, {"kind": "request", "x": 1})
+    obs.safe_emit(None, {"kind": "request"})  # no-op, no raise
+    assert len(sink) == 1
+    assert sink.by_kind("request")[0]["x"] == 1
+    assert sink.by_kind("batch") == []
+    sink.clear()
+    assert len(sink) == 0
+
+    class Exploding:
+        def emit(self, record):
+            raise RuntimeError("sink down")
+
+    errors_before = obs._SINK_ERRORS.value
+    obs.safe_emit(Exploding(), {"kind": "request"})  # silenced
+    assert obs._SINK_ERRORS.value == errors_before + 1
+
+
+def test_jsonl_sink_and_read_back(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    with obs.JsonlSink(path) as sink:
+        sink.emit({"kind": "request", "trace_id": "aa", "total_ms": 1.5})
+        sink.emit({"kind": "batch", "trace_ids": ["aa"]})
+    with open(path, "a") as f:
+        f.write('{"torn": ')  # crashed-writer tail must not break reads
+    recs = obs.read_jsonl(path)
+    assert len(recs) == 2
+    assert obs.read_jsonl(path, kind="batch")[0]["trace_ids"] == ["aa"]
+    # emit after close is a silent no-op
+    sink2 = obs.JsonlSink(path)
+    sink2.close()
+    sink2.emit({"kind": "request"})
+    assert len(obs.read_jsonl(path)) == 2
+
+
+def test_timed_span_durations_and_errors():
+    sink = obs.ListSink()
+    with obs.timed_span(sink, "phase", step="warmup") as rec:
+        rec["n"] = 7
+    (r,) = sink.records
+    assert r["kind"] == "phase" and r["step"] == "warmup" and r["n"] == 7
+    assert r["duration_ms"] >= 0 and len(r["trace_id"]) == 16
+    with pytest.raises(ValueError, match="boom"):
+        with obs.timed_span(sink, "phase", trace_id="ff" * 8):
+            raise ValueError("boom")
+    failed = sink.records[-1]
+    assert failed["trace_id"] == "ff" * 8
+    assert failed["error"].startswith("ValueError")
+
+
+# ---------------------------------------------------------------- httpd
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_metrics_server_routes():
+    reg = obm.Registry()
+    reg.counter("served_total", "h").inc(9)
+    health = {"status": "ok", "queue_depth": 0}
+    with MetricsServer(port=0, registry=reg,
+                       health_fn=lambda: health) as srv:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and "served_total 9" in body
+        code, body = _get(srv.url + "/metrics.json")
+        assert code == 200
+        assert json.loads(body)["served_total"]["series"][0]["value"] == 9
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        health["status"] = "degraded"  # alive-but-shedding is still 200
+        assert _get(srv.url + "/healthz")[0] == 200
+        health["status"] = "stopped"
+        assert _get(srv.url + "/healthz")[0] == 503
+        assert _get(srv.url + "/nope")[0] == 404
+
+
+def test_metrics_server_503_when_health_fn_raises():
+    def bad_health():
+        raise RuntimeError("engine gone")
+
+    with MetricsServer(port=0, health_fn=bad_health) as srv:
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503 and "engine gone" in body
+
+
+def test_metrics_server_defaults_to_global_registry():
+    from raft_tpu.obs.metrics import REGISTRY
+    marker = REGISTRY.counter("obs_test_marker_total", "test only")
+    marker.inc()
+    with MetricsServer(port=0) as srv:
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and "obs_test_marker_total" in body
+        # no health_fn: healthz is an unconditional liveness 200
+        assert _get(srv.url + "/healthz")[0] == 200
+
+
+# --------------------------------------------------------------- device
+
+def test_compile_counters_installed_and_monotonic():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.obs import device as obd
+
+    obd.install_compile_metrics()
+    obd.install_compile_metrics()  # idempotent
+    before = obd.compile_count()
+
+    @jax.jit
+    def fresh(x):
+        return x * 3.0 + 1.0
+
+    fresh(jnp.ones(5)).block_until_ready()
+    after = obd.compile_count()
+    assert after >= before + 1
+    assert obd.compile_seconds() >= 0.0
+    # cached second call must not count a compile
+    fresh(jnp.ones(5)).block_until_ready()
+    assert obd.compile_count() == after

@@ -144,10 +144,16 @@ def test_stats_counters_and_latency_under_fake_clock():
     assert snap["batch_size_hist"] == {1: 1, 3: 1}
     assert snap["bucket_hist"] == {8: 2}
     assert snap["mean_batch_size"] == 2.0
-    # nearest-rank over [800, 500, 100, 0] ms queue waits
-    assert snap["queue_wait_ms"]["p50"] == 100.0
-    assert snap["queue_wait_ms"]["p99"] == 800.0
-    assert snap["total_ms"]["p50"] == 600.0
+    # percentiles are histogram-bucket interpolated now (exact to within
+    # one exponential bucket of DEFAULT_LATENCY_BUCKETS); the queue
+    # waits are [800, 500, 100, 0] ms, so p50 (rank 2) lands in the
+    # (51.2, 102.4] ms bucket and p99 in (409.6, 819.2] ms
+    assert 51.2 <= snap["queue_wait_ms"]["p50"] <= 102.4
+    assert 409.6 <= snap["queue_wait_ms"]["p99"] <= 819.2
+    # totals [1300, 1000, 600, 250] ms: p50 in (409.6, 819.2] ms
+    assert 409.6 <= snap["total_ms"]["p50"] <= 819.2
+    # means are exact, not bucketed
+    assert snap["queue_wait_ms"]["mean"] == pytest.approx(350.0)
     assert snap["device_ms"]["mean"] == pytest.approx(437.5)
     st.reset_samples()
     snap2 = st.snapshot()

@@ -1,0 +1,350 @@
+"""Telemetry reconciliation for the serving engine (docs/observability.md).
+
+The contract under test: EVERY submit() is traced — each request-kind
+span carries the trace id minted at submission and a typed outcome, and
+the span file reconciles 1:1 with the registry counters for every
+outcome, including the ones the chaos injectors force (batch failure,
+hang, deadline shed, watermark and breaker rejections). Zero untraced
+requests, zero phantom spans.
+
+Shares the chaos suite's fixtures/idioms (tests/test_serving_chaos.py);
+the same ~0.2-0.5 s warmed-search timing note applies to every
+``hang_timeout_s`` choice here."""
+
+import collections
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import metrics as obm
+from raft_tpu.obs.spans import ListSink
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+#: outcome vocabulary → the stats counter each span outcome must match
+OUTCOME_COUNTERS = {
+    "ok": "n_completed",
+    "cancelled": "n_cancelled",
+    "shed_deadline": "n_shed_deadline",
+    "rejected_overload": "n_rejected_overload",
+    "rejected_breaker": "n_rejected_breaker",
+}
+
+
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+
+
+@pytest.fixture()
+def searcher(flat_index):
+    return serving.ivf_flat_searcher(flat_index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _engine(s, sink=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_us", 5000)
+    kw.setdefault("warm_ks", (K,))
+    kw.setdefault("span_sink", sink)
+    return serving.Engine(s, serving.EngineConfig(**kw))
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def _reconcile(sink, stats):
+    """Assert span outcomes match the registry counters 1:1; returns the
+    per-outcome span tally. ``batch_failed`` and ``hang`` both count as
+    ``n_failed`` (the hang verdict belongs to the watchdog)."""
+    tally = collections.Counter(
+        r["outcome"] for r in sink.by_kind("request"))
+    for outcome, attr in OUTCOME_COUNTERS.items():
+        assert tally.get(outcome, 0) == getattr(stats, attr), (
+            outcome, dict(tally))
+    assert (tally.get("batch_failed", 0) + tally.get("hang", 0)
+            == stats.n_failed), dict(tally)
+    return tally
+
+
+# -------------------------------------------------------- the happy path
+
+def test_every_completed_request_has_a_full_span(searcher):
+    rng = np.random.default_rng(0)
+    sink = ListSink()
+    with _engine(searcher, sink, hang_timeout_s=None) as eng:
+        futs = [eng.submit(_q(rng), K) for _ in range(10)]
+        ids = set()
+        for f in futs:
+            f.result(timeout=60)
+            ids.add(f.trace_id)
+        eng.drain(60)
+        assert len(ids) == 10  # every future carries a distinct trace id
+
+        spans = sink.by_kind("request")
+        assert {s["trace_id"] for s in spans} == ids  # zero untraced
+        for s in spans:
+            assert s["outcome"] == "ok"
+            assert s["engine"] == eng.stats.engine_label
+            # full phase decomposition + batch breadcrumbs
+            for key in ("admission_ms", "queue_ms", "pad_copy_ms",
+                        "device_ms", "readback_ms", "total_ms",
+                        "batch_id", "bucket", "batch_size",
+                        "searcher_gen", "coverage"):
+                assert key in s, key
+            assert s["total_ms"] >= 0 and s["coverage"] == 1.0
+            assert s["searcher_gen"] == 0
+
+        # batch records join back to every rider's trace id
+        batch_ids = [t for b in sink.by_kind("batch")
+                     for t in b["trace_ids"]]
+        assert set(batch_ids) == ids and len(batch_ids) == 10
+        assert all(b["outcome"] == "ok" for b in sink.by_kind("batch"))
+        _reconcile(sink, eng.stats)
+
+
+def test_span_records_are_json_serializable(searcher):
+    rng = np.random.default_rng(1)
+    sink = ListSink()
+    with _engine(searcher, sink, hang_timeout_s=None) as eng:
+        eng.search(_q(rng), K)
+        eng.drain(60)
+    for rec in sink.records:
+        json.dumps(rec)  # the JSONL interchange contract
+
+
+# ------------------------------------------------- chaos reconciliation
+
+def test_batch_failure_and_shed_spans_reconcile(searcher):
+    rng = np.random.default_rng(2)
+    sink = ListSink()
+    with _engine(searcher, sink, hang_timeout_s=None) as eng:
+        # one poisoned batch
+        faults.fail_next_dispatch(searcher)
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed):
+            victim.result(timeout=60)
+        # a deadline shed: generous flush deadline, microscopic request
+        # deadline — the batcher prunes it before any launch
+        shed = eng.submit(_q(rng), K, deadline_ms=0.0)
+        with pytest.raises(serving.DeadlineExceeded):
+            shed.result(timeout=60)
+        # healthy traffic after both incidents
+        oks = [eng.submit(_q(rng), K) for _ in range(6)]
+        for f in oks:
+            f.result(timeout=60)
+        eng.drain(60)
+
+        tally = _reconcile(sink, eng.stats)
+        assert tally["batch_failed"] == 1
+        assert tally["shed_deadline"] == 1
+        assert tally["ok"] == 6
+        # the failed request's span carries the typed error + trace id
+        (failed,) = [s for s in sink.by_kind("request")
+                     if s["outcome"] == "batch_failed"]
+        assert failed["trace_id"] == victim.trace_id
+        assert "BatchFailed" in failed["error"]
+        (shed_span,) = [s for s in sink.by_kind("request")
+                        if s["outcome"] == "shed_deadline"]
+        assert shed_span["trace_id"] == shed.trace_id
+        assert shed_span["shed_after_ms"] >= 0.0
+        # the failed batch record is typed too
+        bad_batches = [b for b in sink.by_kind("batch")
+                       if b["outcome"] == "batch_failed"]
+        assert len(bad_batches) == 1
+        assert bad_batches[0]["trace_ids"] == [victim.trace_id]
+
+
+def test_hang_and_breaker_rejection_spans_reconcile(searcher):
+    rng = np.random.default_rng(3)
+    sink = ListSink()
+    with _engine(searcher, sink, hang_timeout_s=1.0,
+                 breaker_cooldown_s=30.0, max_wait_us=0) as eng:
+        faults.hang_next_dispatch(searcher, hang_s=3.0)
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed) as ei:
+            victim.result(timeout=60)
+        assert ei.value.hang is True
+        # breaker is now open: admission rejects, and the rejection is
+        # itself traced (rejections never enter the queue)
+        with pytest.raises(serving.CircuitOpen):
+            eng.submit(_q(rng), K)
+        eng.drain(60)
+
+        tally = _reconcile(sink, eng.stats)
+        assert tally["hang"] == 1
+        assert tally["rejected_breaker"] == 1
+        (rej,) = [s for s in sink.by_kind("request")
+                  if s["outcome"] == "rejected_breaker"]
+        assert "CircuitOpen" in rej["error"]
+        assert len(rej["trace_id"]) == 16
+
+
+def test_overload_rejection_spans_reconcile(searcher):
+    rng = np.random.default_rng(4)
+    sink = ListSink()
+    # tiny watermark + an enormous flush deadline so the queue backs up
+    eng = _engine(searcher, sink, hang_timeout_s=None, max_wait_us=int(5e7),
+                  queue_high_watermark=2, queue_low_watermark=1)
+    with eng:
+        admitted, rejected = [], 0
+        for _ in range(6):
+            try:
+                admitted.append(eng.submit(_q(rng), K))
+            except serving.Overloaded:
+                rejected += 1
+        assert rejected >= 1 and admitted
+        eng.stop(drain=True)  # void flush deadlines, launch the queue
+        for f in admitted:
+            f.result(timeout=60)
+
+        tally = _reconcile(sink, eng.stats)
+        assert tally["rejected_overload"] == rejected
+        assert tally["ok"] == len(admitted)
+
+
+def test_cancelled_on_stop_is_traced(searcher):
+    rng = np.random.default_rng(5)
+    sink = ListSink()
+    eng = _engine(searcher, sink, hang_timeout_s=None, max_wait_us=int(5e7))
+    with eng:
+        futs = [eng.submit(_q(rng), K) for _ in range(3)]
+        eng.stop(drain=False)  # queued requests are cancelled
+        tally = _reconcile(sink, eng.stats)
+        assert tally["cancelled"] == 3
+        cancelled = [s for s in sink.by_kind("request")
+                     if s["outcome"] == "cancelled"]
+        assert {s["trace_id"] for s in cancelled} == \
+            {f.trace_id for f in futs}
+        assert all(s["where"] == "stop" for s in cancelled)
+
+
+def test_swap_emits_generation_span(searcher, flat_index):
+    rng = np.random.default_rng(6)
+    sink = ListSink()
+    other = serving.ivf_flat_searcher(flat_index,
+                                      ivf_flat.SearchParams(n_probes=8))
+    with _engine(searcher, sink, hang_timeout_s=None) as eng:
+        eng.search(_q(rng), K)
+        eng.swap_index(other)
+        d, i = eng.search(_q(rng), K)
+        assert d.shape == (K,)
+        eng.drain(60)
+        (swap,) = sink.by_kind("swap")
+        assert swap["searcher_gen"] == 1
+        assert swap["old_coverage"] == swap["new_coverage"] == 1.0
+        # post-swap requests carry the new generation breadcrumb
+        gens = {s["searcher_gen"] for s in sink.by_kind("request")}
+        assert gens == {0, 1}
+
+
+# ------------------------------------------------- scrape + warm start
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_metrics_endpoint_on_running_engine(searcher):
+    rng = np.random.default_rng(8)
+    # default (global) registry so the scrape includes the process-wide
+    # compile counter next to this engine's families
+    with _engine(searcher, hang_timeout_s=None, metrics_port=0) as eng:
+        assert eng.metrics_server is not None
+        url = eng.metrics_server.url
+        for _ in range(4):
+            eng.search(_q(rng), K)
+        eng.drain(60)
+
+        code, body = _get(url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+
+        code, text = _get(url + "/metrics")
+        assert code == 200
+        e = eng.stats.engine_label
+        # request counters incl. the pre-touched shed/reject children
+        assert (f'raft_tpu_serving_requests_total{{engine="{e}",'
+                f'event="completed"}} 4') in text
+        for ev in ("rejected_overload", "rejected_breaker",
+                   "shed_deadline"):
+            assert (f'raft_tpu_serving_requests_total{{engine="{e}",'
+                    f'event="{ev}"}} 0') in text
+        # latency histogram buckets, compile counter, autoscale gauge
+        assert f'raft_tpu_serving_queue_wait_seconds_bucket{{engine="{e}"' \
+            in text
+        assert "raft_tpu_xla_compile_total" in text
+        assert f'raft_tpu_serving_autoscale_pressure{{engine="{e}"}}' \
+            in text
+        assert f'raft_tpu_serving_queue_depth{{engine="{e}"}} 0' in text
+
+        code, body = _get(url + "/metrics.json")
+        assert code == 200
+        doc = json.loads(body)
+        series = doc["raft_tpu_serving_requests_total"]["series"]
+        completed = [s for s in series
+                     if s["labels"] == {"engine": e, "event": "completed"}]
+        assert completed[0]["value"] == 4.0
+    # engine stop tears the server down
+    with pytest.raises(OSError):
+        urllib.request.urlopen(url + "/healthz", timeout=1)
+
+
+def test_healthz_degrades_and_recovers_with_breaker(searcher):
+    rng = np.random.default_rng(9)
+    with _engine(searcher, hang_timeout_s=1.0, breaker_cooldown_s=30.0,
+                 max_wait_us=0, metrics_port=0) as eng:
+        url = eng.metrics_server.url
+        assert _get(url + "/healthz")[0] == 200
+        faults.hang_next_dispatch(searcher, hang_s=3.0)
+        with pytest.raises(serving.BatchFailed):
+            eng.submit(_q(rng), K).result(timeout=60)
+        code, body = _get(url + "/healthz")  # breaker open → 503
+        assert code == 503
+        assert json.loads(body)["breaker"] == "open"
+        eng.drain(60)
+
+
+def test_warm_start_still_precompiles_with_telemetry_enabled(searcher):
+    rng = np.random.default_rng(10)
+    sink = ListSink()
+    with _engine(searcher, sink, hang_timeout_s=None) as eng:
+        # (warmup_info["compiles"] may be 0 here: earlier tests in this
+        # process already compiled these shapes; the delta is what counts)
+        assert "compiles" in eng.warmup_info
+        c0 = serving.compile_count()
+        for _ in range(5):
+            eng.search(_q(rng), K)
+        eng.drain(60)
+        # telemetry must not perturb the warmed shapes: zero compiles
+        # after start() on the instrumented path
+        assert serving.compile_count() == c0
+
+
+def test_autoscale_pressure_gauge_derives_from_registry(searcher):
+    rng = np.random.default_rng(11)
+    reg = obm.Registry()
+    with _engine(searcher, hang_timeout_s=None, registry=reg,
+                 deadline_budget_ms=50.0) as eng:
+        gauge = reg.get("raft_tpu_serving_autoscale_pressure")
+        child = gauge.labels(eng.stats.engine_label)
+        assert child.value == 0.0  # no batches yet → no queue-wait p99
+        for _ in range(6):
+            eng.search(_q(rng), K)
+        eng.drain(60)
+        expected = eng.stats.queue_wait_p99_s() * 1e3 / 50.0
+        assert child.value == pytest.approx(expected)
+        assert 0.0 <= child.value < 1e6

@@ -1,0 +1,98 @@
+"""core/tracing.py — the R006 scope primitive (CPU-checked).
+
+``range`` must behave identically as a context manager and a decorator
+(the reference's RAII type vs its FUNC_RANGE macro), nest, re-enter, and
+never swallow exceptions; the same instance is shared by every call of a
+decorated entry point, so re-entrancy is not optional."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import tracing
+
+pytestmark = pytest.mark.fast
+
+
+def test_range_as_context_manager():
+    with tracing.range("test.scope") as r:
+        assert r.name == "test.scope"
+        x = jnp.arange(4.0)
+    assert float(x.sum()) == 6.0
+
+
+def test_range_as_decorator_preserves_metadata():
+    @tracing.range("test.decorated")
+    def payload(a, b=2):
+        """payload doc"""
+        return a + b
+
+    assert payload.__name__ == "payload"
+    assert payload.__doc__ == "payload doc"
+    assert payload(3) == 5
+    assert payload(3, b=4) == 7
+
+
+def test_exceptions_propagate_from_both_forms():
+    r = tracing.range("test.raises")
+    with pytest.raises(ValueError, match="inner"):
+        with r:
+            raise ValueError("inner")
+
+    @tracing.range("test.raises_deco")
+    def boom():
+        raise KeyError("deco")
+
+    with pytest.raises(KeyError):
+        boom()
+    # the scope stack fully unwound — the instance is reusable
+    with r:
+        pass
+    assert r._stack == []
+
+
+def test_nesting_and_reentrancy():
+    outer = tracing.range("test.outer")
+    with outer:
+        with tracing.range("test.inner"):
+            # same instance re-entered (recursive decorated function)
+            with outer:
+                assert len(outer._stack) == 2
+        assert len(outer._stack) == 1
+    assert outer._stack == []
+
+
+def test_recursive_decorated_function():
+    @tracing.range("test.recursive")
+    def fact(n):
+        return 1 if n <= 1 else n * fact(n - 1)
+
+    assert fact(5) == 120
+
+
+def test_range_inside_jit_names_the_hlo():
+    def fn(x):
+        with tracing.range("jitscope"):
+            y = x * 2.0
+            return y + 1.0
+
+    np.testing.assert_allclose(np.asarray(jax.jit(fn)(jnp.ones(3))), 3.0)
+    # named_scope survives into the compiled HLO op names — that is what
+    # xprof reads, so it is the property worth pinning
+    text = jax.jit(fn).lower(jnp.ones(3)).compile().as_text()
+    assert "jitscope" in text
+
+
+def test_annotate_defaults_to_qualname():
+    @tracing.annotate()
+    def named_by_default():
+        return 7
+
+    assert named_by_default() == 7
+
+    @tracing.annotate("explicit.name")
+    def named_explicitly():
+        return 8
+
+    assert named_explicitly() == 8

@@ -16,7 +16,12 @@ via raft_tpu.bench.timing:
   comparison point.
 
 Also records per-bucket jit compile time (cold) so compile-cache misses
-can't masquerade as dispatch overhead. Artifact: LATENCY_TPU.json.
+can't masquerade as dispatch overhead. Artifact: LATENCY_TPU.json, plus
+a span JSONL (``<out>.spans.jsonl``, docs/observability.md) with one
+``build`` record per index and one ``latency_point`` record per
+(index, batch) measurement — the same schema ``obs.spans.read_jsonl``
+and tools/serving_bench.py consume, so profile runs land in the same
+trace tooling as serving runs. ``--spans ''`` disables.
 """
 
 import argparse
@@ -37,6 +42,9 @@ def main():
     ap.add_argument("--dim", type=int, default=96)
     ap.add_argument("--batches", type=int, nargs="*", default=[1, 10, 100])
     ap.add_argument("--fori-iters", type=int, default=64)
+    ap.add_argument("--spans", default=None,
+                    help="span JSONL path (default <out>.spans.jsonl; "
+                         "'' disables)")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -47,18 +55,25 @@ def main():
 
     from raft_tpu.bench import timing
     from raft_tpu.neighbors import ivf_flat, ivf_pq
+    from raft_tpu.obs import spans as obs_spans
 
     platform = jax.devices()[0].platform
+    spans_path = args.spans if args.spans is not None \
+        else args.out + ".spans.jsonl"
+    # timed_span tolerates sink=None, so '' just turns emission off
+    sink = obs_spans.JsonlSink(spans_path) if spans_path else None
     rng = np.random.default_rng(0)
     base = rng.standard_normal((args.rows, args.dim)).astype(np.float32)
 
     print(f"platform={platform}; building indexes on {args.rows}x{args.dim}",
           flush=True)
     t0 = time.perf_counter()
-    flat = ivf_flat.build(base, ivf_flat.IndexParams(n_lists=1024))
-    timing.fence_index(flat)
-    pq = ivf_pq.build(base, ivf_pq.IndexParams(n_lists=1024, pq_dim=48))
-    timing.fence_index(pq)
+    with obs_spans.timed_span(sink, "build", index="ivf_flat"):
+        flat = ivf_flat.build(base, ivf_flat.IndexParams(n_lists=1024))
+        timing.fence_index(flat)
+    with obs_spans.timed_span(sink, "build", index="ivf_pq"):
+        pq = ivf_pq.build(base, ivf_pq.IndexParams(n_lists=1024, pq_dim=48))
+        timing.fence_index(pq)
     print(f"builds done in {time.perf_counter() - t0:.1f}s", flush=True)
 
     searchers = {
@@ -84,35 +99,38 @@ def main():
                 rng.standard_normal((b, args.dim)).astype(np.float32))
             row = {"index": name, "batch": b}
 
-            # cold compile cost for this bucket (first trace+compile)
-            t0 = time.perf_counter()
-            timing.fence(fn(q0))
-            row["cold_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            with obs_spans.timed_span(sink, "latency_point",
+                                      index=name, batch=b) as span:
+                # cold compile cost for this bucket (first trace+compile)
+                t0 = time.perf_counter()
+                timing.fence(fn(q0))
+                row["cold_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
 
-            step = lambda q: timing.chain_perturb(q0, fn(q))  # noqa: E731
-            row["chained_ms"] = round(
-                timing.time_latency_chained(step, q0, iters=16) * 1e3, 3)
-            row["chained_rtt_bound"] = timing.last_info["rtt_bound"]
+                step = lambda q: timing.chain_perturb(q0, fn(q))  # noqa: E731
+                row["chained_ms"] = round(
+                    timing.time_latency_chained(step, q0, iters=16) * 1e3, 3)
+                row["chained_rtt_bound"] = timing.last_info["rtt_bound"]
 
-            # pure on-chip: same chain inside ONE jit (no host dispatch)
-            try:
-                n_it = args.fori_iters
+                # pure on-chip: same chain inside ONE jit (no host dispatch)
+                try:
+                    n_it = args.fori_iters
 
-                @jax.jit
-                def fori(q0_, n=n_it, f=fn):
-                    def body(_, q):
-                        return timing.chain_perturb(q0_, f(q))
+                    @jax.jit
+                    def fori(q0_, n=n_it, f=fn):
+                        def body(_, q):
+                            return timing.chain_perturb(q0_, f(q))
 
-                    return jax.lax.fori_loop(0, n, body, q0_)
+                        return jax.lax.fori_loop(0, n, body, q0_)
 
-                timing.fence(fori(q0))  # compile
-                dt = timing.time_dispatches(lambda: fori(q0), iters=2)
-                row["onchip_ms"] = round(dt / n_it * 1e3, 3)
-                row["onchip_rtt_bound"] = timing.last_info["rtt_bound"]
-                row["dispatch_ms"] = round(
-                    row["chained_ms"] - row["onchip_ms"], 3)
-            except Exception as e:  # not traceable inside fori
-                row["onchip_error"] = repr(e)[:200]
+                    timing.fence(fori(q0))  # compile
+                    dt = timing.time_dispatches(lambda: fori(q0), iters=2)
+                    row["onchip_ms"] = round(dt / n_it * 1e3, 3)
+                    row["onchip_rtt_bound"] = timing.last_info["rtt_bound"]
+                    row["dispatch_ms"] = round(
+                        row["chained_ms"] - row["onchip_ms"], 3)
+                except Exception as e:  # not traceable inside fori
+                    row["onchip_error"] = repr(e)[:200]
+                span.update(row)
             results.append(row)
             print(row, flush=True)
 
@@ -122,6 +140,9 @@ def main():
            "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
+    if sink is not None:
+        sink.close()
+        print(f"-> {spans_path}")
     print(f"-> {args.out}")
 
 

@@ -23,6 +23,16 @@ Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
   the queue. Every shed is a typed rejection (Overloaded / QueueFull /
   DeadlineExceeded); an untyped wait-timeout fails the run.
 
+Telemetry (docs/observability.md): every engine in the bench runs with a
+span sink writing ``<out>.spans.jsonl`` (one record per request with its
+trace id, phase decomposition, and typed outcome; ``--spans ''``
+disables). After each family the span file is read back and reconciled
+against the engines' counters — ok spans must equal completed requests.
+For the first family the bench also measures the cost of that
+instrumentation: best-of-N closed-loop QPS with the span sink on vs off,
+asserted < 2% apart (``--no-overhead-check`` skips the gate,
+``--overhead-tolerance`` moves it).
+
 Artifact: SERVING_cpu.json / SERVING_tpu.json (name follows the measured
 platform unless --out is given).
 
@@ -234,6 +244,54 @@ def bench_overload(engine, queries, k, rate_qps, n_requests, rng,
     return row
 
 
+class _TaggedSink:
+    """Stamps every span record with the family before forwarding, so
+    one spans file serves the whole bench and reads back per-family."""
+
+    def __init__(self, inner, family):
+        self._inner = inner
+        self._family = family
+
+    def emit(self, record):
+        record["family"] = self._family
+        self._inner.emit(record)
+
+
+def bench_telemetry_overhead(searcher, cfg_kwargs, queries, k, submitters,
+                             reps, tmpdir):
+    """Best-of-``reps`` closed-loop QPS with the span sink writing JSONL
+    vs telemetry-silent, arms alternated per rep so thermal/load drift
+    hits both equally. The registry counters stay on in both arms (they
+    are not optional); the measured delta is the span-emission path."""
+    from raft_tpu import serving
+    from raft_tpu.obs import spans as obs_spans
+
+    def one_run(sink):
+        eng = serving.Engine(searcher, serving.EngineConfig(
+            span_sink=sink, **cfg_kwargs))
+        eng.start()
+        try:
+            summary, _, _, _ = bench_closed_loop(eng, queries, k,
+                                                 submitters)
+        finally:
+            eng.stop()
+        return summary["qps"]
+
+    qps = {"plain": 0.0, "telemetry": 0.0}
+    for rep in range(reps):
+        qps["plain"] = max(qps["plain"], one_run(None))
+        path = os.path.join(tmpdir, f"overhead_{rep}.jsonl")
+        with obs_spans.JsonlSink(path) as sink:
+            qps["telemetry"] = max(qps["telemetry"], one_run(sink))
+    overhead = 1.0 - qps["telemetry"] / qps["plain"]
+    return {
+        "reps": reps,
+        "qps_plain": qps["plain"],
+        "qps_telemetry": qps["telemetry"],
+        "overhead": round(overhead, 4),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None,
@@ -262,6 +320,18 @@ def main():
     ap.add_argument("--overload-queries", type=int, default=300)
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the per-request bit-identity sweep")
+    ap.add_argument("--spans", default=None,
+                    help="span JSONL path (default <out>.spans.jsonl; "
+                         "'' disables span emission)")
+    ap.add_argument("--overhead-reps", type=int, default=3,
+                    help="best-of-N reps per arm of the telemetry "
+                         "overhead measurement")
+    ap.add_argument("--overhead-tolerance", type=float, default=0.02,
+                    help="maximum allowed closed-loop QPS loss with the "
+                         "span sink enabled (fraction)")
+    ap.add_argument("--no-overhead-check", action="store_true",
+                    help="skip the telemetry overhead measurement + gate "
+                         "(noisy shared machines)")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -286,22 +356,30 @@ def main():
                               res=res)
     gt = np.asarray(gt_j)
 
-    config = serving.EngineConfig(
+    from raft_tpu.obs import spans as obs_spans
+
+    cfg_kwargs = dict(
         max_batch=args.max_batch, max_wait_us=args.max_wait_us,
         max_inflight=args.max_inflight, warm_ks=(args.k,))
+    spans_path = args.spans if args.spans is not None \
+        else out_path + ".spans.jsonl"
+    spans_sink = obs_spans.JsonlSink(spans_path) if spans_path else None
     art = {
         "platform": platform,
         "rows": args.rows, "dim": args.dim, "k": args.k,
         "config": {"max_batch": args.max_batch,
                    "max_wait_us": args.max_wait_us,
                    "max_inflight": args.max_inflight},
+        "spans": spans_path or None,
         "families": {},
     }
 
-    for family in args.families:
+    for fi, family in enumerate(args.families):
         print(f"=== {family}", flush=True)
         searcher, build_s = build_family(family, db, res)
         row = {"build_s": build_s}
+        fam_sink = _TaggedSink(spans_sink, family) if spans_sink else None
+        config = serving.EngineConfig(span_sink=fam_sink, **cfg_kwargs)
         base, base_idx = bench_baseline_b1(searcher, queries, args.k)
         base["recall"] = round(
             float(neighborhood_recall(base_idx, gt)), 4)
@@ -340,6 +418,7 @@ def main():
                       flush=True)
         finally:
             engine.stop()
+        completed_total = engine.stats.n_completed
 
         if args.overload_factors and "closed_loop" in row:
             # fresh engine with the shedding knobs engaged: the high
@@ -354,7 +433,8 @@ def main():
                 max_batch=args.max_batch, max_wait_us=args.max_wait_us,
                 max_inflight=args.max_inflight, warm_ks=(args.k,),
                 queue_limit=max(4 * args.max_batch, 64),
-                queue_high_watermark=args.max_batch)
+                queue_high_watermark=args.max_batch,
+                span_sink=fam_sink)
             ov_engine = serving.Engine(searcher, overload_cfg)
             ov_engine.start()
             try:
@@ -396,8 +476,46 @@ def main():
                           f"of at-capacity {p99_cap} ms)", flush=True)
             finally:
                 ov_engine.stop()
+            completed_total += ov_engine.stats.n_completed
+
+        if spans_sink is not None:
+            # consume the span file back: the ok spans must reconcile
+            # 1:1 with what the engines' counters say completed
+            reqs = [r for r in obs_spans.read_jsonl(spans_path,
+                                                    kind="request")
+                    if r.get("family") == family]
+            outcomes = {}
+            for r in reqs:
+                outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+            assert outcomes.get("ok", 0) == completed_total, (
+                f"span/counter mismatch for {family}: "
+                f"{outcomes.get('ok', 0)} ok spans vs "
+                f"{completed_total} completed requests")
+            row["spans"] = {"requests": len(reqs), "outcomes": outcomes}
+            print(f"  spans: {len(reqs)} request records reconciled, "
+                  f"outcomes={outcomes}", flush=True)
+
+        if fi == 0 and not args.no_overhead_check:
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as td:
+                oh = bench_telemetry_overhead(
+                    searcher, cfg_kwargs, queries, args.k,
+                    args.submitters, args.overhead_reps, td)
+            row["telemetry_overhead"] = oh
+            print(f"  telemetry overhead: {oh['overhead'] * 100:.2f}% "
+                  f"(plain {oh['qps_plain']} qps vs spans-on "
+                  f"{oh['qps_telemetry']} qps, best of "
+                  f"{oh['reps']})", flush=True)
+            assert oh["overhead"] <= args.overhead_tolerance, (
+                f"telemetry overhead {oh['overhead'] * 100:.2f}% exceeds "
+                f"{args.overhead_tolerance * 100:.1f}% of closed-loop "
+                f"QPS (rerun with --overhead-reps higher on a noisy "
+                f"machine, or --no-overhead-check to skip the gate)")
         art["families"][family] = row
 
+    if spans_sink is not None:
+        spans_sink.close()
     art["when"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
     with open(out_path, "w") as f:
         json.dump(art, f, indent=1)
