@@ -22,15 +22,18 @@ import os
 from typing import Iterable, List, Optional, Tuple
 
 from raft_tpu.analysis.astutils import ModuleInfo
-from raft_tpu.analysis.findings import (Finding, load_baseline, save_baseline,
-                                        split_by_baseline)
+from raft_tpu.analysis.findings import (PLACEHOLDER_JUSTIFICATION, Finding,
+                                        load_baseline, save_baseline,
+                                        split_by_baseline, unjustified_keys)
 from raft_tpu.analysis.layering import check_layering
 from raft_tpu.analysis.rules_ast import AST_RULES
 
 __all__ = [
     "Finding", "ModuleInfo", "AST_RULES", "check_layering",
     "load_baseline", "save_baseline", "split_by_baseline",
-    "collect_modules", "run_tier_a", "DEFAULT_SCAN_DIRS",
+    "unjustified_keys", "PLACEHOLDER_JUSTIFICATION",
+    "collect_modules", "run_tier_a",
+    "DEFAULT_SCAN_DIRS",
 ]
 
 #: directories scanned by default, relative to the repo root.

@@ -17,6 +17,11 @@ from typing import Iterable, Optional
 
 BASELINE_VERSION = 1
 
+#: the justification ``save_baseline`` stamps on entries that never got
+#: a human one. A baseline carrying it is a TODO that was never done —
+#: ``graftcheck`` refuses to treat such entries as suppressions.
+PLACEHOLDER_JUSTIFICATION = "TODO: justify or fix"
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -71,12 +76,22 @@ def save_baseline(path, findings: Iterable[Finding],
             "rule": f.rule,
             "file": f.file,
             "qualname": f.qualname,
-            "justification": old.get(f.key, "TODO: justify or fix"),
+            "justification": old.get(f.key, PLACEHOLDER_JUSTIFICATION),
         })
     with open(path, "w") as fh:
         json.dump({"version": BASELINE_VERSION, "entries": entries}, fh,
                   indent=1)
         fh.write("\n")
+
+
+def unjustified_keys(baseline: dict) -> list:
+    """Keys of baseline entries whose justification is empty or still
+    the :data:`PLACEHOLDER_JUSTIFICATION` stamp. A suppression without a
+    reason is a silent rot channel — ``graftcheck`` fails the run until
+    each one is written (or the entry removed)."""
+    return sorted(
+        key for key, just in baseline.items()
+        if not just.strip() or just.strip() == PLACEHOLDER_JUSTIFICATION)
 
 
 def split_by_baseline(findings: Iterable[Finding], baseline: dict

@@ -186,16 +186,18 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def make_ivf_pq_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                          shape: Optional[Sift1MCrashShape] = None,
-                          unbounded_variant: bool = False):
-    """Trace the LUT-engine scan core exactly as ``ivf_pq.search`` would
-    dispatch it at ``shape``: tiles from ``plan_lut_tiles`` against
-    ``budget_bytes``. ``unbounded_variant=True`` reproduces the PRE-PR-1
-    planning instead — one-axis q_tile solved from the under-counting
-    estimate (LUT + packed-code gather only, ~1/5 of the true live set)
-    and no probe tiling — the exact configuration that produced the ~19 GB
-    live set in LUT_CRASH_tpu.json; the walker must flag it."""
+def make_ivf_pq_lut_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                         shape: Optional[Sift1MCrashShape] = None,
+                         unbounded_variant: bool = False):
+    """→ ``(core, args, meta)`` for the LUT-engine scan core exactly as
+    ``ivf_pq.search`` would dispatch it at ``shape``: tiles from
+    ``plan_lut_tiles`` against ``budget_bytes``. ``unbounded_variant=True``
+    reproduces the PRE-PR-1 planning instead — one-axis q_tile solved from
+    the under-counting estimate (LUT + packed-code gather only, ~1/5 of
+    the true live set) and no probe tiling — the exact configuration that
+    produced the ~19 GB live set in LUT_CRASH_tpu.json; the walker must
+    flag it. ``meta`` carries the planner name and its predicted peak
+    workspace bytes for the obs.costs calibration audit."""
     import jax.numpy as jnp
 
     from raft_tpu.neighbors import ivf_pq
@@ -209,9 +211,16 @@ def make_ivf_pq_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
         if q_tile >= 8:
             q_tile -= q_tile % 8
         probe_tile = 0  # all probes in one pass
+        meta = {"family": "ivf_pq", "planner": None, "predicted_bytes": None,
+                "tiles": {"q_tile": q_tile, "probe_tile": probe_tile}}
     else:
         q_tile, probe_tile = ivf_pq.plan_lut_tiles(
             s.n_probes, s.list_pad, s.pq_dim, s.pq_bits, budget_bytes)
+        per_qp = ivf_pq.lut_bytes_per_query_probe(s.list_pad, s.pq_dim,
+                                                  s.pq_bits)
+        meta = {"family": "ivf_pq", "planner": "ivf_pq.plan_lut_tiles",
+                "predicted_bytes": q_tile * probe_tile * per_qp,
+                "tiles": {"q_tile": q_tile, "probe_tile": probe_tile}}
 
     def core(queries, centers, rotation, codebooks, list_codes,
              list_indices, list_sizes, filter_words):
@@ -227,7 +236,7 @@ def make_ivf_pq_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
             overflow_indices=jnp.zeros((0,), jnp.int32),
             has_overflow=False, probe_tile=probe_tile)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((s.nq, s.dim), np.float32),
         _sds((s.n_lists, s.dim), np.float32),
         _sds((s.rot_dim, s.dim), np.float32),
@@ -236,10 +245,19 @@ def make_ivf_pq_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
         _sds((s.n_lists, s.list_pad), np.int32),
         _sds((s.n_lists,), np.int32),
         _sds((0,), np.uint32))
+    return core, args, meta
 
 
-def make_ivf_pq_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                            shape: Optional[Sift1MCrashShape] = None):
+def make_ivf_pq_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                          shape: Optional[Sift1MCrashShape] = None,
+                          unbounded_variant: bool = False):
+    core, args, _ = make_ivf_pq_lut_core(budget_bytes, shape,
+                                         unbounded_variant)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_pq_cache_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                           shape: Optional[Sift1MCrashShape] = None):
     """The decoded-cache engine at the same shape (bf16 cache)."""
     import jax.numpy as jnp
 
@@ -249,6 +267,10 @@ def make_ivf_pq_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
     s = shape or Sift1MCrashShape()
     q_tile = ivf_pq.plan_cache_tiles(s.n_probes, s.list_pad, s.rot_dim,
                                      budget_bytes)
+    meta = {"family": "ivf_pq", "planner": "ivf_pq.plan_cache_tiles",
+            "predicted_bytes": q_tile * ivf_pq.cache_bytes_per_query(
+                s.n_probes, s.list_pad, s.rot_dim),
+            "tiles": {"q_tile": q_tile}}
 
     def core(queries, centers, rotation, list_decoded, decoded_norms,
              list_indices, list_sizes, filter_words):
@@ -263,7 +285,7 @@ def make_ivf_pq_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
             overflow_indices=jnp.zeros((0,), jnp.int32),
             has_overflow=False)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((s.nq, s.dim), np.float32),
         _sds((s.n_lists, s.dim), np.float32),
         _sds((s.rot_dim, s.dim), np.float32),
@@ -272,11 +294,18 @@ def make_ivf_pq_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
         _sds((s.n_lists, s.list_pad), np.int32),
         _sds((s.n_lists,), np.int32),
         _sds((0,), np.uint32))
+    return core, args, meta
 
 
-def make_ivf_pq_encode_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                             shape: Optional[Sift1MCrashShape] = None,
-                             n_rows: int = 1_000_000):
+def make_ivf_pq_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                            shape: Optional[Sift1MCrashShape] = None):
+    core, args, _ = make_ivf_pq_cache_core(budget_bytes, shape)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_pq_encode_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                            shape: Optional[Sift1MCrashShape] = None,
+                            n_rows: int = 1_000_000):
     """The build/extend residual-encode core (``encode_batch``'s row_tile
     solve) at the 1M build shape."""
     from raft_tpu.neighbors import ivf_pq
@@ -284,21 +313,31 @@ def make_ivf_pq_encode_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
     s = shape or Sift1MCrashShape()
     row_tile = int(np.clip(
         budget_bytes // max(s.pq_dim * s.book * 4 * 4, 1), 8, 4096))
+    meta = {"family": "ivf_pq", "planner": None, "predicted_bytes": None,
+            "tiles": {"row_tile": row_tile}}
 
     def core(x, labels, centers, rotation, codebooks):
         return ivf_pq.encode_core(x, labels, centers, rotation, codebooks,
                                   per_cluster=False, row_tile=row_tile)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((n_rows, s.dim), np.float32),
         _sds((n_rows,), np.int32),
         _sds((s.n_lists, s.dim), np.float32),
         _sds((s.rot_dim, s.dim), np.float32),
         _sds((s.pq_dim, s.book, s.pq_len), np.float32))
+    return core, args, meta
 
 
-def make_ivf_flat_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                        shape: Optional[Sift1MCrashShape] = None):
+def make_ivf_pq_encode_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                             shape: Optional[Sift1MCrashShape] = None,
+                             n_rows: int = 1_000_000):
+    core, args, _ = make_ivf_pq_encode_core(budget_bytes, shape, n_rows)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_flat_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                       shape: Optional[Sift1MCrashShape] = None):
     """ivf_flat search core at the 1M shape (raw fp32 lists)."""
     import jax.numpy as jnp
 
@@ -308,6 +347,10 @@ def make_ivf_flat_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
     s = shape or Sift1MCrashShape()
     q_tile = ivf_flat.plan_scan_tiles(s.n_probes, s.list_pad, s.dim,
                                       budget_bytes)
+    meta = {"family": "ivf_flat", "planner": "ivf_flat.plan_scan_tiles",
+            "predicted_bytes": q_tile * ivf_flat.scan_bytes_per_query(
+                s.n_probes, s.list_pad, s.dim),
+            "tiles": {"q_tile": q_tile}}
 
     def core(queries, centers, list_data, list_indices, list_sizes,
              filter_words):
@@ -321,18 +364,25 @@ def make_ivf_flat_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
             overflow_indices=jnp.zeros((0,), jnp.int32),
             has_overflow=False)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((s.nq, s.dim), np.float32),
         _sds((s.n_lists, s.dim), np.float32),
         _sds((s.n_lists, s.list_pad, s.dim), np.float32),
         _sds((s.n_lists, s.list_pad), np.int32),
         _sds((s.n_lists,), np.int32),
         _sds((0,), np.uint32))
+    return core, args, meta
 
 
-def make_brute_force_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                           n_db: int = 1_000_000, nq: int = 10_000,
-                           dim: int = 128, k: int = 100):
+def make_ivf_flat_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                        shape: Optional[Sift1MCrashShape] = None):
+    core, args, _ = make_ivf_flat_core(budget_bytes, shape)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_brute_force_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                          n_db: int = 1_000_000, nq: int = 10_000,
+                          dim: int = 128, k: int = 100):
     """brute_force exact kNN at 1M×128 with tiles from the public plan."""
     import jax.numpy as jnp
 
@@ -341,6 +391,10 @@ def make_brute_force_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
 
     q_tile, db_tile = brute_force.choose_tiles(nq, n_db, dim, k,
                                                budget_bytes)
+    meta = {"family": "brute_force", "planner": "brute_force.choose_tiles",
+            "predicted_bytes": brute_force.planned_peak_bytes(
+                nq, n_db, dim, k, budget_bytes),
+            "tiles": {"q_tile": q_tile, "db_tile": db_tile}}
 
     def core(queries, dataset, db_norms):
         return brute_force.knn_core(
@@ -349,34 +403,127 @@ def make_brute_force_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
             has_filter=False, fast_scan=False, refine_mult=1,
             select_recall=1.0)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((nq, dim), np.float32),
         _sds((n_db, dim), np.float32),
         _sds((n_db,), np.float32))
+    return core, args, meta
+
+
+def make_brute_force_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                           n_db: int = 1_000_000, nq: int = 10_000,
+                           dim: int = 128, k: int = 100):
+    core, args, _ = make_brute_force_core(budget_bytes, n_db, nq, dim, k)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_select_k_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                       rows: int = 1024, width: int = 65536, k: int = 64):
+    """matrix::select_k at a serving-scale [rows, width] board."""
+    from raft_tpu.ops.select_k import select_k
+
+    meta = {"family": "select_k", "planner": None, "predicted_bytes": None,
+            "tiles": {}}
+    return (lambda v: select_k(v, k)), (_sds((rows, width), np.float32),), \
+        meta
 
 
 def make_select_k_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
                         rows: int = 1024, width: int = 65536, k: int = 64):
-    """matrix::select_k at a serving-scale [rows, width] board."""
-    from raft_tpu.ops.select_k import select_k
-
-    return jax.make_jaxpr(lambda v: select_k(v, k))(
-        _sds((rows, width), np.float32))
+    core, args, _ = make_select_k_core(budget_bytes, rows, width, k)
+    return jax.make_jaxpr(core)(*args)
 
 
-def make_fused_l2_nn_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
-                           m: int = 100_000, n: int = 4096, dim: int = 128):
+def make_fused_l2_nn_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                          m: int = 100_000, n: int = 4096, dim: int = 128):
     """fused_l2_nn_argmin with its row tile solved from the budget."""
     from raft_tpu.ops import fused_l2_nn as fl
 
     tile = fl.choose_tile_rows(m, n, budget_bytes)
+    meta = {"family": "fused_l2_nn",
+            "planner": "fused_l2_nn.choose_tile_rows",
+            "predicted_bytes": fl.planned_peak_bytes(m, n, budget_bytes),
+            "tiles": {"row_tile": tile}}
 
     def core(x, y, xn, yn):
         return fl.fused_l2_nn_core.__wrapped__(x, y, xn, yn, False, tile)
 
-    return jax.make_jaxpr(core)(
+    args = (
         _sds((m, dim), np.float32), _sds((n, dim), np.float32),
         _sds((m,), np.float32), _sds((n,), np.float32))
+    return core, args, meta
+
+
+def make_fused_l2_nn_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                           m: int = 100_000, n: int = 4096, dim: int = 128):
+    core, args, _ = make_fused_l2_nn_core(budget_bytes, m, n, dim)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_cagra_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                    n: int = 1_000_000, nq: int = 1024, dim: int = 128,
+                    graph_degree: int = 64, k: int = 10, itopk: int = 64,
+                    width: int = 1):
+    """cagra greedy graph search at the 1M shape (graph_degree=64,
+    itopk=64, width=1 — the IndexParams/SearchParams defaults). No byte
+    planner: the beam state is O(nq·itopk), shape-independent of n, so
+    there is nothing for a workspace solver to tile. Not part of the
+    seven audited entries (the walker's upper bound over a 74-iteration
+    while_loop is vacuous); it exists for the compiled-cost layer, which
+    needs all four ANN families in the roofline report."""
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops.distance import DistanceType
+
+    max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    n_seeds = min(max(itopk, 32), n)
+    meta = {"family": "cagra", "planner": None, "predicted_bytes": None,
+            "tiles": {"itopk": itopk, "width": width,
+                      "max_iter": max_iter}}
+
+    def core(queries, dataset, graph, seed_ids, filter_words):
+        return cagra.search_core.__wrapped__(
+            queries, dataset, dataset, graph, seed_ids, filter_words,
+            DistanceType.L2Expanded, k, itopk, width, max_iter, False,
+            False)
+
+    args = (
+        _sds((nq, dim), np.float32),
+        _sds((n, dim), np.float32),
+        _sds((n, graph_degree), np.int32),
+        _sds((nq, n_seeds), np.int32),
+        _sds((0,), np.uint32))
+    return core, args, meta
+
+
+def make_cagra_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES, **kw):
+    core, args, _ = make_cagra_core(budget_bytes, **kw)
+    return jax.make_jaxpr(core)(*args)
+
+
+def canonical_cores(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
+    """The seven canonical entrypoints as ``(name, make_core)`` pairs —
+    the SAME names and shapes ``default_entries`` audits, exposed so the
+    compiled-cost layer (:mod:`raft_tpu.obs.costs`) lowers and compiles
+    exactly what the jaxpr walker abstract-evals. ``make_core()`` →
+    ``(core, args, meta)`` with the planner name + predicted workspace
+    bytes in ``meta``."""
+    b = budget_bytes
+    return [
+        ("ivf_pq.search[lut]@sift1m-crash",
+         lambda: make_ivf_pq_lut_core(b)),
+        ("ivf_pq.search[cache]@sift1m",
+         lambda: make_ivf_pq_cache_core(b)),
+        ("ivf_pq.encode_batch@1m",
+         lambda: make_ivf_pq_encode_core(b)),
+        ("ivf_flat.search@1m",
+         lambda: make_ivf_flat_core(b)),
+        ("brute_force.knn@1m",
+         lambda: make_brute_force_core(b)),
+        ("select_k@1024x65536",
+         lambda: make_select_k_core(b)),
+        ("fused_l2_nn@100kx4096",
+         lambda: make_fused_l2_nn_core(b)),
+    ]
 
 
 def default_entries(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:

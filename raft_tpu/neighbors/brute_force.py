@@ -103,6 +103,17 @@ def _choose_tiles(n_queries: int, n_db: int, dim: int, k: int, budget: int
 choose_tiles = _choose_tiles
 
 
+def planned_peak_bytes(n_queries: int, n_db: int, dim: int, k: int,
+                       budget: int) -> int:
+    """The peak live set ``choose_tiles`` believes its solve yields: the
+    whole-dataset pad copy plus the 5 concurrent fp32 distance tiles of
+    the expanded-L2 chain at the planned (q_tile, db_tile). Public so the
+    obs.costs calibration audit can compare this prediction against the
+    compiled ``memory_analysis`` ground truth at the same shape."""
+    q_tile, db_tile = _choose_tiles(n_queries, n_db, dim, k, budget)
+    return n_db * dim * 4 + 5 * q_tile * db_tile * 4
+
+
 #: metrics eligible for the bf16 fast-scan (their scan is one MXU matmul and
 #: their exact distance is recoverable from gathered candidates at refine)
 _FAST_SCAN_METRICS = (

@@ -537,13 +537,22 @@ _search_jit = jax.jit(
 search_core = _search_core
 
 
+def scan_bytes_per_query(n_probes: int, list_pad: int, dim: int) -> int:
+    """TRUE peak live-set bytes of the flat scan per query: the gathered
+    probe tile [P, pad, dim] fp32, ×2 for the distance/score temporaries
+    live with it. The itemized accounting ``plan_scan_tiles`` solves
+    against — public so the obs.costs calibration audit can compare the
+    planner's prediction to the compiled ``memory_analysis`` truth."""
+    return n_probes * list_pad * dim * 4 * 2
+
+
 def plan_scan_tiles(n_probes: int, list_pad: int, dim: int,
                     workspace_limit_bytes: int) -> int:
     """q_tile from the workspace budget: the gathered probe tile is
     [q_tile, n_probes, list_pad, dim] fp32, ×2 for the distance/score
     temporaries that are live with it (shared by ``search`` and the
     graftcheck jaxpr audit, which certifies the solve statically)."""
-    per_q = n_probes * list_pad * dim * 4 * 2
+    per_q = scan_bytes_per_query(n_probes, list_pad, dim)
     q_tile = int(np.clip(workspace_limit_bytes // max(per_q, 1), 1, 1024))
     if q_tile >= 8:
         q_tile -= q_tile % 8

@@ -1193,6 +1193,17 @@ def plan_lut_tiles(n_probes: int, list_pad: int, pq_dim: int, pq_bits: int,
     return q_tile, probe_tile
 
 
+def cache_bytes_per_query(n_probes: int, list_pad: int,
+                          rot_dim: int) -> int:
+    """TRUE peak live-set bytes of the decoded-cache scan per query: the
+    gathered cache tile [P, pad, rot] bf16, its fp32 upcast feeding the
+    MXU einsum, and the fp32 distance/id/mask temporaries. The itemized
+    accounting ``plan_cache_tiles`` solves against — public so the
+    obs.costs calibration audit can compare the planner's prediction to
+    the compiled ``memory_analysis`` ground truth."""
+    return n_probes * list_pad * (rot_dim * 6 + 24)
+
+
 def plan_cache_tiles(n_probes: int, list_pad: int, rot_dim: int,
                      workspace_limit_bytes: int) -> int:
     """q_tile for the decoded-cache engine from the workspace budget: the
@@ -1201,7 +1212,7 @@ def plan_cache_tiles(n_probes: int, list_pad: int, rot_dim: int,
     missed — a 3x undercount caught by the graftcheck jaxpr audit), and the
     fp32 distance/id/mask temporaries (shared by ``search`` and the audit,
     which certifies the solve statically)."""
-    per_q = n_probes * list_pad * (rot_dim * 6 + 24)
+    per_q = cache_bytes_per_query(n_probes, list_pad, rot_dim)
     q_tile = int(np.clip(workspace_limit_bytes // max(per_q, 1), 1, 1024))
     if q_tile >= 8:
         q_tile -= q_tile % 8

@@ -9,8 +9,12 @@ four pieces (docs/observability.md):
   pluggable JSONL/in-memory sinks (stdlib-only);
 - :mod:`~raft_tpu.obs.device` — jax.monitoring compile counters and
   ``profile_session()`` (imports jax lazily);
-- :mod:`~raft_tpu.obs.httpd` — the ``/metrics`` + ``/healthz`` server
-  an Engine exposes.
+- :mod:`~raft_tpu.obs.httpd` — the ``/metrics`` + ``/healthz`` +
+  ``/debug/bundle`` server an Engine exposes;
+- :mod:`~raft_tpu.obs.diagnostics` — flight-recorder bundles (the span
+  tape + registry snapshot + health frozen at a moment of interest);
+- :mod:`~raft_tpu.obs.costs` — compiled-cost roofline reports and the
+  planner calibration audit (imports jax lazily; AOT only).
 
 Layering: obs sits beside ``core`` — serving/parallel/neighbors import
 obs, never the reverse.
@@ -18,20 +22,25 @@ obs, never the reverse.
 
 from raft_tpu.obs.device import (compile_count, compile_seconds,
                                  install_compile_metrics, profile_session)
+from raft_tpu.obs.diagnostics import (build_bundle, load_bundle,
+                                      write_bundle)
 from raft_tpu.obs.httpd import MetricsServer
 from raft_tpu.obs.metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter,
                                   Gauge, Histogram, HistogramSnapshot,
                                   Registry, exponential_buckets)
-from raft_tpu.obs.spans import (JsonlSink, ListSink, NullSink, new_trace_id,
-                                read_jsonl, safe_emit, timed_span)
+from raft_tpu.obs.spans import (JsonlSink, ListSink, NullSink, RingSink,
+                                new_trace_id, read_jsonl, safe_emit,
+                                timed_span)
 
 __all__ = [
     # metrics
     "Counter", "Gauge", "Histogram", "HistogramSnapshot", "Registry",
     "REGISTRY", "DEFAULT_LATENCY_BUCKETS", "exponential_buckets",
     # spans
-    "JsonlSink", "ListSink", "NullSink", "new_trace_id", "read_jsonl",
-    "safe_emit", "timed_span",
+    "JsonlSink", "ListSink", "NullSink", "RingSink", "new_trace_id",
+    "read_jsonl", "safe_emit", "timed_span",
+    # diagnostics (costs is imported explicitly — it compiles)
+    "build_bundle", "write_bundle", "load_bundle",
     # device
     "compile_count", "compile_seconds", "install_compile_metrics",
     "profile_session",

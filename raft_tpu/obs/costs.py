@@ -1,0 +1,325 @@
+"""Compiled-cost roofline reports and planner calibration audit.
+
+graftcheck's Tier B walker (:mod:`raft_tpu.analysis.jaxpr_audit`)
+abstract-evals the canonical entrypoint cores and bounds their live set
+*statically*. This module asks the compiler instead: lower + AOT-compile
+the SAME cores at the SAME shapes (``canonical_cores``) and read XLA's
+own accounting —
+
+- ``compiled.cost_analysis()`` → FLOPs and HBM bytes accessed, which
+  give arithmetic intensity and a roofline placement against the chip's
+  peak FLOP/s and HBM bandwidth (:data:`CHIP_PEAKS`, keyed by
+  ``device_kind``; on CPU or an unknown chip only absolutes are
+  reported);
+- ``compiled.memory_analysis()`` → peak temp (workspace) bytes, the
+  ground truth the tile planners were *predicting* when they solved
+  their tiles. The calibration audit divides each planner's predicted
+  workspace (``meta["predicted_bytes"]`` from the core factory) by the
+  compiled temp bytes and flags any entrypoint whose drift ratio leaves
+  ``[1/tolerance, tolerance]`` — a planner that over-predicts wastes
+  batch size, one that under-predicts re-creates the LUT crash.
+
+Everything here is AOT: no index is built, no input allocated; compiling
+the seven audit cores plus cagra takes seconds on CPU. Consumed by
+``tools/perf_report.py`` (JSON artifact + registry gauges) and
+``tools/graftcheck.py --costs`` (C001 findings vs the baseline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Optional
+
+from raft_tpu.analysis.findings import Finding
+
+COST_RULE = "C001"
+COST_FILE = "cost-calibration"
+
+#: planner-predicted vs compiled workspace drift beyond this ratio
+#: (either direction) raises a C001 finding
+DEFAULT_DRIFT_TOLERANCE = 1.5
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPeaks:
+    """Peak dense-fp32/bf16 throughput + HBM bandwidth for one TPU
+    generation (public spec sheet numbers, per chip)."""
+
+    flops_per_s: float
+    hbm_bytes_per_s: float
+
+    @property
+    def ridge_intensity(self) -> float:
+        """FLOP/byte where the roofline's memory slope meets the compute
+        ceiling; below it a kernel is bandwidth-bound."""
+        return self.flops_per_s / self.hbm_bytes_per_s
+
+
+#: substring of ``jax.devices()[0].device_kind`` → peaks. Matched
+#: longest-substring-first so "v5p" wins over "v5".
+CHIP_PEAKS = {
+    "v6e": ChipPeaks(918e12, 1640e9),
+    "v5p": ChipPeaks(459e12, 2765e9),
+    "v5e": ChipPeaks(197e12, 819e9),
+    "v5 lite": ChipPeaks(197e12, 819e9),
+    "v4": ChipPeaks(275e12, 1228e9),
+    "v3": ChipPeaks(123e12, 900e9),
+    "v2": ChipPeaks(45e12, 700e9),
+}
+
+
+def peaks_for_device_kind(device_kind: str) -> Optional[ChipPeaks]:
+    """Look up :data:`CHIP_PEAKS` by substring (None for CPU/unknown)."""
+    kind = device_kind.lower()
+    for sub in sorted(CHIP_PEAKS, key=len, reverse=True):
+        if sub in kind:
+            return CHIP_PEAKS[sub]
+    return None
+
+
+@dataclasses.dataclass
+class EntryCost:
+    """One entrypoint's compiled-cost record."""
+
+    name: str
+    family: str
+    flops: Optional[float]
+    hbm_bytes: Optional[float]
+    temp_bytes: Optional[int]
+    argument_bytes: Optional[int]
+    output_bytes: Optional[int]
+    compile_s: float
+    planner: Optional[str] = None
+    predicted_bytes: Optional[int] = None
+    tiles: dict = dataclasses.field(default_factory=dict)
+    # roofline placement (None off-TPU / when cost analysis is partial)
+    arithmetic_intensity: Optional[float] = None
+    bound: Optional[str] = None  # "memory" | "compute"
+    peak_utilization: Optional[float] = None
+    min_time_us: Optional[float] = None
+
+    @property
+    def drift_ratio(self) -> Optional[float]:
+        """predicted / compiled workspace; None when either side is
+        missing (no planner, or zero temp)."""
+        if self.predicted_bytes is None or not self.temp_bytes:
+            return None
+        return self.predicted_bytes / self.temp_bytes
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["drift_ratio"] = self.drift_ratio
+        return d
+
+
+def _normalize_cost_analysis(raw) -> dict:
+    """``Compiled.cost_analysis()`` is a dict on newer jax and a
+    one-element list of dicts on older; normalize to the dict (empty
+    when the backend reports nothing)."""
+    if raw is None:
+        return {}
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else {}
+    return dict(raw)
+
+
+def compile_entry(name: str, make_core, backend: Optional[str] = None
+                  ) -> EntryCost:
+    """Lower + compile one ``(core, args, meta)`` factory and extract
+    XLA's cost/memory analysis. Device-agnostic: works on the CPU
+    backend (temp/flops are the CPU compiler's numbers there, still
+    valid calibration ground truth for shape-driven planners)."""
+    import jax
+
+    core, args, meta = make_core()
+    t0 = time.perf_counter()
+    lowered = jax.jit(core, backend=backend).lower(*args)
+    compiled = lowered.compile()
+    compile_s = time.perf_counter() - t0
+
+    cost = _normalize_cost_analysis(
+        _quiet(lambda: compiled.cost_analysis()))
+    flops = cost.get("flops")
+    hbm = cost.get("bytes accessed")
+    mem = _quiet(lambda: compiled.memory_analysis())
+    temp = getattr(mem, "temp_size_in_bytes", None)
+    argb = getattr(mem, "argument_size_in_bytes", None)
+    outb = getattr(mem, "output_size_in_bytes", None)
+
+    return EntryCost(
+        name=name, family=meta.get("family", "unknown"),
+        flops=float(flops) if flops is not None else None,
+        hbm_bytes=float(hbm) if hbm is not None else None,
+        temp_bytes=int(temp) if temp is not None else None,
+        argument_bytes=int(argb) if argb is not None else None,
+        output_bytes=int(outb) if outb is not None else None,
+        compile_s=compile_s,
+        planner=meta.get("planner"),
+        predicted_bytes=meta.get("predicted_bytes"),
+        tiles=dict(meta.get("tiles", {})))
+
+
+def _quiet(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def apply_roofline(entry: EntryCost, peaks: Optional[ChipPeaks]) -> None:
+    """Fill the roofline fields in place. Arithmetic intensity needs
+    only cost_analysis; regime + utilization also need chip peaks."""
+    if entry.flops and entry.hbm_bytes:
+        entry.arithmetic_intensity = entry.flops / entry.hbm_bytes
+    if peaks is None or entry.arithmetic_intensity is None:
+        return
+    ai = entry.arithmetic_intensity
+    if ai < peaks.ridge_intensity:
+        entry.bound = "memory"
+        t = entry.hbm_bytes / peaks.hbm_bytes_per_s
+    else:
+        entry.bound = "compute"
+        t = entry.flops / peaks.flops_per_s
+    entry.min_time_us = t * 1e6
+    # roofline-attainable fraction of the chip's peak FLOP/s at this
+    # intensity: 1.0 on the compute ceiling, AI/ridge on the bandwidth
+    # slope — the "how much MXU can this kernel ever use" number
+    entry.peak_utilization = min(1.0, ai / peaks.ridge_intensity)
+
+
+def default_cost_entries(budget_bytes: Optional[int] = None) -> list:
+    """``(name, make_core)`` pairs for the cost report: the seven audit
+    cores (identical shapes to graftcheck --jaxpr-audit) plus cagra, so
+    the report covers all four ANN families."""
+    from raft_tpu.analysis import jaxpr_audit as ja
+
+    b = budget_bytes if budget_bytes is not None else ja.DEFAULT_BUDGET_BYTES
+    return ja.canonical_cores(b) + [
+        ("cagra.search@1m", lambda: ja.make_cagra_core(b)),
+    ]
+
+
+@dataclasses.dataclass
+class CostReport:
+    """The full report: per-entry costs + the platform they were
+    compiled for."""
+
+    platform: str
+    device_kind: str
+    peaks: Optional[ChipPeaks]
+    entries: list  # of EntryCost
+    budget_bytes: int
+    drift_tolerance: float = DEFAULT_DRIFT_TOLERANCE
+
+    def calibration_findings(self) -> list:
+        """One C001 :class:`Finding` per planner whose drift ratio
+        leaves ``[1/tol, tol]`` — keyed by entry name so the graftcheck
+        baseline can carry a justification."""
+        out = []
+        tol = self.drift_tolerance
+        for e in self.entries:
+            r = e.drift_ratio
+            if r is None or e.planner is None:
+                continue
+            if 1.0 / tol <= r <= tol:
+                continue
+            side = "over" if r > 1 else "under"
+            out.append(Finding(
+                COST_RULE, COST_FILE, e.name, 0,
+                f"planner {e.planner} {side}-predicts workspace: "
+                f"predicted {e.predicted_bytes / 2**20:.0f} MiB vs "
+                f"compiled temp {e.temp_bytes / 2**20:.0f} MiB "
+                f"(ratio {r:.2f}, tolerance {tol:g}x)"))
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": "raft_tpu.perf_report/v1",
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "peaks": dataclasses.asdict(self.peaks) if self.peaks else None,
+            "budget_bytes": self.budget_bytes,
+            "drift_tolerance": self.drift_tolerance,
+            "entries": [e.to_dict() for e in self.entries],
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=True)
+
+    def format(self) -> str:
+        lines = [f"perf report — platform={self.platform} "
+                 f"device_kind={self.device_kind!r}"]
+        for e in self.entries:
+            fl = f"{e.flops / 1e9:.2f} GFLOP" if e.flops else "?"
+            hb = f"{e.hbm_bytes / 2**20:.0f} MiB" if e.hbm_bytes else "?"
+            tp = (f"{e.temp_bytes / 2**20:.0f} MiB"
+                  if e.temp_bytes is not None else "?")
+            line = f"  {e.name}: {fl}, {hb} accessed, temp {tp}"
+            if e.arithmetic_intensity is not None:
+                line += f", AI {e.arithmetic_intensity:.1f}"
+            if e.bound:
+                line += (f" [{e.bound}-bound, "
+                         f"min {e.min_time_us:.0f} us]")
+            r = e.drift_ratio
+            if r is not None:
+                line += f", planner drift {r:.2f}x"
+            lines.append(line)
+        return "\n".join(lines)
+
+
+def build_report(budget_bytes: Optional[int] = None,
+                 entries: Optional[list] = None,
+                 drift_tolerance: float = DEFAULT_DRIFT_TOLERANCE,
+                 backend: Optional[str] = None) -> CostReport:
+    """Compile every cost entry and assemble the :class:`CostReport`."""
+    import jax
+
+    from raft_tpu.analysis import jaxpr_audit as ja
+
+    b = budget_bytes if budget_bytes is not None else ja.DEFAULT_BUDGET_BYTES
+    pairs = default_cost_entries(b) if entries is None else entries
+    dev = jax.devices(backend)[0] if backend else jax.devices()[0]
+    device_kind = getattr(dev, "device_kind", "unknown")
+    platform = getattr(dev, "platform", "unknown")
+    peaks = peaks_for_device_kind(device_kind)
+    out = []
+    for name, make_core in pairs:
+        e = compile_entry(name, make_core, backend=backend)
+        apply_roofline(e, peaks)
+        out.append(e)
+    return CostReport(platform=platform, device_kind=device_kind,
+                      peaks=peaks, entries=out, budget_bytes=b,
+                      drift_tolerance=drift_tolerance)
+
+
+def export_gauges(report: CostReport, registry=None) -> None:
+    """Mirror the report into registry gauges so a scrape shows the
+    compiled-cost picture next to the serving metrics."""
+    from raft_tpu.obs import metrics as m
+
+    reg = registry if registry is not None else m.REGISTRY
+    flops = reg.gauge("raft_tpu_cost_flops",
+                      "XLA cost_analysis FLOPs per canonical entrypoint",
+                      labelnames=("entry",))
+    hbm = reg.gauge("raft_tpu_cost_hbm_bytes",
+                    "XLA cost_analysis bytes accessed per entrypoint",
+                    labelnames=("entry",))
+    temp = reg.gauge("raft_tpu_cost_temp_bytes",
+                     "compiled peak temp (workspace) bytes per entrypoint",
+                     labelnames=("entry",))
+    drift = reg.gauge(
+        "raft_tpu_planner_drift_ratio",
+        "planner-predicted / compiled workspace bytes per entrypoint",
+        labelnames=("entry", "planner"))
+    for e in report.entries:
+        if e.flops is not None:
+            flops.labels(e.name).set(e.flops)
+        if e.hbm_bytes is not None:
+            hbm.labels(e.name).set(e.hbm_bytes)
+        if e.temp_bytes is not None:
+            temp.labels(e.name).set(e.temp_bytes)
+        r = e.drift_ratio
+        if r is not None and e.planner:
+            drift.labels(e.name, e.planner).set(r)

@@ -14,6 +14,10 @@ Routes:
 - ``GET /healthz``  → JSON health doc; 200 for ``ok``/``degraded``
   (alive but shedding is still alive), 503 for anything else — the
   TPU_RUNBOOK pre-flight curls this before pointing traffic at a host.
+- ``GET /debug/bundle`` → a freshly-built flight-recorder diagnostics
+  bundle (``bundle_fn``, typically ``Engine.dump_diagnostics`` — the
+  span tape + registry snapshot + health + config in one JSON doc);
+  404 when no ``bundle_fn`` is wired.
 - anything else → 404.
 """
 
@@ -39,10 +43,12 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[_metrics.Registry] = None,
-                 health_fn: Optional[Callable[[], dict]] = None) -> None:
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 bundle_fn: Optional[Callable[[], dict]] = None) -> None:
         self._registry = registry if registry is not None else \
             _metrics.REGISTRY
         self._health_fn = health_fn
+        self._bundle_fn = bundle_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -92,6 +98,16 @@ class MetricsServer:
                                    json.dumps(doc, sort_keys=True).encode())
                     elif path == "/healthz":
                         self._do_healthz()
+                    elif path == "/debug/bundle":
+                        if server._bundle_fn is None:
+                            self._send(404, "text/plain",
+                                       b"no flight recorder wired\n")
+                        else:
+                            doc = server._bundle_fn()
+                            self._send(200, "application/json",
+                                       (json.dumps(doc, sort_keys=True,
+                                                   default=str)
+                                        + "\n").encode())
                     else:
                         self._send(404, "text/plain", b"not found\n")
                 except BrokenPipeError:

@@ -20,6 +20,7 @@ default registry).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -34,6 +35,7 @@ __all__ = [
     "JsonlSink",
     "ListSink",
     "NullSink",
+    "RingSink",
     "safe_emit",
     "timed_span",
     "read_jsonl",
@@ -84,6 +86,62 @@ class ListSink:
     def __len__(self) -> int:
         with self._lock:
             return len(self._records)
+
+
+class RingSink:
+    """Bounded in-memory ring of the last ``capacity`` span records —
+    the flight recorder's tape. Unlike :class:`ListSink` it can run
+    forever in a serving process: memory is O(capacity) no matter how
+    many spans flow through. ``emit`` is a deque append under a lock
+    (the deque's own maxlen does the eviction), cheap enough to tee
+    every engine span through unconditionally.
+
+    Optionally tees to ``inner`` (the user's configured sink) so
+    installing the recorder never displaces existing telemetry; the
+    inner emit rides through :func:`safe_emit` and cannot poison the
+    ring."""
+
+    def __init__(self, capacity: int = 512, inner=None) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.inner = inner
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._emitted = 0
+
+    def emit(self, record: dict) -> None:
+        with self._lock:
+            self._ring.append(record)
+            self._emitted += 1
+        if self.inner is not None:
+            safe_emit(self.inner, record)
+
+    @property
+    def records(self) -> List[dict]:
+        """Oldest-first copy of the tape."""
+        with self._lock:
+            return list(self._ring)
+
+    @property
+    def emitted(self) -> int:
+        """Total records ever emitted (dropped ones included)."""
+        with self._lock:
+            return self._emitted
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._emitted - len(self._ring))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
 
 
 class JsonlSink:

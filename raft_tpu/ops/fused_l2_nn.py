@@ -32,6 +32,13 @@ def choose_tile_rows(m: int, n: int, budget_bytes: int) -> int:
     return balanced_tile(m, tile, 128)
 
 
+def planned_peak_bytes(m: int, n: int, budget_bytes: int) -> int:
+    """The peak live set ``choose_tile_rows`` solves for: ~8 concurrent
+    fp32 [tile, n] intermediates of the expanded-L2 + argmin chain at the
+    planned row tile (public for the obs.costs calibration audit)."""
+    return choose_tile_rows(m, n, budget_bytes) * max(n, 1) * 8 * 4
+
+
 @functools.partial(jax.jit, static_argnames=("sqrt", "tile"))
 def _fused_l2_nn_jit(x, y, x_norms, y_norms, sqrt: bool, tile: int):
     m, k = x.shape

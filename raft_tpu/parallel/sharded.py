@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Optional, Tuple
 
@@ -31,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import spans as obs_spans
 from raft_tpu.ops.distance import DistanceType, resolve_metric, pairwise_core
 from raft_tpu.ops.select_k import refine_multiplier, select_k
 from raft_tpu.parallel.comms import Comms
@@ -52,6 +54,94 @@ _CKPT_RESTORES = obs_metrics.REGISTRY.counter(
     "raft_tpu_checkpoint_restore_total",
     "Sharded checkpoint restores by kind and coverage mode.",
     ("kind", "mode"))
+
+# ---- per-shard trace spans (docs/observability.md "Sharded search
+# spans"): a module-level sink, installed by set_span_sink. With no sink
+# (the default) every search entrypoint runs its usual single fused SPMD
+# program — zero overhead, zero behavior change. With a sink installed,
+# the same local cores run in a two-phase dispatch: phase A is the
+# shard_map local scan WITHOUT the in-program merge (per-shard [nq, kk]
+# candidates stay sharded), each shard is fenced in rank order to emit a
+# per-shard child span (rank, device, readback-order completion ms),
+# and phase B merges host-gathered candidates via ``_elastic_merge`` —
+# bit-identical math to the in-program allgather merge (rank-order
+# concat along the candidate axis feeding the same deterministic
+# select_k), pinned by tests/test_parallel.py.
+_SPAN_SINK_LOCK = threading.Lock()
+_SPAN_SINK: Optional[object] = None
+
+
+def set_span_sink(sink: Optional[object]) -> Optional[object]:
+    """Install (or clear, with None) the sharded-search span sink.
+    Anything with ``emit(dict)`` works (:class:`raft_tpu.obs.RingSink`,
+    :class:`~raft_tpu.obs.JsonlSink`, ...). Returns the previous sink
+    so callers can restore it."""
+    global _SPAN_SINK
+    with _SPAN_SINK_LOCK:
+        prev, _SPAN_SINK = _SPAN_SINK, sink
+    return prev
+
+
+def _span_sink() -> Optional[object]:
+    with _SPAN_SINK_LOCK:
+        return _SPAN_SINK
+
+
+def _instrumented_search(comms: Comms, local_scan, in_specs, args,
+                         family: str, nq: int, k_eff: int,
+                         minimize: bool, sink) -> Tuple[jax.Array,
+                                                        jax.Array]:
+    """Two-phase sharded search with per-shard child spans.
+
+    ``local_scan`` is the entrypoint's per-device scan (returns the
+    [nq, kk] local candidates WITHOUT the merge). Phase A runs it under
+    shard_map with the candidates left sharded [S, nq, kk]; each shard
+    is then fenced in rank order (``shard_search`` child spans — since
+    the dispatch is one SPMD program, all shards compute concurrently
+    and ``device_ms`` is each shard's completion lag in readback order,
+    the per-rank skew signal). Phase B merges on the default device via
+    :func:`_elastic_merge` and emits the parent ``sharded_search`` span
+    carrying launch/merge/total wall time under the minted trace id."""
+    ax = comms.axis
+    trace_id = obs_spans.new_trace_id()
+    t0 = time.perf_counter()
+
+    def expanded(*a):
+        v, i = local_scan(*a)
+        return v[None], i[None]
+
+    fn = comms.run(expanded, in_specs,
+                   (P(ax, None, None), P(ax, None, None)))
+    v, i = jax.jit(fn)(*args)
+    t_launch = time.perf_counter()
+    by_rank_i = {s.index[0].start or 0: s for s in i.addressable_shards}
+    v_parts, i_parts = [], []
+    for sh in sorted(v.addressable_shards,
+                     key=lambda s: s.index[0].start or 0):
+        rank = int(sh.index[0].start or 0)
+        ts = time.perf_counter()
+        v_np = np.asarray(sh.data)  # graftcheck: R001 — the fence
+        i_np = np.asarray(by_rank_i[rank].data)  # graftcheck: R001
+        obs_spans.safe_emit(sink, {
+            "kind": "shard_search", "trace_id": trace_id,
+            "family": family, "rank": rank, "device": str(sh.device),
+            "device_ms": round((time.perf_counter() - ts) * 1e3, 3)})
+        v_parts.append(v_np)
+        i_parts.append(i_np)
+    t_merge = time.perf_counter()
+    vm, im = _elastic_merge(
+        jnp.asarray(np.concatenate(v_parts, axis=0)),
+        jnp.asarray(np.concatenate(i_parts, axis=0)),
+        nq, k_eff, minimize)
+    jax.block_until_ready((vm, im))
+    t_end = time.perf_counter()
+    obs_spans.safe_emit(sink, {
+        "kind": "sharded_search", "trace_id": trace_id, "family": family,
+        "n_shards": len(v_parts),
+        "launch_ms": round((t_launch - t0) * 1e3, 3),
+        "merge_ms": round((t_end - t_merge) * 1e3, 3),
+        "total_ms": round((t_end - t0) * 1e3, 3)})
+    return vm, im
 
 
 # ------------------------------------------------- shard build orchestration
@@ -272,7 +362,9 @@ def knn(
     x = comms.shard(dataset, P(comms.axis, None))
     q = comms.shard(queries, P(None, None))
 
-    def local(q_rep, x_loc):
+    kk = min(k, shard)
+
+    def local_scan(q_rep, x_loc):
         rank = comms.rank()
         base = rank * shard
         d = pairwise_core(q_rep, x_loc, m, 2.0, 1 << 30)
@@ -280,9 +372,19 @@ def knn(
         local_ids = jnp.arange(shard) + base
         d = jnp.where(local_ids[None, :] < n, d,
                       jnp.inf if minimize else -jnp.inf)
-        kk = min(k, shard)
         v, i = select_k(d, kk, select_min=minimize)
         gids = (i + base).astype(jnp.int32)
+        return v, gids
+
+    in_specs = (P(None, None), P(comms.axis, None))
+    sink = _span_sink()
+    if sink is not None:
+        return _instrumented_search(
+            comms, local_scan, in_specs, (q, x), "brute_force",
+            queries.shape[0], min(k, size * kk), minimize, sink)
+
+    def local(q_rep, x_loc):
+        v, gids = local_scan(q_rep, x_loc)
         # merge across ranks: gather all shards' candidates, re-select
         v_all = comms.allgather(v, axis=1)  # [nq, size*kk]
         g_all = comms.allgather(gids, axis=1)
@@ -290,8 +392,7 @@ def knn(
         im = jnp.take_along_axis(g_all, sel, axis=1)
         return vm, im
 
-    fn = comms.run(local, (P(None, None), P(comms.axis, None)),
-                   (P(None, None), P(None, None)))
+    fn = comms.run(local, in_specs, (P(None, None), P(None, None)))
     return jax.jit(fn)(q, x)
 
 
@@ -529,7 +630,7 @@ def search_cagra(
         if index.datasets.dtype != jnp.float32:
             raise ValueError("scan_dtype requires an fp32 dataset")
 
-    def local(q_rep, ds, sds, gr, n_valid, b):
+    def local_scan(q_rep, ds, sds, gr, n_valid, b):
         # per-shard seeds within the shard's valid rows
         rank = comms.rank()
         seeds = jax.random.randint(
@@ -542,23 +643,29 @@ def search_cagra(
         pad_hit = (i < 0) | (i >= n_valid[0])
         gid = jnp.where(pad_hit, -1, i + b[0])
         v = jnp.where(pad_hit, jnp.inf if minimize else -jnp.inf, v)
+        return v, gid
+
+    def local(q_rep, ds, sds, gr, n_valid, b):
+        v, gid = local_scan(q_rep, ds, sds, gr, n_valid, b)
         v_all = comms.allgather(v, axis=1)
         g_all = comms.allgather(gid, axis=1)
         vm, sel = select_k(v_all, int(k), select_min=minimize)
         return vm, jnp.take_along_axis(g_all, sel, axis=1)
 
     ax = comms.axis
-    fn = comms.run(
-        local,
-        (P(None, None), P(ax, None, None), P(ax, None, None),
-         P(ax, None, None), P(ax), P(ax)),
-        (P(None, None), P(None, None)))
+    in_specs = (P(None, None), P(ax, None, None), P(ax, None, None),
+                P(ax, None, None), P(ax), P(ax))
     q = comms.shard(queries, P(None, None))
     # bf16 scan copies are cached on the index (one cast, reused per search)
     scan_ds = index.ensure_scan_datasets() if fast_scan else index.datasets
-    return jax.jit(fn)(q, index.datasets, scan_ds, index.graphs,
-                       comms.shard(shard_rows, P(ax)),
-                       comms.shard(base, P(ax)))
+    args = (q, index.datasets, scan_ds, index.graphs,
+            comms.shard(shard_rows, P(ax)), comms.shard(base, P(ax)))
+    sink = _span_sink()
+    if sink is not None:
+        return _instrumented_search(comms, local_scan, in_specs, args,
+                                    "cagra", nq, int(k), minimize, sink)
+    fn = comms.run(local, in_specs, (P(None, None), P(None, None)))
+    return jax.jit(fn)(*args)
 
 
 # --------------------------------------------------- sharded ivf_flat search
@@ -996,56 +1103,57 @@ def search_ivf_pq(
         return dict(overflow_decoded=od[0], overflow_norms=on[0],
                     overflow_indices=oi[0], has_overflow=True)
 
+    q = comms.shard(queries, P(None, None))
+
     if mode == "cache":
         q_tile, _ = _pq_tiles("cache", n_probes, res, index.list_decoded,
                               index.list_codes, index.pq_dim, index.pq_bits)
 
-        def local(q_rep, c, ro, ld, dn, li, ls, *over):
-            v, i = ivf_pq.search_cache_core(
+        def local_scan(q_rep, c, ro, ld, dn, li, ls, *over):
+            return ivf_pq.search_cache_core(
                 q_rep, c[0], ro[0], ld[0], dn[0], li[0], ls[0], empty_filter,
                 index.metric, int(k), n_probes, q_tile, False,
                 select_recall=select_recall, **unpack_over(over))
-            return merge(v, i)
 
-        fn = comms.run(
-            local,
-            (P(None, None), P(ax, None, None), P(ax, None, None),
-             P(ax, None, None, None), P(ax, None, None), P(ax, None, None),
-             P(ax, None)) + over_specs,
-            (P(None, None), P(None, None)))
-        q = comms.shard(queries, P(None, None))
-        return jax.jit(fn)(q, index.centers, index.rotation,
-                           index.list_decoded, index.decoded_norms,
-                           index.list_indices, index.list_sizes, *over_ops)
+        in_specs = (P(None, None), P(ax, None, None), P(ax, None, None),
+                    P(ax, None, None, None), P(ax, None, None),
+                    P(ax, None, None), P(ax, None)) + over_specs
+        args = (q, index.centers, index.rotation, index.list_decoded,
+                index.decoded_norms, index.list_indices, index.list_sizes,
+                *over_ops)
+    else:
+        # LUT engine: packed codes only (the DEEP-100M/8 memory-lean shape)
+        q_tile, probe_tile = _pq_tiles(
+            "lut", n_probes, res, index.list_decoded, index.list_codes,
+            index.pq_dim, index.pq_bits,
+            jnp.dtype(params.lut_dtype).itemsize,
+            jnp.dtype(params.internal_distance_dtype).itemsize)
+        lut_dtype = jnp.dtype(params.lut_dtype).name
+        dist_dtype = jnp.dtype(params.internal_distance_dtype).name
 
-    # LUT engine: packed codes only (the DEEP-100M/8 memory-lean shape)
-    q_tile, probe_tile = _pq_tiles(
-        "lut", n_probes, res, index.list_decoded, index.list_codes,
-        index.pq_dim, index.pq_bits,
-        jnp.dtype(params.lut_dtype).itemsize,
-        jnp.dtype(params.internal_distance_dtype).itemsize)
-    lut_dtype = jnp.dtype(params.lut_dtype).name
-    dist_dtype = jnp.dtype(params.internal_distance_dtype).name
+        def local_scan(q_rep, c, ro, cb, lc, li, ls, *over):
+            return ivf_pq.search_lut_core(
+                q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
+                index.metric, int(k), n_probes, q_tile, index.per_cluster,
+                index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
+                select_recall=select_recall, probe_tile=probe_tile,
+                **unpack_over(over))
 
-    def local(q_rep, c, ro, cb, lc, li, ls, *over):
-        v, i = ivf_pq.search_lut_core(
-            q_rep, c[0], ro[0], cb[0], lc[0], li[0], ls[0], empty_filter,
-            index.metric, int(k), n_probes, q_tile, index.per_cluster,
-            index.pq_dim, index.pq_bits, False, lut_dtype, dist_dtype,
-            select_recall=select_recall, probe_tile=probe_tile,
-            **unpack_over(over))
-        return merge(v, i)
+        in_specs = (P(None, None), P(ax, None, None), P(ax, None, None),
+                    P(ax, None, None, None), P(ax, None, None, None),
+                    P(ax, None, None), P(ax, None)) + over_specs
+        args = (q, index.centers, index.rotation, index.codebooks,
+                index.list_codes, index.list_indices, index.list_sizes,
+                *over_ops)
 
-    fn = comms.run(
-        local,
-        (P(None, None), P(ax, None, None), P(ax, None, None),
-         P(ax, None, None, None), P(ax, None, None, None),
-         P(ax, None, None), P(ax, None)) + over_specs,
-        (P(None, None), P(None, None)))
-    q = comms.shard(queries, P(None, None))
-    return jax.jit(fn)(q, index.centers, index.rotation, index.codebooks,
-                       index.list_codes, index.list_indices,
-                       index.list_sizes, *over_ops)
+    sink = _span_sink()
+    if sink is not None:
+        return _instrumented_search(comms, local_scan, in_specs, args,
+                                    "ivf_pq", queries.shape[0], int(k),
+                                    minimize, sink)
+    fn = comms.run(lambda *a: merge(*local_scan(*a)),
+                   in_specs, (P(None, None), P(None, None)))
+    return jax.jit(fn)(*args)
 
 
 @tracing.range("sharded.search_ivf_flat")
@@ -1097,43 +1205,43 @@ def search_ivf_flat(
         return vm, jnp.take_along_axis(i_all, sel, axis=1)
 
     ax = comms.axis
+    q = comms.shard(queries, P(None, None))
     if has_overflow:
         # each device scans its own spill block alongside its probed lists
-        def local(q_rep, c, ld, li, ls, od, oi):
-            v, i = ivf_flat.search_core(
+        def local_scan(q_rep, c, ld, li, ls, od, oi):
+            return ivf_flat.search_core(
                 q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
                 int(k), n_probes, q_tile, False, fast_scan=fast_scan,
                 overflow_data=od[0], overflow_indices=oi[0],
                 has_overflow=True, select_recall=select_recall,
                 refine_mult=refine_mult)
-            return merge(v, i)
 
-        fn = comms.run(
-            local,
-            (P(None, None), P(ax, None, None), P(ax, None, None, None),
-             P(ax, None, None), P(ax, None), P(ax, None, None),
-             P(ax, None)),
-            (P(None, None), P(None, None)))
-        q = comms.shard(queries, P(None, None))
-        return jax.jit(fn)(q, index.centers, index.list_data,
-                           index.list_indices, index.list_sizes,
-                           index.overflow_data, index.overflow_indices)
+        in_specs = (P(None, None), P(ax, None, None),
+                    P(ax, None, None, None), P(ax, None, None), P(ax, None),
+                    P(ax, None, None), P(ax, None))
+        args = (q, index.centers, index.list_data, index.list_indices,
+                index.list_sizes, index.overflow_data,
+                index.overflow_indices)
+    else:
+        def local_scan(q_rep, c, ld, li, ls):
+            return ivf_flat.search_core(
+                q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
+                int(k), n_probes, q_tile, False, fast_scan=fast_scan,
+                select_recall=select_recall, refine_mult=refine_mult)
 
-    def local(q_rep, c, ld, li, ls):
-        v, i = ivf_flat.search_core(
-            q_rep, c[0], ld[0], li[0], ls[0], empty_filter, index.metric,
-            int(k), n_probes, q_tile, False, fast_scan=fast_scan,
-            select_recall=select_recall, refine_mult=refine_mult)
-        return merge(v, i)
+        in_specs = (P(None, None), P(ax, None, None),
+                    P(ax, None, None, None), P(ax, None, None), P(ax, None))
+        args = (q, index.centers, index.list_data, index.list_indices,
+                index.list_sizes)
 
-    fn = comms.run(
-        local,
-        (P(None, None), P(ax, None, None), P(ax, None, None, None),
-         P(ax, None, None), P(ax, None)),
-        (P(None, None), P(None, None)))
-    q = comms.shard(queries, P(None, None))
-    return jax.jit(fn)(q, index.centers, index.list_data, index.list_indices,
-                       index.list_sizes)
+    sink = _span_sink()
+    if sink is not None:
+        return _instrumented_search(comms, local_scan, in_specs, args,
+                                    "ivf_flat", queries.shape[0], int(k),
+                                    minimize, sink)
+    fn = comms.run(lambda *a: merge(*local_scan(*a)),
+                   in_specs, (P(None, None), P(None, None)))
+    return jax.jit(fn)(*args)
 
 
 # ------------------------------------------------------------- persistence

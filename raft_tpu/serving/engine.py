@@ -86,6 +86,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from raft_tpu.obs import device as obs_device
+from raft_tpu.obs import diagnostics as obs_diagnostics
 from raft_tpu.obs import spans as obs_spans
 from raft_tpu.obs.httpd import MetricsServer
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
@@ -249,6 +250,18 @@ class EngineConfig:
     metrics_host: str = "127.0.0.1"
     registry: Optional[object] = None
     deadline_budget_ms: Optional[float] = None
+    # ---- flight recorder (docs/observability.md "Flight recorder"):
+    # a bounded RingSink tape of the last N span records, on by default
+    # (O(capacity) memory, a deque append per span). On a watchdog hang
+    # or a breaker trip the engine freezes the tape + registry snapshot
+    # + health into a diagnostics bundle; ``diagnostics_dir`` (None
+    # keeps bundles in memory only, see ``Engine.last_diagnostics``)
+    # makes auto-dumps land on disk. ``diagnostics_min_interval_s``
+    # rate-limits auto-dumps so a flapping breaker can't spam bundles.
+    flight_recorder: bool = True
+    flight_recorder_capacity: int = 512
+    diagnostics_dir: Optional[str] = None
+    diagnostics_min_interval_s: float = 30.0
 
 
 def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -308,7 +321,18 @@ class Engine:
         self._stopped = False
         self.warmup_info: dict = {}
         # ---- telemetry (docs/observability.md)
-        self._span_sink = cfg.span_sink
+        self._flight_ring: Optional[obs_spans.RingSink] = None
+        if cfg.flight_recorder:
+            # the tape tees to the user's sink, so installing the
+            # recorder never displaces configured telemetry
+            self._flight_ring = obs_spans.RingSink(
+                cfg.flight_recorder_capacity, inner=cfg.span_sink)
+            self._span_sink = self._flight_ring
+        else:
+            self._span_sink = cfg.span_sink
+        self.last_diagnostics: Optional[dict] = None
+        self._last_dump_t: Optional[float] = None
+        self._dump_lock = threading.Lock()
         self._batch_seq = itertools.count(1)
         self._searcher_gen = 0
         self.metrics_server: Optional[MetricsServer] = None
@@ -404,14 +428,17 @@ class Engine:
     def serve_metrics(self, port: int = 0,
                       host: str = "127.0.0.1") -> MetricsServer:
         """Expose this engine's registry at ``/metrics`` (Prometheus
-        text), ``/metrics.json``, and its :meth:`health` at ``/healthz``
+        text), ``/metrics.json``, its :meth:`health` at ``/healthz``
         (200 for ok/degraded, 503 otherwise — the TPU_RUNBOOK pre-flight
-        curl). ``port=0`` binds an ephemeral port; read
+        curl), and a fresh flight-recorder bundle at ``/debug/bundle``.
+        ``port=0`` binds an ephemeral port; read
         ``engine.metrics_server.port``. Stopped by :meth:`stop`."""
         if self.metrics_server is None:
             self.metrics_server = MetricsServer(
                 port, host, registry=self.stats.registry,
-                health_fn=self.health).start()
+                health_fn=self.health,
+                bundle_fn=lambda: self.dump_diagnostics(
+                    reason="http")).start()
         return self.metrics_server
 
     def __enter__(self) -> "Engine":
@@ -654,6 +681,84 @@ class Engine:
             "n_hangs": self.stats.n_hangs,
         }
 
+    # ---------------------------------------------------- flight recorder
+    def _config_doc(self) -> dict:
+        """The effective config as JSON-safe primitives (objects like
+        sinks/registries degrade to their repr)."""
+        out = {}
+        for f in dataclasses.fields(self.config):
+            v = getattr(self.config, f.name)
+            if v is None or isinstance(v, (bool, int, float, str)):
+                out[f.name] = v
+            elif isinstance(v, (tuple, list)):
+                out[f.name] = list(v)
+            else:
+                out[f.name] = repr(v)
+        return out
+
+    def dump_diagnostics(self, reason: str = "manual",
+                         dir_path: Optional[str] = None) -> dict:
+        """Freeze the flight-recorder state into a diagnostics bundle:
+        the span tape (last N records), a full registry snapshot,
+        ``health()``, and the effective config. Returns the bundle doc
+        (also kept as ``last_diagnostics``); when ``dir_path`` (or
+        ``EngineConfig.diagnostics_dir``) is set the bundle is also
+        written there atomically and the doc carries its ``"path"``.
+
+        Safe to call from any thread at any time — including while the
+        dispatch loop is wedged on a hung device call, which is the
+        moment it exists for (the watchdog calls this after tripping
+        the breaker)."""
+        spans = (self._flight_ring.records
+                 if self._flight_ring is not None else [])
+        extra = None
+        if self._flight_ring is not None:
+            extra = {"ring_capacity": self._flight_ring.capacity,
+                     "ring_emitted": self._flight_ring.emitted,
+                     "ring_dropped": self._flight_ring.dropped}
+        doc = obs_diagnostics.build_bundle(
+            reason=reason, spans=spans, registry=self.stats.registry,
+            health=self.health(), config=self._config_doc(), extra=extra)
+        target = dir_path if dir_path is not None \
+            else self.config.diagnostics_dir
+        if target is not None:
+            try:
+                doc["path"] = obs_diagnostics.write_bundle(target, doc)
+            except OSError as e:  # recorder must never take serving down
+                doc["path_error"] = f"{type(e).__name__}: {e}"
+        self.last_diagnostics = doc
+        self.stats.registry.counter(
+            "raft_tpu_serving_diagnostics_dumps_total",
+            "Flight-recorder bundles written, by trigger.",
+            ("engine", "reason")).labels(
+                self.stats.engine_label, reason).inc()
+        return doc
+
+    def _auto_dump(self, reason: str) -> None:
+        """Rate-limited dump from the failure paths (watchdog hang,
+        breaker open): at most one bundle per
+        ``diagnostics_min_interval_s`` so a flapping breaker can't
+        drown the disk, and never an exception out."""
+        now = self.clock()
+        with self._dump_lock:
+            min_gap = self.config.diagnostics_min_interval_s
+            if (self._last_dump_t is not None
+                    and now - self._last_dump_t < min_gap):
+                return
+            self._last_dump_t = now
+        try:
+            self.dump_diagnostics(reason=reason)
+        except Exception:
+            pass
+
+    def _on_batch_failure(self) -> None:
+        """Report a failed batch to the breaker; when that re-opens it
+        (a half-open probe failed), freeze a bundle — the operator will
+        want the spans from the probe that kept the breaker open."""
+        self.breaker.on_batch_result(False)
+        if self.breaker.state == "open":
+            self._auto_dump("breaker_open")
+
     # ------------------------------------------------------------- internal
     def _resolve(self, n: int) -> None:
         with self._outstanding_cv:
@@ -801,6 +906,12 @@ class Engine:
                         cause=TimeoutError(f"hung > {timeout}s"),
                         hang=True),
                     hang=True, meta=c["meta"])
+            if overdue:
+                # freeze the tape AFTER the hang spans land on it, so
+                # the bundle explains itself (the dispatch thread is
+                # still wedged on the device — this thread is the only
+                # one that can record what happened)
+                self._auto_dump("watchdog_hang")
 
     # ------------------------------------------------------------ the loops
     def _dispatch_loop(self) -> None:
@@ -821,7 +932,7 @@ class Engine:
                 # loop survives anything; only this batch's riders fail
                 self._fail_requests(
                     reqs, BatchFailed("dispatch failed", cause=e))
-                self.breaker.on_batch_result(False)
+                self._on_batch_failure()
 
     def _dispatch_batch(self, reqs: List[Request]) -> None:
         # honor client-side Future.cancel() before paying the launch
@@ -872,7 +983,7 @@ class Engine:
             self._inflight.release()
             self._fail_requests(live, BatchFailed("dispatch failed",
                                                   cause=e), meta=meta)
-            self.breaker.on_batch_result(False)
+            self._on_batch_failure()
             return
         if hung:
             # the watchdog already failed these futures and settled the
@@ -900,7 +1011,7 @@ class Engine:
                 self._fail_requests(
                     b.requests, BatchFailed("readback failed", cause=e),
                     meta=b.meta)
-                self.breaker.on_batch_result(False)
+                self._on_batch_failure()
                 continue
             t_read1 = self.clock()
             hung = self._end_device_call(call)

@@ -1,0 +1,279 @@
+"""Flight-recorder tests (docs/observability.md "Crash flight recorder").
+
+The recorder's contract, pinned here:
+
+- :class:`~raft_tpu.obs.spans.RingSink` stays bounded at ``capacity``
+  under concurrent emitters and never loses the newest records;
+- a diagnostics bundle round-trips through disk (atomic write, schema
+  marker, collision-safe names) via :func:`~raft_tpu.obs.load_bundle`;
+- an injected dispatch hang leaves a complete bundle behind — the hang
+  batch span on the tape, a registry snapshot, ``health()`` at its
+  unhealthy worst, and the effective config — both through the
+  watchdog's auto-dump and through ``GET /debug/bundle``;
+- auto-dumps are rate-limited so a flapping breaker can't spam disk.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import RingSink, build_bundle, load_bundle, write_bundle
+from raft_tpu.obs.diagnostics import BUNDLE_SCHEMA
+from raft_tpu.obs.spans import ListSink
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+# ------------------------------------------------------------- RingSink
+def test_ring_sink_bounded_and_oldest_first():
+    ring = RingSink(capacity=4)
+    for n in range(10):
+        ring.emit({"n": n})
+    assert len(ring) == 4
+    assert [r["n"] for r in ring.records] == [6, 7, 8, 9]
+    assert ring.emitted == 10
+    assert ring.dropped == 6
+    ring.clear()
+    assert len(ring) == 0 and ring.records == []
+
+
+def test_ring_sink_rejects_nonpositive_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        RingSink(capacity=0)
+
+
+def test_ring_sink_tees_to_inner_and_survives_poison_inner():
+    inner = ListSink()
+    ring = RingSink(capacity=2, inner=inner)
+    ring.emit({"a": 1})
+    assert inner.records == [{"a": 1}]
+
+    class Exploding:
+        def emit(self, record):
+            raise RuntimeError("inner sink down")
+
+    ring2 = RingSink(capacity=2, inner=Exploding())
+    ring2.emit({"b": 2})  # must not raise
+    assert ring2.records == [{"b": 2}]
+
+
+def test_ring_sink_bounded_under_concurrent_emitters():
+    """4 threads x 500 emits: the tape stays exactly at capacity, the
+    emitted counter loses nothing, and every surviving record is one of
+    the emitted ones."""
+    ring = RingSink(capacity=64)
+    n_threads, per_thread = 4, 500
+
+    def emitter(tid):
+        for n in range(per_thread):
+            ring.emit({"tid": tid, "n": n})
+
+    threads = [threading.Thread(target=emitter, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ring.emitted == n_threads * per_thread
+    assert len(ring) == 64
+    assert ring.dropped == n_threads * per_thread - 64
+    for r in ring.records:
+        assert 0 <= r["tid"] < n_threads and 0 <= r["n"] < per_thread
+
+
+# ------------------------------------------------------ bundle round-trip
+def test_bundle_roundtrip_and_schema_gate(tmp_path):
+    from raft_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    reg.counter("fr_test_total", "h").inc(3)
+    doc = build_bundle("unit-test", spans=[{"kind": "x"}], registry=reg,
+                       health={"status": "ok"}, config={"max_batch": 8})
+    assert doc["schema"] == BUNDLE_SCHEMA
+    assert doc["metrics"]["fr_test_total"]["series"][0]["value"] == 3
+    path = write_bundle(str(tmp_path), doc)
+    back = load_bundle(path)
+    assert back["reason"] == "unit-test"
+    assert back["spans"] == [{"kind": "x"}]
+    # same-second second dump gets a distinct collision-suffixed name
+    path2 = write_bundle(str(tmp_path), doc)
+    assert path2 != path
+    # a non-bundle json is refused, not half-parsed
+    junk = tmp_path / "junk.json"
+    junk.write_text(json.dumps({"schema": "other/v9"}))
+    with pytest.raises(ValueError, match="not a diagnostics bundle"):
+        load_bundle(str(junk))
+
+
+def test_bundle_registry_failure_degrades_to_error_section():
+    class BadRegistry:
+        def to_json(self):
+            raise RuntimeError("registry poisoned")
+
+    doc = build_bundle("worst-case", registry=BadRegistry())
+    assert "registry poisoned" in doc["metrics"]["error"]
+
+
+# ------------------------------------------------------- engine recorder
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+
+
+@pytest.fixture()
+def searcher(flat_index):
+    # fresh handle per test: fault injectors rebind .search on the handle
+    return serving.ivf_flat_searcher(flat_index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _engine(s, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_us", 5000)
+    kw.setdefault("warm_ks", (K,))
+    return serving.Engine(s, serving.EngineConfig(**kw))
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def test_manual_dump_carries_all_sections(searcher, tmp_path):
+    rng = np.random.default_rng(0)
+    with _engine(searcher, hang_timeout_s=None,
+                 diagnostics_dir=str(tmp_path)) as eng:
+        eng.search(_q(rng), K)
+        doc = eng.dump_diagnostics()
+        assert doc["reason"] == "manual"
+        assert eng.last_diagnostics is doc
+        kinds = {s.get("kind") for s in doc["spans"]}
+        assert "request" in kinds and "batch" in kinds
+        assert "raft_tpu_serving_batches_total" in doc["metrics"] \
+            or any("batch" in k for k in doc["metrics"])
+        assert doc["health"]["status"] == "ok"
+        assert doc["config"]["max_batch"] == 8
+        assert doc["extra"]["ring_capacity"] == 512
+        # the on-disk copy parses back
+        back = load_bundle(doc["path"])
+        assert back["reason"] == "manual"
+
+
+def test_flight_recorder_tees_to_configured_sink(searcher):
+    """Installing the recorder must not displace a user span sink."""
+    user_sink = ListSink()
+    rng = np.random.default_rng(1)
+    with _engine(searcher, hang_timeout_s=None,
+                 span_sink=user_sink) as eng:
+        eng.search(_q(rng), K)
+        eng.drain(60)
+        assert any(r.get("kind") == "request" for r in user_sink.records)
+        assert len(eng.dump_diagnostics()["spans"]) >= \
+            len([r for r in user_sink.records])
+
+
+def test_flight_recorder_disabled_dumps_empty_tape(searcher):
+    rng = np.random.default_rng(2)
+    with _engine(searcher, hang_timeout_s=None,
+                 flight_recorder=False) as eng:
+        eng.search(_q(rng), K)
+        doc = eng.dump_diagnostics()
+        assert doc["spans"] == [] and "extra" not in doc
+
+
+def test_hang_auto_dumps_complete_bundle(searcher, tmp_path):
+    """The chaos contract: an injected dispatch hang leaves a complete
+    on-disk bundle behind — hang span on the tape, registry snapshot,
+    unhealthy health — without anyone calling dump_diagnostics()."""
+    rng = np.random.default_rng(3)
+    with _engine(searcher, hang_timeout_s=1.0, breaker_cooldown_s=30.0,
+                 max_wait_us=0, diagnostics_dir=str(tmp_path)) as eng:
+        eng.search(_q(rng), K)
+        faults.hang_next_dispatch(searcher, hang_s=3.0)
+        victim = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed) as ei:
+            victim.result(timeout=60)
+        assert ei.value.hang is True
+
+        # the watchdog dumped right after tripping the breaker
+        deadline = time.perf_counter() + 10
+        while eng.last_diagnostics is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        doc = eng.last_diagnostics
+        assert doc is not None, "watchdog never dumped"
+        assert doc["reason"] == "watchdog_hang"
+        hang_spans = [s for s in doc["spans"]
+                      if s.get("kind") == "batch"
+                      and s.get("outcome") == "hang"]
+        assert hang_spans, f"no hang span on tape: {doc['spans']}"
+        assert isinstance(doc["metrics"], dict) and doc["metrics"]
+        assert doc["health"]["status"] == "unhealthy"
+        assert doc["config"]["hang_timeout_s"] == 1.0
+
+        # and the bundle really is on disk, loadable
+        back = load_bundle(doc["path"])
+        assert back["reason"] == "watchdog_hang"
+        dumps = [s for s in doc["metrics"]
+                 if "diagnostics_dumps" in s]
+        assert dumps, "dump counter missing from snapshot"
+
+        time.sleep(2.5)  # let the stuck dispatch thread drain its sleep
+
+
+def test_auto_dump_rate_limit_swallows_flaps(searcher, tmp_path):
+    rng = np.random.default_rng(4)
+    with _engine(searcher, hang_timeout_s=None,
+                 diagnostics_min_interval_s=3600.0,
+                 diagnostics_dir=str(tmp_path)) as eng:
+        eng.search(_q(rng), K)
+        eng._auto_dump("breaker_open")
+        first = eng.last_diagnostics
+        assert first is not None and first["reason"] == "breaker_open"
+        eng._auto_dump("breaker_open")  # inside the interval: swallowed
+        assert eng.last_diagnostics is first
+        # explicit dumps are an operator action and never rate-limited
+        manual = eng.dump_diagnostics()
+        assert manual is not first
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_debug_bundle_endpoint(searcher):
+    rng = np.random.default_rng(5)
+    with _engine(searcher, hang_timeout_s=None) as eng:
+        eng.search(_q(rng), K)
+        srv = eng.serve_metrics(port=0)
+        code, body = _get(srv.url + "/debug/bundle")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["schema"] == BUNDLE_SCHEMA
+        assert doc["reason"] == "http"
+        assert any(s.get("kind") == "request" for s in doc["spans"])
+        assert doc["health"]["status"] == "ok"
+
+
+def test_debug_bundle_404_without_bundle_fn():
+    from raft_tpu.obs import MetricsServer
+
+    with MetricsServer(port=0) as srv:
+        code, body = _get(srv.url + "/debug/bundle")
+        assert code == 404 and "no flight recorder" in body

@@ -210,3 +210,59 @@ def test_audit_default_entries_all_within_budget():
     assert len(results) == 7
     assert findings == [], [f.format() for f in findings]
     assert all(r.ok for r in results)
+
+
+# ------------------------------------------- justification placeholder gate
+
+def test_unjustified_keys_flags_placeholder_and_empty():
+    from raft_tpu.analysis import PLACEHOLDER_JUSTIFICATION, unjustified_keys
+
+    baseline = {
+        ("R001", "a.py", "f"): PLACEHOLDER_JUSTIFICATION,
+        ("R002", "b.py", "g"): "",
+        ("R003", "c.py", "h"): "   ",
+        ("R004", "d.py", "i"): "measured on v5p, deliberate",
+    }
+    assert unjustified_keys(baseline) == [
+        ("R001", "a.py", "f"), ("R002", "b.py", "g"),
+        ("R003", "c.py", "h")]
+
+
+def test_cli_fails_on_placeholder_justification(tmp_path):
+    """A suppression without a reason is not a suppression: a baseline
+    entry still carrying save_baseline's placeholder text must fail the
+    run even when the findings themselves are all baselined."""
+    from raft_tpu.analysis import PLACEHOLDER_JUSTIFICATION
+
+    pkg = tmp_path / "raft_tpu" / "fixture_pkg_b"
+    pkg.mkdir(parents=True)
+    bad = open(os.path.join(FIXDIR, "r001_bad.py")).read()
+    (pkg / "injected.py").write_text(bad)
+    baseline = tmp_path / "baseline.json"
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+             "--root", str(tmp_path), "--baseline", str(baseline), *extra],
+            capture_output=True, text=True)
+
+    # record the baseline: save_baseline stamps the placeholder text
+    proc = run("--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.load(open(baseline))["entries"]
+    assert all(e["justification"] == PLACEHOLDER_JUSTIFICATION
+               for e in entries)
+
+    # the very next gated run fails on the unjustified entries
+    proc = run()
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "no real justification" in proc.stdout
+    assert "not a suppression" in proc.stdout
+
+    # writing a real justification clears the gate
+    doc = json.load(open(baseline))
+    for e in doc["entries"]:
+        e["justification"] = "fixture: exercises the placeholder gate"
+    json.dump(doc, open(baseline, "w"))
+    proc = run()
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,147 @@
+"""obs.costs tests: chip-peak lookup, roofline placement, the drift
+ratio + C001 calibration findings, and one real (tiny) AOT compile so
+the jax extraction path stays honest on this stack's jax version."""
+
+import json
+
+import pytest
+
+from raft_tpu.obs import costs
+
+pytestmark = pytest.mark.fast
+
+
+def _entry(**kw):
+    base = dict(name="e", family="f", flops=None, hbm_bytes=None,
+                temp_bytes=None, argument_bytes=None, output_bytes=None,
+                compile_s=0.0)
+    base.update(kw)
+    return costs.EntryCost(**base)
+
+
+# ------------------------------------------------------------ chip peaks
+def test_peaks_lookup_longest_substring_first():
+    assert costs.peaks_for_device_kind("TPU v5p chip") is \
+        costs.CHIP_PEAKS["v5p"]
+    assert costs.peaks_for_device_kind("TPU v5 lite pod") is \
+        costs.CHIP_PEAKS["v5 lite"]
+    assert costs.peaks_for_device_kind("TPU v6e") is costs.CHIP_PEAKS["v6e"]
+    assert costs.peaks_for_device_kind("cpu") is None
+
+
+def test_ridge_intensity():
+    p = costs.ChipPeaks(flops_per_s=100.0, hbm_bytes_per_s=10.0)
+    assert p.ridge_intensity == 10.0
+
+
+# -------------------------------------------------------------- roofline
+def test_apply_roofline_memory_and_compute_bound():
+    peaks = costs.ChipPeaks(flops_per_s=1e12, hbm_bytes_per_s=1e11)
+    # AI = 1 < ridge 10: memory-bound, time = bytes / bandwidth
+    e = _entry(flops=1e9, hbm_bytes=1e9)
+    costs.apply_roofline(e, peaks)
+    assert e.bound == "memory"
+    assert e.min_time_us == pytest.approx(1e9 / 1e11 * 1e6)
+    assert e.peak_utilization == pytest.approx(0.1)
+    # AI = 100 > ridge: compute-bound, full MXU attainable
+    e = _entry(flops=1e12, hbm_bytes=1e10)
+    costs.apply_roofline(e, peaks)
+    assert e.bound == "compute"
+    assert e.min_time_us == pytest.approx(1e6)
+    assert e.peak_utilization == 1.0
+
+
+def test_apply_roofline_degrades_without_peaks_or_costs():
+    e = _entry(flops=1e9, hbm_bytes=1e9)
+    costs.apply_roofline(e, None)  # CPU: intensity only
+    assert e.arithmetic_intensity == 1.0 and e.bound is None
+    e = _entry()  # backend reported nothing
+    costs.apply_roofline(e, costs.CHIP_PEAKS["v5e"])
+    assert e.arithmetic_intensity is None and e.min_time_us is None
+
+
+# --------------------------------------------------- drift + C001 findings
+def test_drift_ratio_none_without_either_side():
+    assert _entry(predicted_bytes=100).drift_ratio is None
+    assert _entry(temp_bytes=100).drift_ratio is None
+    assert _entry(predicted_bytes=100, temp_bytes=0).drift_ratio is None
+    assert _entry(predicted_bytes=300, temp_bytes=100).drift_ratio == 3.0
+
+
+def _report(entries):
+    return costs.CostReport(platform="cpu", device_kind="cpu", peaks=None,
+                            entries=entries, budget_bytes=1 << 30)
+
+
+def test_calibration_findings_flag_both_directions():
+    ok = _entry(name="a", planner="p", predicted_bytes=120, temp_bytes=100)
+    over = _entry(name="b", planner="p", predicted_bytes=200,
+                  temp_bytes=100)
+    under = _entry(name="c", planner="p", predicted_bytes=100,
+                   temp_bytes=200)
+    no_planner = _entry(name="d", predicted_bytes=900, temp_bytes=100)
+    fs = _report([ok, over, under, no_planner]).calibration_findings()
+    assert sorted(f.qualname for f in fs) == ["b", "c"]
+    for f in fs:
+        assert f.rule == costs.COST_RULE
+        assert f.file == costs.COST_FILE
+    assert "over-predicts" in next(f for f in fs if f.qualname == "b").message
+    assert "under-predicts" in next(
+        f for f in fs if f.qualname == "c").message
+
+
+def test_report_to_dict_schema_and_format():
+    e = _entry(name="a", planner="p", predicted_bytes=150, temp_bytes=100,
+               flops=1e9, hbm_bytes=1e8)
+    doc = json.loads(_report([e]).to_json())
+    assert doc["schema"] == "raft_tpu.perf_report/v1"
+    assert doc["entries"][0]["drift_ratio"] == 1.5
+    assert "planner drift 1.50x" in _report([e]).format()
+
+
+def test_export_gauges_lands_series():
+    from raft_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    e = _entry(name="a", planner="p", predicted_bytes=150, temp_bytes=100,
+               flops=5.0, hbm_bytes=7.0)
+    costs.export_gauges(_report([e]), registry=reg)
+    doc = reg.to_json()
+    assert doc["raft_tpu_cost_flops"]["series"][0]["value"] == 5.0
+    drift = doc["raft_tpu_planner_drift_ratio"]["series"][0]
+    assert drift["labels"] == {"entry": "a", "planner": "p"}
+    assert drift["value"] == pytest.approx(1.5)
+
+
+# ----------------------------------------------------- one real compile
+def test_compile_entry_extracts_real_costs():
+    """One tiny matmul through the real lower/compile/cost path — pins
+    the jax-version quirks (list-shaped cost_analysis, memory_analysis
+    attribute names) the heavier perf_report run relies on."""
+    import jax
+    import jax.numpy as jnp
+
+    def make_core():
+        def core(a, b):
+            return (a @ b).sum(axis=1)
+
+        sds = (jax.ShapeDtypeStruct((64, 32), jnp.float32),
+               jax.ShapeDtypeStruct((32, 16), jnp.float32))
+        return core, sds, {"family": "test", "planner": "toy",
+                           "predicted_bytes": 64 * 16 * 4}
+
+    e = costs.compile_entry("toy.matmul", make_core)
+    assert e.family == "test" and e.planner == "toy"
+    assert e.compile_s > 0
+    # XLA:CPU reports both analyses on this stack; flops at least the
+    # mac count, argument bytes exactly the input sizes
+    assert e.flops is not None and e.flops >= 2 * 64 * 32 * 16 * 0.5
+    assert e.argument_bytes == 64 * 32 * 4 + 32 * 16 * 4
+    assert e.temp_bytes is not None and e.temp_bytes >= 0
+
+
+def test_normalize_cost_analysis_shapes():
+    assert costs._normalize_cost_analysis(None) == {}
+    assert costs._normalize_cost_analysis([]) == {}
+    assert costs._normalize_cost_analysis([{"flops": 2.0}]) == {"flops": 2.0}
+    assert costs._normalize_cost_analysis({"flops": 3.0}) == {"flops": 3.0}

@@ -267,3 +267,100 @@ def test_sharded_cagra(tmp_path):
                        text=True, timeout=900, env=env)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
     assert "SHARDED_CAGRA_OK" in r.stdout, r.stdout[-3000:]
+
+
+# ------------------------------------------- per-shard search trace spans
+
+
+class TestShardedSearchSpans:
+    """``set_span_sink()`` flips every search entrypoint onto the two-phase
+    dispatch (local scan sharded, per-shard fence, host-side
+    ``_elastic_merge``) — results must stay bit-identical to the fused
+    single-program path, and the tape must carry one ``shard_search``
+    child per rank under the parent's trace id."""
+
+    def _run_instrumented(self, fn):
+        from raft_tpu.obs import spans as obs_spans
+
+        sink = obs_spans.ListSink()
+        prev = sharded.set_span_sink(sink)
+        try:
+            out = fn()
+        finally:
+            sharded.set_span_sink(prev)
+        return out, sink.records
+
+    def _check_spans(self, records, family, size=8):
+        children = [r for r in records if r["kind"] == "shard_search"]
+        parents = [r for r in records if r["kind"] == "sharded_search"]
+        assert len(parents) == 1
+        parent = parents[0]
+        assert parent["family"] == family
+        assert parent["n_shards"] == size
+        assert sorted(c["rank"] for c in children) == list(range(size))
+        assert all(c["trace_id"] == parent["trace_id"] for c in children)
+        assert all(c["family"] == family for c in children)
+        # one distinct device per shard; timing fields present
+        assert len({c["device"] for c in children}) == size
+        for key in ("launch_ms", "merge_ms", "total_ms"):
+            assert parent[key] >= 0.0
+        assert all(c["device_ms"] >= 0.0 for c in children)
+
+    def test_set_span_sink_returns_previous(self):
+        marker = object()
+        assert sharded.set_span_sink(marker) is None
+        assert sharded.set_span_sink(None) is marker
+        assert sharded._span_sink() is None
+
+    def test_knn_spans_and_parity(self, comms, rng):
+        data = rng.standard_normal((1000, 32)).astype(np.float32)
+        q = rng.standard_normal((20, 32)).astype(np.float32)
+        v0, i0 = sharded.knn(comms, q, data, k=10)
+        (v1, i1), records = self._run_instrumented(
+            lambda: sharded.knn(comms, q, data, k=10))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        self._check_spans(records, "brute_force")
+
+    @pytest.mark.slow
+    def test_ivf_flat_spans_and_parity(self, comms, rng):
+        from raft_tpu.neighbors import ivf_flat
+
+        data = rng.standard_normal((800, 32)).astype(np.float32)
+        q = rng.standard_normal((16, 32)).astype(np.float32)
+        idx = sharded.build_ivf_flat(comms, data,
+                                     ivf_flat.IndexParams(n_lists=8))
+        params = ivf_flat.SearchParams(n_probes=4)
+        v0, i0 = sharded.search_ivf_flat(idx, q, 10, params)
+        (v1, i1), records = self._run_instrumented(
+            lambda: sharded.search_ivf_flat(idx, q, 10, params))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        self._check_spans(records, "ivf_flat")
+
+    @pytest.mark.slow
+    def test_ivf_pq_spans_and_parity(self, comms, rng):
+        from raft_tpu.neighbors import ivf_pq
+
+        data = rng.standard_normal((800, 32)).astype(np.float32)
+        q = rng.standard_normal((16, 32)).astype(np.float32)
+        idx = sharded.build_ivf_pq(comms, data,
+                                   ivf_pq.IndexParams(n_lists=8, pq_dim=8))
+        params = ivf_pq.SearchParams(n_probes=4)
+        v0, i0 = sharded.search_ivf_pq(idx, q, 8, params)
+        (v1, i1), records = self._run_instrumented(
+            lambda: sharded.search_ivf_pq(idx, q, 8, params))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        self._check_spans(records, "ivf_pq")
+
+    def test_no_sink_emits_nothing(self, comms, rng):
+        """Default path: no sink, no spans — the zero-overhead guarantee."""
+        from raft_tpu.obs import spans as obs_spans
+
+        data = rng.standard_normal((256, 16)).astype(np.float32)
+        q = rng.standard_normal((4, 16)).astype(np.float32)
+        sink = obs_spans.ListSink()
+        # sink NOT installed
+        sharded.knn(comms, q, data, k=4)
+        assert sink.records == []

@@ -28,7 +28,8 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from raft_tpu.analysis import (load_baseline, run_tier_a,  # noqa: E402
-                               save_baseline, split_by_baseline)
+                               save_baseline, split_by_baseline,
+                               unjustified_keys)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftcheck_baseline.json")
 
@@ -49,6 +50,12 @@ def main(argv=None) -> int:
     ap.add_argument("--jaxpr-audit", action="store_true",
                     help="also run the Tier-B jaxpr memory-budget audit "
                          "(imports JAX)")
+    ap.add_argument("--costs", action="store_true",
+                    help="also run the Tier-C compiled-cost calibration "
+                         "audit: AOT-compile the canonical cores and flag "
+                         "planners whose predicted workspace drifts >1.5x "
+                         "from XLA's memory_analysis (imports JAX, "
+                         "compiles — seconds on CPU)")
     ap.add_argument("--budget-bytes", type=int, default=None,
                     help="override the Tier-B workspace budget "
                          "(default: 2 GiB, the CPU-fallback "
@@ -77,6 +84,23 @@ def main(argv=None) -> int:
                       f"{r.peak_bytes / 2**20:.1f} MiB "
                       f"> budget {r.budget_bytes / 2**20:.0f} MiB")
 
+    if args.costs:
+        from raft_tpu.obs import costs
+        report = costs.build_report(budget_bytes=args.budget_bytes)
+        cost_findings = report.calibration_findings()
+        findings.extend(cost_findings)
+        if not args.quiet:
+            flagged = {f.qualname for f in cost_findings}
+            for e in report.entries:
+                r = e.drift_ratio
+                if r is None:
+                    continue
+                state = "FAIL" if e.name in flagged else "OK  "
+                print(f"  [costs] {state} {e.name}: planner {e.planner} "
+                      f"predicted {e.predicted_bytes / 2**20:.0f} MiB, "
+                      f"compiled temp {e.temp_bytes / 2**20:.0f} MiB "
+                      f"(drift {r:.2f}x)")
+
     if args.rules:
         keep = {r.strip() for r in args.rules.split(",") if r.strip()}
         findings = [f for f in findings if f.rule in keep]
@@ -89,6 +113,19 @@ def main(argv=None) -> int:
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
+
+    placeholders = unjustified_keys(baseline)
+    if placeholders:
+        for rule, file, qualname in placeholders:
+            print(f"graftcheck: baseline entry ({rule}, {file}, "
+                  f"{qualname}) has no real justification — write one in "
+                  f"{args.baseline} or fix and remove the entry")
+        print(f"graftcheck: {len(placeholders)} baseline entr"
+              f"{'y' if len(placeholders) == 1 else 'ies'} still carry "
+              f"the 'TODO: justify or fix' placeholder; a suppression "
+              f"without a reason is not a suppression")
+        return 1
+
     new, suppressed = split_by_baseline(findings, baseline)
 
     if not args.quiet:

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""mini_bench — seconds-scale bench emitting the bench.py JSON shape.
+
+The smallest run that exercises real entrypoints end to end: brute
+force kNN + select_k + ivf_flat at toy shapes (2k rows, dim 32). It
+exists so the bench_gate CI job has something cheap and deterministic
+to diff — the output object carries the same ``metric``/``value``/
+``extra`` layout bench.py prints, so ``tools/bench_gate.py`` treats
+the two identically.
+
+Typical use::
+
+    python tools/mini_bench.py > /tmp/run1.json
+    python tools/mini_bench.py > /tmp/run2.json
+    python tools/bench_gate.py /tmp/run1.json /tmp/run1.json /tmp/run2.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+N_ROWS = 2000
+N_QUERIES = 200
+DIM = 32
+K = 10
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="mini_bench", description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="write JSON here instead of stdout")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.bench.timing import time_dispatches
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.ops.select_k import select_k
+
+    rng = np.random.default_rng(args.seed)
+    data = jax.device_put(
+        rng.standard_normal((N_ROWS, DIM), dtype=np.float32))
+    queries = jax.device_put(
+        rng.standard_normal((N_QUERIES, DIM), dtype=np.float32))
+    board = jax.device_put(
+        rng.standard_normal((256, 8192), dtype=np.float32))
+
+    # ground truth for recall (brute force IS the ground truth: 1.0)
+    _, gt_idx = brute_force.knn(queries, data, k=K)
+    gt = np.asarray(gt_idx)
+
+    dt = time_dispatches(lambda: brute_force.knn(queries, data, k=K),
+                         iters=3, warmup=1)
+    bf_qps = N_QUERIES / dt
+
+    dt = time_dispatches(lambda: select_k(board, K), iters=3, warmup=1)
+    sk_rows_per_s = board.shape[0] / dt
+
+    idx = ivf_flat.build(data, ivf_flat.IndexParams(n_lists=16))
+    sp = ivf_flat.SearchParams(n_probes=8)
+    dt = time_dispatches(
+        lambda: ivf_flat.search(idx, queries, k=K, params=sp),
+        iters=3, warmup=1)
+    flat_qps = N_QUERIES / dt
+    _, fi = ivf_flat.search(idx, queries, k=K, params=sp)
+    fi = np.asarray(fi)
+    flat_recall = float(np.mean([
+        len(set(fi[i]) & set(gt[i])) / K for i in range(N_QUERIES)]))
+
+    platform = jax.devices()[0].platform
+    row = {
+        "metric": f"mini_brute_force_qps_{N_ROWS}x{DIM}_k{K}",
+        "value": round(bf_qps, 1),
+        "unit": "QPS",
+        "recall": 1.0,
+        "platform": platform,
+        "extra": {
+            "select_k_256x8192": {
+                "rows_per_s": round(sk_rows_per_s, 1),
+            },
+            "ivf_flat_nprobe8": {
+                "qps": round(flat_qps, 1),
+                "recall": round(flat_recall, 4),
+            },
+        },
+    }
+    text = json.dumps(row)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""perf_report — compiled-cost roofline + planner-calibration artifact.
+
+AOT-compiles the canonical entrypoint cores (the graftcheck jaxpr-audit
+seven plus cagra — all four ANN families) on the current backend, reads
+XLA's cost/memory analysis, and writes ``PERF_REPORT_<platform>.json``:
+FLOPs, HBM bytes, peak temp memory, roofline placement (TPU only — on
+CPU absolutes are reported without a peaks table), and the planner
+predicted-vs-compiled workspace drift ratio per entrypoint. The same
+numbers land in the metrics registry as gauges, so a serving process
+that runs this at startup exposes its compiled-cost picture on
+``/metrics``.
+
+No index is built and no input allocated — this is lowering + AOT
+compilation only, seconds on CPU. Typical use::
+
+    python tools/perf_report.py                 # writes PERF_REPORT_cpu.json
+    python tools/perf_report.py --out report.json
+    python tools/perf_report.py --check         # exit 1 on unjustified drift
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default: "
+                         "PERF_REPORT_<platform>.json in the repo root)")
+    ap.add_argument("--budget-bytes", type=int, default=None,
+                    help="planner workspace budget (default: 2 GiB, the "
+                         "CPU-fallback workspace_limit_bytes)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="drift ratio beyond which a planner is flagged "
+                         "(default 1.5)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any drift finding is not justified "
+                         "in graftcheck_baseline.json (the CI gate)")
+    ap.add_argument("--no-gauges", action="store_true",
+                    help="skip mirroring the report into the global "
+                         "metrics registry")
+    args = ap.parse_args(argv)
+
+    from raft_tpu.obs import costs
+
+    kw = {}
+    if args.tolerance is not None:
+        kw["drift_tolerance"] = args.tolerance
+    report = costs.build_report(budget_bytes=args.budget_bytes, **kw)
+    print(report.format())
+
+    if not args.no_gauges:
+        costs.export_gauges(report)
+
+    out = args.out or os.path.join(
+        REPO_ROOT, f"PERF_REPORT_{report.platform}.json")
+    with open(out, "w") as fh:
+        fh.write(report.to_json())
+        fh.write("\n")
+    print(f"perf_report: wrote {out} ({len(report.entries)} entries)")
+
+    findings = report.calibration_findings()
+    if not findings:
+        return 0
+    from raft_tpu.analysis import load_baseline, split_by_baseline
+    baseline = load_baseline(
+        os.path.join(REPO_ROOT, "graftcheck_baseline.json"))
+    new, suppressed = split_by_baseline(findings, baseline)
+    for f in suppressed:
+        print(f"perf_report: drift baselined: {f.qualname}")
+    for f in new:
+        print(f"perf_report: UNJUSTIFIED drift: {f.message} "
+              f"[{f.qualname}]")
+    if new and args.check:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

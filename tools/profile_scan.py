@@ -1,50 +1,174 @@
-"""Break down ivf_flat-style search costs on TPU."""
+#!/usr/bin/env python
+"""profile_scan — stage-level cost breakdown of an ivf_flat-style search.
 
-import numpy as np, jax, jax.numpy as jnp
-from raft_tpu.ops.select_k import select_k
+Decomposes the probed-list scan into its pipeline stages (coarse quantize
++ probe select, list gather, gather+dot, gather+dot+top-k, top-k alone)
+and reports, per stage:
 
-from raft_tpu.bench.timing import time_dispatches
+- measured dispatch time (:func:`raft_tpu.bench.timing.time_dispatches`);
+- XLA's compiled FLOPs / HBM bytes and the roofline verdict
+  (:mod:`raft_tpu.obs.costs` — arithmetic intensity, memory- vs
+  compute-bound, minimum attainable time on this chip's peaks, and the
+  fraction of roofline the measured run achieved).
 
-def bench(f, *a, iters=5):
-    return time_dispatches(lambda: f(*a), iters=iters)
+On CPU the roofline columns degrade to absolutes (no chip peaks table
+entry) — the tool still answers "which stage moves the bytes".
 
-rng = np.random.default_rng(0)
-L, pad, dim = 1024, 128, 96
-nq, P, k = 1024, 32, 10
-list_data = jnp.asarray(rng.standard_normal((L, pad, dim)), jnp.float32)
-queries = jnp.asarray(rng.standard_normal((nq, dim)), jnp.float32)
-centers = jnp.asarray(rng.standard_normal((L, dim)), jnp.float32)
-probes = jnp.asarray(rng.integers(0, L, (nq, P)), jnp.int32)
+``--trace DIR`` wraps the measured loop in
+:func:`raft_tpu.obs.profile_session` so an xprof capture (with the
+session counters ticked) lands alongside the printed table::
 
-@jax.jit
-def coarse(q):
-    d = q @ centers.T
-    return select_k(d, P, select_min=True)
+    python tools/profile_scan.py                # table only
+    python tools/profile_scan.py --trace /tmp/scan_trace
+"""
 
-@jax.jit
-def gather_only(pr):
-    return list_data[pr]  # [nq, P, pad, dim]
+from __future__ import annotations
 
-@jax.jit
-def gather_dot(q, pr):
-    g = list_data[pr]
-    return jnp.einsum("td,tpld->tpl", q, g, preferred_element_type=jnp.float32)
+import argparse
+import json
+import os
+import sys
 
-@jax.jit
-def gather_dot_topk(q, pr):
-    g = list_data[pr]
-    d = jnp.einsum("td,tpld->tpl", q, g, preferred_element_type=jnp.float32)
-    return select_k(d.reshape(nq, -1), k, select_min=True)
+import numpy as np
 
-@jax.jit
-def topk_only(d):
-    return select_k(d, k, select_min=True)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
-print("coarse+selP  ", round(bench(coarse, queries)*1e3, 1), "ms")
-print("gather_only  ", round(bench(gather_only, probes)*1e3, 1), "ms")
-print("gather_dot   ", round(bench(gather_dot, queries, probes)*1e3, 1), "ms")
-print("g_d_topk     ", round(bench(gather_dot_topk, queries, probes)*1e3, 1), "ms")
-d = jnp.asarray(rng.standard_normal((nq, P*pad)), jnp.float32)
-print("topk_only    ", round(bench(topk_only, d)*1e3, 1), "ms")
-bytes_probed = nq*P*pad*dim*4
-print("probed GB:", round(bytes_probed/1e9, 2))
+
+def _stages(L, pad, dim, nq, n_probes, k):
+    """(name, make_core) factories shaped like obs.costs expects: each
+    returns (core, example_args, meta)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.ops.select_k import select_k
+
+    rng = np.random.default_rng(0)
+    list_data = jnp.asarray(rng.standard_normal((L, pad, dim)), jnp.float32)
+    queries = jnp.asarray(rng.standard_normal((nq, dim)), jnp.float32)
+    centers = jnp.asarray(rng.standard_normal((L, dim)), jnp.float32)
+    probes = jnp.asarray(rng.integers(0, L, (nq, n_probes)), jnp.int32)
+    flat = jnp.asarray(rng.standard_normal((nq, n_probes * pad)), jnp.float32)
+
+    def coarse(q):
+        d = q @ centers.T
+        return select_k(d, n_probes, select_min=True)
+
+    def gather_only(pr):
+        return list_data[pr]  # [nq, P, pad, dim]
+
+    def gather_dot(q, pr):
+        g = list_data[pr]
+        return jnp.einsum("td,tpld->tpl", q, g,
+                          preferred_element_type=jnp.float32)
+
+    def gather_dot_topk(q, pr):
+        g = list_data[pr]
+        d = jnp.einsum("td,tpld->tpl", q, g,
+                       preferred_element_type=jnp.float32)
+        return select_k(d.reshape(nq, -1), k, select_min=True)
+
+    def topk_only(d):
+        return select_k(d, k, select_min=True)
+
+    shaped = [
+        ("coarse+selP", coarse, (queries,)),
+        ("gather_only", gather_only, (probes,)),
+        ("gather_dot", gather_dot, (queries, probes)),
+        ("gather_dot_topk", gather_dot_topk, (queries, probes)),
+        ("topk_only", topk_only, (flat,)),
+    ]
+
+    def make(core, args):
+        sds = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args)
+        return (lambda: (core, sds, {"family": "ivf_flat.stage"}),
+                jax.jit(core), args)
+
+    return [(name, *make(core, args)) for name, core, args in shaped]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="profile_scan", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--n-lists", type=int, default=1024)
+    ap.add_argument("--list-pad", type=int, default=128)
+    ap.add_argument("--dim", type=int, default=96)
+    ap.add_argument("--n-queries", type=int, default=1024)
+    ap.add_argument("--n-probes", type=int, default=32)
+    ap.add_argument("-k", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="capture an xprof trace of the measured loop "
+                         "via obs.profile_session")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the rows as JSON to this path")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from raft_tpu.bench.timing import time_dispatches
+    from raft_tpu.obs import costs, profile_session
+
+    dev = jax.devices()[0]
+    peaks = costs.peaks_for_device_kind(dev.device_kind)
+    print(f"profile_scan: platform={dev.platform} kind={dev.device_kind} "
+          f"peaks={'known' if peaks else 'unknown (absolutes only)'}")
+    print(f"  shape: L={args.n_lists} pad={args.list_pad} dim={args.dim} "
+          f"nq={args.n_queries} P={args.n_probes} k={args.k}")
+
+    stages = _stages(args.n_lists, args.list_pad, args.dim,
+                     args.n_queries, args.n_probes, args.k)
+
+    rows = []
+
+    def measure():
+        for name, make_core, fn, call_args in stages:
+            entry = costs.compile_entry(name, make_core)
+            costs.apply_roofline(entry, peaks)
+            ms = time_dispatches(lambda: fn(*call_args),
+                                 iters=args.iters) * 1e3
+            rows.append((name, ms, entry))
+
+    if args.trace:
+        with profile_session(args.trace) as d:
+            measure()
+        print(f"  xprof trace -> {d}")
+    else:
+        measure()
+
+    hdr = (f"  {'stage':<16} {'ms':>8} {'GFLOP':>8} {'GB':>7} "
+           f"{'AI':>6} {'bound':>7} {'roof_ms':>8} {'%roof':>6}")
+    print(hdr)
+    docs = []
+
+    def fmt(v, p):
+        return f"{v:.{p}f}" if v is not None else "-"
+
+    for name, ms, e in rows:
+        gflop = e.flops / 1e9 if e.flops else None
+        gb = e.hbm_bytes / 1e9 if e.hbm_bytes else None
+        roof_ms = e.min_time_us / 1e3 if e.min_time_us else None
+        pct = 100.0 * roof_ms / ms if roof_ms else None
+        print(f"  {name:<16} {ms:8.2f} {fmt(gflop, 2):>8} {fmt(gb, 3):>7} "
+              f"{fmt(e.arithmetic_intensity, 1):>6} "
+              f"{e.bound or '-':>7} {fmt(roof_ms, 2):>8} "
+              f"{fmt(pct, 1):>6}")
+        docs.append({"stage": name, "ms": round(ms, 3), "flops": e.flops,
+                     "hbm_bytes": e.hbm_bytes,
+                     "arithmetic_intensity": e.arithmetic_intensity,
+                     "bound": e.bound, "roofline_ms": roof_ms,
+                     "pct_of_roofline": pct})
+    probed_gb = (args.n_queries * args.n_probes * args.list_pad
+                 * args.dim * 4) / 1e9
+    print(f"  probed GB (logical gather): {probed_gb:.2f}")
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump({"device_kind": dev.device_kind, "rows": docs},
+                      fh, indent=1)
+            fh.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

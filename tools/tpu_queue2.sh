@@ -44,6 +44,24 @@ run_step() {  # run_step <name> <done-marker-file> <cmd...>
 # pulled from the conf after 2x TPU worker crash — since restored with the
 # tiled scan engine, so a resume picks the lut points up as missing).
 run_step bench  /tmp/q5_bench.done  timeout 1800 python bench.py
+
+# regression gate: diff this round's headline bench against the prior
+# round's committed artifact (wrapper format) with the noise-aware
+# tolerance band. The bench log doubles as the candidate (bench_gate
+# scans .log files for the last JSON metric line). Non-fatal to the
+# queue — a regression is a finding, not a reason to starve the
+# remaining artifacts — but the verdict JSON lands next to the log for
+# the wrap-up commit.
+run_step benchgate /tmp/q5_benchgate.done timeout 600 \
+  python tools/bench_gate.py --allow-missing \
+  --json /tmp/q_benchgate_verdicts.json BENCH_r05.json /tmp/q_bench.log
+
+# compiled-cost roofline + planner-calibration artifact on the real
+# chip (CPU numbers are committed from CI; this one has the TPU peaks
+# table applied) — AOT only, seconds of window time
+run_step perfreport /tmp/q5_perfreport.done timeout 1200 \
+  python tools/perf_report.py
+
 run_step tputests /tmp/q5_tputests.done timeout 2700 \
   python -m pytest tests_tpu/ -x -q -p no:cacheprovider -o addopts=""
 run_step kprobe /tmp/q5_kprobe.done env RAFT_TPU_BENCH_PLATFORM=default \
