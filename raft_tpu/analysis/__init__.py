@@ -11,6 +11,10 @@ Two tiers:
   entrypoints at canonical shapes (no device memory is allocated), walks
   the closed jaxpr for a peak-live-set upper bound and fails when an
   entrypoint's estimate exceeds its workspace budget (rule B001).
+* **Threads** (``--threads``): concurrency-discipline rules T001–T004
+  over the serving/comms/obs stack — unguarded shared state, lock-order
+  cycles, blocking calls under a lock, condition waits outside a
+  predicate loop. See :mod:`raft_tpu.analysis.concurrency`.
 
 Findings are keyed ``(rule, file, qualname)`` so a committed baseline
 survives line churn; see :mod:`raft_tpu.analysis.findings`.
@@ -22,6 +26,7 @@ import os
 from typing import Iterable, List, Optional, Tuple
 
 from raft_tpu.analysis.astutils import ModuleInfo
+from raft_tpu.analysis.concurrency import THREAD_SCAN_DIRS, run_threads
 from raft_tpu.analysis.findings import (PLACEHOLDER_JUSTIFICATION, Finding,
                                         load_baseline, save_baseline,
                                         split_by_baseline, unjustified_keys)
@@ -32,8 +37,8 @@ __all__ = [
     "Finding", "ModuleInfo", "AST_RULES", "check_layering",
     "load_baseline", "save_baseline", "split_by_baseline",
     "unjustified_keys", "PLACEHOLDER_JUSTIFICATION",
-    "collect_modules", "run_tier_a",
-    "DEFAULT_SCAN_DIRS",
+    "collect_modules", "run_tier_a", "run_threads",
+    "DEFAULT_SCAN_DIRS", "THREAD_SCAN_DIRS",
 ]
 
 #: directories scanned by default, relative to the repo root.

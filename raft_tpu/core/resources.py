@@ -54,10 +54,10 @@ class Resources:
     ):
         self._device = device
         self.mesh = mesh
-        self._key = jax.random.key(seed)
+        self._key = jax.random.key(seed)  # guarded_by: _key_lock
         self._key_lock = threading.Lock()
         self._workspace_limit = workspace_limit_bytes
-        self._slots: dict[str, Any] = {}
+        self._slots: dict[str, Any] = {}  # guarded_by: _slot_lock
         self._slot_lock = threading.Lock()
         self._comms = None  # set by raft_tpu.parallel.comms.inject_comms
 

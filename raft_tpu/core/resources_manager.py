@@ -24,11 +24,11 @@ from raft_tpu.core.resources import Resources
 class _Manager:
     def __init__(self):
         self._lock = threading.Lock()
-        self._pools: Dict[int, List[Resources]] = {}
-        self._next: Dict[int, int] = {}
-        self._pool_size = 1
-        self._workspace_limit: Optional[int] = None
-        self._frozen = False
+        self._pools: Dict[int, List[Resources]] = {}  # guarded_by: _lock
+        self._next: Dict[int, int] = {}  # guarded_by: _lock
+        self._pool_size = 1  # guarded_by: _lock
+        self._workspace_limit: Optional[int] = None  # guarded_by: _lock
+        self._frozen = False  # guarded_by: _lock
 
     def set_resources_per_device(self, n: int) -> None:
         """Analog of ``set_streams_per_device`` — pool width per device.

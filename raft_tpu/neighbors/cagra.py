@@ -543,11 +543,22 @@ def search(
     # across disconnected components, so a query's component must be
     # seeded. Seeds beyond itopk are fine: they enter through the merge.
     n_seeds = min(max(itopk, 32) * n_rand, index.size)
-    # deterministic pseudo-random seeds per query (rand_xor_mask analog)
-    key = jax.random.fold_in(jax.random.key(params.rand_xor_mask & 0x7FFFFFFF),
-                             queries.shape[0])
-    seed_ids = jax.random.randint(
-        key, (queries.shape[0], n_seeds), 0, index.size, jnp.int32)
+    # deterministic pseudo-random seeds per query (rand_xor_mask analog):
+    # a stratified lattice rotated by a per-row draw. Row q's seed set
+    # depends only on q and the mask — never on the (padded) batch size —
+    # so batch 1 and batch 64 see the same seeds for the same query, and
+    # the lattice guarantees every size/n_seeds stretch of the dataset
+    # (hence every graph component that large) holds a seed, which a
+    # bare uniform draw cannot promise on clustered data.
+    base = jnp.asarray(
+        (np.arange(n_seeds, dtype=np.int64) * index.size) // n_seeds,
+        jnp.int32)
+    key = jax.random.key(params.rand_xor_mask & 0x7FFFFFFF)
+    offsets = jax.vmap(
+        lambda row: jax.random.randint(
+            jax.random.fold_in(key, row), (), 0, index.size, jnp.int32)
+    )(jnp.arange(queries.shape[0], dtype=jnp.uint32))
+    seed_ids = (base[None, :] + offsets[:, None]) % index.size
     fast_scan = params.scan_dtype is not None
     if fast_scan:
         if jnp.dtype(params.scan_dtype) != jnp.bfloat16:

@@ -99,7 +99,7 @@ class CounterChild:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded_by: _lock
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -121,8 +121,9 @@ class GaugeChild:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._value = 0.0
-        self._fn: Optional[Callable[[], float]] = None
+        self._value = 0.0  # guarded_by: _lock
+        self._fn: Optional[
+            Callable[[], float]] = None  # guarded_by: _lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -217,9 +218,9 @@ class HistogramChild:
     def __init__(self, bounds: Tuple[float, ...]) -> None:
         self._lock = threading.Lock()
         self.bounds = bounds
-        self._counts = [0] * (len(bounds) + 1)
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * (len(bounds) + 1)  # guarded_by: _lock
+        self._sum = 0.0  # guarded_by: _lock
+        self._count = 0  # guarded_by: _lock
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self.bounds, value)
@@ -256,7 +257,8 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[
+            Tuple[str, ...], object] = {}  # guarded_by: _lock
 
     def _make_child(self):
         raise NotImplementedError
@@ -354,7 +356,7 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded_by: _lock
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str], **kw) -> _Family:

@@ -65,7 +65,7 @@ class ListSink:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._records: List[dict] = []
+        self._records: List[dict] = []  # guarded_by: _lock
 
     def emit(self, record: dict) -> None:
         with self._lock:
@@ -109,14 +109,18 @@ class RingSink:
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(
             maxlen=self.capacity)
-        self._emitted = 0
+        self._emitted = 0  # guarded_by: _lock
 
     def emit(self, record: dict) -> None:
         with self._lock:
             self._ring.append(record)
             self._emitted += 1
-        if self.inner is not None:
-            safe_emit(self.inner, record)
+            # tee under the lock: the inner sink sees records in the
+            # same order the ring does, so a frozen bundle's tail is a
+            # suffix of the inner sink's stream (two emitters racing
+            # outside the lock could cross-order the two sinks)
+            if self.inner is not None:
+                safe_emit(self.inner, record)
 
     @property
     def records(self) -> List[dict]:
@@ -153,7 +157,7 @@ class JsonlSink:
     def __init__(self, path: str) -> None:
         self.path = path
         self._lock = threading.Lock()
-        self._f = open(path, "a")
+        self._f = open(path, "a")  # guarded_by: _lock
 
     def emit(self, record: dict) -> None:
         line = json.dumps(record, sort_keys=True, default=str)

@@ -94,13 +94,21 @@ class Request:
 
     ``wait()`` with no explicit timeout uses the ENDPOINT's timeout as a
     real deadline (raising TimeoutError) rather than blocking forever — a
-    dead peer costs a bounded wait, never a hung serving process."""
+    dead peer costs a bounded wait, never a hung serving process.
+
+    Deadlines are computed against the endpoint's injectable ``clock``
+    (the same seam the fake-clock batcher tests use): with the default
+    ``time.monotonic`` the wait is a single blocking ``Event.wait``;
+    with an injected clock it polls short real slices against the
+    injected time so a test can advance the deadline synthetically."""
 
     def __init__(self, kind: str, lock: threading.Lock,
-                 default_timeout: Optional[float] = None):
+                 default_timeout: Optional[float] = None,
+                 clock=time.monotonic):
         self.kind = kind
         self._lock = lock  # endpoint matching lock
         self._default_timeout = default_timeout
+        self._clock = clock
         self._done = threading.Event()
         self._cancelled = False
         self._value = None
@@ -114,10 +122,24 @@ class Request:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def _wait_done(self, timeout: Optional[float]) -> bool:
+        if timeout is None:
+            self._done.wait()
+            return True
+        if self._clock is time.monotonic:
+            return self._done.wait(timeout)
+        # injected clock: real-time slices, injected-time deadline
+        deadline = self._clock() + timeout
+        while True:
+            if self._done.wait(0.02):
+                return True
+            if self._clock() >= deadline:
+                return False
+
     def wait(self, timeout: Optional[float] = None):
         if timeout is None:
             timeout = self._default_timeout
-        if not self._done.wait(timeout):
+        if not self._wait_done(timeout):
             with self._lock:
                 if not self._done.is_set():  # lost the race with delivery?
                     self._cancelled = True
@@ -191,7 +213,8 @@ class HostP2P:
                  peers: Optional[Sequence[Tuple[str, int]]] = None,
                  base_port: int = 41300, timeout: float = 120.0,
                  retries: int = 3, retry_backoff: float = 0.05,
-                 retry_backoff_max: float = 2.0, peer_grace: float = 2.0):
+                 retry_backoff_max: float = 2.0, peer_grace: float = 2.0,
+                 clock=time.monotonic):
         self.rank = int(rank)
         self.size = int(size)
         self.timeout = timeout
@@ -199,6 +222,10 @@ class HostP2P:
         self.retry_backoff = float(retry_backoff)
         self.retry_backoff_max = float(retry_backoff_max)
         self.peer_grace = float(peer_grace)
+        # every deadline in the endpoint (wait/waitall, the connect
+        # handshake, the peer-grace window) is computed on this clock —
+        # the same injectable seam the fake-clock Batcher tests use
+        self._clock = clock
         self.peers = (list(peers) if peers is not None
                       else [("127.0.0.1", base_port + r)
                             for r in range(size)])
@@ -207,19 +234,22 @@ class HostP2P:
         # receiver matching state, all under one lock: FIFO inbox of
         # unclaimed messages + FIFO queue of waiting irecvs per (src, tag)
         self._match_lock = threading.Lock()
-        self._inbox: dict = {}  # (src, tag) -> deque of payloads
-        self._waiting: dict = {}  # (src, tag) -> deque of Requests
-        # per-src delivery generation counters (under _match_lock): an
-        # abnormal connection drop schedules a grace check against the
-        # generation at drop time — any later delivery proves the peer
-        # (or its retry) is alive and voids the death verdict
-        self._peer_gen: dict = {}
+        # (src, tag) -> deque of payloads
+        self._inbox: dict = {}  # guarded_by: _match_lock
+        # (src, tag) -> deque of Requests
+        self._waiting: dict = {}  # guarded_by: _match_lock
+        # per-src delivery generation counters: an abnormal connection
+        # drop schedules a grace check against the generation at drop
+        # time — any later delivery proves the peer (or its retry) is
+        # alive and voids the death verdict
+        self._peer_gen: dict = {}  # guarded_by: _match_lock
         # per-destination sender worker: one persistent connection, FIFO
-        self._send_queues: dict = {}
+        self._send_queues: dict = {}  # guarded_by: _send_lock
         self._send_lock = threading.Lock()
         # dest -> live outbound socket (test hook _sever_send cuts it)
-        self._active_send: dict = {}
-        self._conns: set = set()  # live accepted connections (see close())
+        self._active_send: dict = {}  # guarded_by: _send_lock
+        # live accepted connections (see close())
+        self._conns: set = set()  # guarded_by: _conns_lock
         self._conns_lock = threading.Lock()
         self._closed = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -303,9 +333,28 @@ class HostP2P:
     def _schedule_peer_check(self, src: int) -> None:
         with self._match_lock:
             gen = self._peer_gen.get(src, 0)
-        t = threading.Timer(self.peer_grace, self._peer_check, (src, gen))
-        t.daemon = True
+        t = threading.Thread(
+            target=self._grace_wait, args=(src, gen), daemon=True,
+            name=f"raft-tpu-p2p-grace-{self.rank}-{src}")
         t.start()
+
+    def _grace_wait(self, src: int, gen: int) -> None:
+        """Sleep out the grace window on the endpoint clock, observing
+        ``_closed`` (a plain threading.Timer observes neither the clock
+        seam nor close(), so a fake-clock test could never expire it and
+        close() could leak a pending verdict)."""
+        deadline = self._clock() + self.peer_grace
+        while not self._closed.is_set():
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                self._peer_check(src, gen)
+                return
+            # injected clock: short real slices so synthetic time
+            # advances are observed promptly
+            slice_s = remaining if self._clock is time.monotonic \
+                else min(remaining, 0.02)
+            if self._closed.wait(slice_s):
+                return
 
     def _peer_check(self, src: int, gen: int) -> None:
         """Grace timer body: if ``src`` has delivered nothing since the
@@ -351,7 +400,7 @@ class HostP2P:
         if self._closed.is_set():
             raise ConnectionError("irecv on a closed HostP2P endpoint")
         req = Request("irecv", self._match_lock,
-                      default_timeout=self.timeout)
+                      default_timeout=self.timeout, clock=self._clock)
         with self._match_lock:
             box = self._inbox.get((source, tag))
             if box:
@@ -425,7 +474,7 @@ class HostP2P:
         rc = sock.connect_ex(addr)
         if rc not in (0, errno.EINPROGRESS, errno.EWOULDBLOCK):
             raise OSError(rc, os.strerror(rc))
-        deadline = time.monotonic() + self.timeout
+        deadline = self._clock() + self.timeout
         sel = selectors.DefaultSelector()
         try:
             if rc != 0:
@@ -439,7 +488,7 @@ class HostP2P:
             while rc != 0:
                 if self._closed.is_set():
                     raise _EndpointClosed("HostP2P closed during connect")
-                if time.monotonic() > deadline:
+                if self._clock() > deadline:
                     raise TimeoutError(
                         f"connect to rank {dest} {addr} timed out after "
                         f"{self.timeout}s")
@@ -584,7 +633,7 @@ class HostP2P:
         if self._closed.is_set():
             raise ConnectionError("isend on a closed HostP2P endpoint")
         req = Request("isend", self._match_lock,
-                      default_timeout=self.timeout)
+                      default_timeout=self.timeout, clock=self._clock)
         ty, raw = _encode(payload)  # encode eagerly: caller may mutate
         q = self._sender_for(dest)
         q.put((req, tag, ty, raw))
@@ -605,12 +654,16 @@ class HostP2P:
         (None for sends). ``timeout`` is ONE deadline for the whole batch,
         not per-request: each wait gets only the time remaining.
         ``timeout=None`` falls back to each request's endpoint timeout —
-        a real deadline either way, never an unbounded hang."""
+        a real deadline either way, never an unbounded hang. The deadline
+        runs on the first request's endpoint clock (one endpoint's
+        requests share it), so fake-clock tests drive it too."""
         if timeout is None:
             return [r.wait() for r in requests]
-        deadline = time.monotonic() + timeout
-        return [r.wait(max(deadline - time.monotonic(), 0.0))
-                for r in requests]
+        if not requests:
+            return []
+        clock = requests[0]._clock
+        deadline = clock() + timeout
+        return [r.wait(max(deadline - clock(), 0.0)) for r in requests]
 
     def sendrecv(self, payload, dest: int, source: int, tag: int = 0):
         """Convenience paired exchange (device_sendrecv's host analog)."""

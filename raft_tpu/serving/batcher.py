@@ -128,9 +128,9 @@ class Batcher:
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)
-        self._queue: List[Request] = []
-        self._expired: List[Request] = []
-        self._stopping = False
+        self._queue: List[Request] = []  # guarded_by: _lock
+        self._expired: List[Request] = []  # guarded_by: _lock
+        self._stopping = False  # guarded_by: _lock
 
     def __len__(self) -> int:
         with self._lock:

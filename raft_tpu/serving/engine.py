@@ -157,18 +157,31 @@ class CircuitBreaker:
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
         self._lock = threading.Lock()
-        self._state = "closed"
-        self._opened_at: Optional[float] = None
+        self._state = "closed"  # guarded_by: _lock
+        self._opened_at: Optional[float] = None  # guarded_by: _lock
+        # trip-generation counter: batch results are stamped with the
+        # epoch captured at launch, so a result from a batch launched
+        # BEFORE the most recent trip can never decide a half-open
+        # probe (it proves nothing about the device after the hang)
+        self._epoch = 0  # guarded_by: _lock
 
     @property
     def state(self) -> str:
         with self._lock:
             return self._state
 
+    @property
+    def epoch(self) -> int:
+        """Current trip generation — capture at batch launch and pass
+        back via :meth:`on_batch_result`."""
+        with self._lock:
+            return self._epoch
+
     def trip(self) -> None:
         with self._lock:
             self._state = "open"
             self._opened_at = self.clock()
+            self._epoch += 1
 
     def admit(self) -> bool:
         """True when a new request may enter (closed, or half-open probe
@@ -182,11 +195,21 @@ class CircuitBreaker:
                 return False
             return True
 
-    def on_batch_result(self, ok: bool) -> None:
+    def on_batch_result(self, ok: bool,
+                        epoch: Optional[int] = None) -> None:
         """Probe verdict: only meaningful in half-open (a closed breaker
         ignores batch failures — those are contained per-batch, not a
-        device-health signal; only the watchdog's hang verdict opens)."""
+        device-health signal; only the watchdog's hang verdict opens).
+
+        ``epoch`` is the value of :attr:`epoch` when the batch was
+        launched; a result whose epoch predates the last trip is stale
+        (the batch ran against the device state that caused the hang)
+        and is discarded rather than closing or re-opening the breaker.
+        ``None`` keeps the legacy always-current behavior for direct
+        unit-test calls."""
         with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return
             if self._state != "half_open":
                 return
             if ok:
@@ -284,7 +307,10 @@ class Engine:
     def __init__(self, searcher: Searcher,
                  config: Optional[EngineConfig] = None,
                  clock=time.perf_counter):
-        self._searcher = searcher
+        # reads outside the lock (submit/health) tolerate one-swap
+        # staleness by design; every WRITE holds _swap_lock so a batch
+        # runs whole on exactly one (searcher, gen) pair
+        self._searcher = searcher  # guarded_by: _swap_lock
         self.config = config or EngineConfig()
         self.clock = clock
         self.stats = ServingStats(window=self.config.stats_window,
@@ -304,22 +330,29 @@ class Engine:
                                   self._high_watermark - 1)
         self._shed_rng = _random.Random(cfg.shed_seed)
         self._admission_lock = threading.Lock()
-        self._shedding = False
+        self._shedding = False  # guarded_by: _admission_lock
         self.breaker = CircuitBreaker(cfg.breaker_cooldown_s, clock)
         self._completion: _queue.Queue = _queue.Queue()
         self._inflight = threading.Semaphore(self.config.max_inflight)
-        self._outstanding = 0
+        self._outstanding = 0  # guarded_by: _outstanding_cv
         self._outstanding_cv = threading.Condition()
         self._swap_lock = threading.Lock()
         self._calls_lock = threading.Lock()
-        self._calls: dict = {}  # id(call) -> live device-call record
+        # id(call) -> live device-call record
+        self._calls: dict = {}  # guarded_by: _calls_lock
         self._watchdog_stop = threading.Event()
-        self._dispatch_thread: Optional[threading.Thread] = None
-        self._completion_thread: Optional[threading.Thread] = None
-        self._watchdog_thread: Optional[threading.Thread] = None
-        self._started = False
-        self._stopped = False
-        self.warmup_info: dict = {}
+        # start()-once lifecycle: thread handles and flags transition
+        # a single time before/after the worker threads exist; readers
+        # tolerate staleness (rebind of an immutable reference)
+        self._dispatch_thread: Optional[
+            threading.Thread] = None  # guarded_by: atomic
+        self._completion_thread: Optional[
+            threading.Thread] = None  # guarded_by: atomic
+        self._watchdog_thread: Optional[
+            threading.Thread] = None  # guarded_by: atomic
+        self._started = False  # guarded_by: atomic
+        self._stopped = False  # guarded_by: atomic
+        self.warmup_info: dict = {}  # guarded_by: atomic (start() rebind)
         # ---- telemetry (docs/observability.md)
         self._flight_ring: Optional[obs_spans.RingSink] = None
         if cfg.flight_recorder:
@@ -330,11 +363,12 @@ class Engine:
             self._span_sink = self._flight_ring
         else:
             self._span_sink = cfg.span_sink
-        self.last_diagnostics: Optional[dict] = None
-        self._last_dump_t: Optional[float] = None
+        # rebind-only: each dump publishes a fresh immutable doc
+        self.last_diagnostics: Optional[dict] = None  # guarded_by: atomic
+        self._last_dump_t: Optional[float] = None  # guarded_by: _dump_lock
         self._dump_lock = threading.Lock()
         self._batch_seq = itertools.count(1)
-        self._searcher_gen = 0
+        self._searcher_gen = 0  # guarded_by: _swap_lock
         self.metrics_server: Optional[MetricsServer] = None
         budget_ms = cfg.deadline_budget_ms
         if budget_ms is None:
@@ -631,18 +665,24 @@ class Engine:
         recorded in ``stats.coverage_transitions``."""
         if self._stopped:
             raise EngineStopped("engine is stopped")
-        old = self._searcher
-        if searcher.dim != old.dim:
+        # snapshot for validation only: dim/query_dtype are invariant
+        # across swaps, so a concurrent swap can't invalidate the check
+        snap = self._searcher
+        if searcher.dim != snap.dim:
             raise ValueError(
-                f"swap_index dim mismatch: {searcher.dim} != {old.dim}")
-        if searcher.query_dtype != old.query_dtype:
+                f"swap_index dim mismatch: {searcher.dim} != {snap.dim}")
+        if searcher.query_dtype != snap.query_dtype:
             raise ValueError(
                 f"swap_index query_dtype mismatch: {searcher.query_dtype}"
-                f" != {old.query_dtype}")
+                f" != {snap.query_dtype}")
         searcher.place()
         if warm and self._started:
             self._warm(searcher)
         with self._swap_lock:
+            # capture the outgoing handle under the lock so the
+            # (old, new) coverage transition pairs correctly even when
+            # two swaps race
+            old = self._searcher
             self._searcher = searcher
             self._searcher_gen += 1
             gen = self._searcher_gen
@@ -751,11 +791,16 @@ class Engine:
         except Exception:
             pass
 
-    def _on_batch_failure(self) -> None:
+    def _on_batch_failure(self, epoch: Optional[int] = None) -> None:
         """Report a failed batch to the breaker; when that re-opens it
         (a half-open probe failed), freeze a bundle — the operator will
-        want the spans from the probe that kept the breaker open."""
-        self.breaker.on_batch_result(False)
+        want the spans from the probe that kept the breaker open.
+
+        ``epoch`` is the breaker epoch stamped at batch LAUNCH (see
+        ``CircuitBreaker.on_batch_result``): a late result from a batch
+        launched before the last trip says nothing about current device
+        health and must not flip the breaker state."""
+        self.breaker.on_batch_result(False, epoch)
         if self.breaker.state == "open":
             self._auto_dump("breaker_open")
 
@@ -967,7 +1012,10 @@ class Engine:
         # and into every rider's span record
         meta = {"batch_id": next(self._batch_seq), "bucket": bucket,
                 "batch_size": len(live), "searcher_gen": gen,
-                "coverage": round(float(searcher.coverage), 6)}
+                "coverage": round(float(searcher.coverage), 6),
+                # launch-time breaker epoch: a result from a batch
+                # launched before a trip must not flip breaker state
+                "breaker_epoch": self.breaker.epoch}
         try:
             t_pad0 = self.clock()
             batch = np.zeros((bucket, searcher.dim), searcher.query_dtype)
@@ -983,7 +1031,7 @@ class Engine:
             self._inflight.release()
             self._fail_requests(live, BatchFailed("dispatch failed",
                                                   cause=e), meta=meta)
-            self._on_batch_failure()
+            self._on_batch_failure(meta.get("breaker_epoch"))
             return
         if hung:
             # the watchdog already failed these futures and settled the
@@ -1011,7 +1059,8 @@ class Engine:
                 self._fail_requests(
                     b.requests, BatchFailed("readback failed", cause=e),
                     meta=b.meta)
-                self._on_batch_failure()
+                self._on_batch_failure(
+                    b.meta.get("breaker_epoch") if b.meta else None)
                 continue
             t_read1 = self.clock()
             hung = self._end_device_call(call)
@@ -1037,7 +1086,8 @@ class Engine:
                     r.future.set_result((d_np[j], i_np[j]))
                     resolved += 1
                     self._emit_request_outcome(r, "ok", **meta)
-            self.breaker.on_batch_result(True)
+            self.breaker.on_batch_result(
+                True, b.meta.get("breaker_epoch") if b.meta else None)
             self.stats.record_batch(
                 len(b.requests), b.bucket,
                 [b.t_launch - r.t_submit for r in b.requests],

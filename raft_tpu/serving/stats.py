@@ -89,8 +89,9 @@ class ServingStats:
         self.registry = registry if registry is not None \
             else obs_metrics.REGISTRY
         self.engine_label = engine_label or f"engine{next(_engine_seq)}"
-        self._lock = threading.Lock()   # guards coverage_transitions only
-        self.coverage_transitions = []  # [(old, new), ...] per swap
+        self._lock = threading.Lock()
+        # [(old, new), ...] per swap
+        self.coverage_transitions = []  # guarded_by: _lock
         r, e = self.registry, self.engine_label
 
         req = r.counter(
@@ -139,8 +140,12 @@ class ServingStats:
                 "raft_tpu_serving_total_seconds",
                 "Admission to future resolved.", ("engine",)).labels(e),
         }
-        # windowing: snapshot() diffs against these baselines
-        self._base = {k: h.snapshot() for k, h in self._hists.items()}
+        # windowing: snapshot() diffs against these baselines.
+        # rebind-only: reset_samples() publishes a fresh immutable dict;
+        # readers capture ONE local reference so a concurrent re-baseline
+        # cannot mix old and new baselines within a single snapshot
+        self._base = {k: h.snapshot()
+                      for k, h in self._hists.items()}  # guarded_by: atomic
 
     # --------------------------------------------------- counter views
     @property
@@ -271,7 +276,8 @@ class ServingStats:
 
     # ----------------------------------------------------------- scraping
     def _window_diffs(self):
-        return {k: h.snapshot() - self._base[k]
+        base = self._base  # one capture: coherent across components
+        return {k: h.snapshot() - base[k]
                 for k, h in self._hists.items()}
 
     def snapshot(self) -> dict:
@@ -302,9 +308,10 @@ class ServingStats:
             snap["mean_batch_size"] = round(
                 sum(k * v for k, v in snap["batch_size_hist"].items())
                 / snap["n_batches"], 2)
+        base = self._base  # one capture: coherent across components
         for key, name in (("queue_wait", "queue_wait_ms"),
                           ("device", "device_ms"), ("total", "total_ms")):
-            diff = self._hists[key].snapshot() - self._base[key]
+            diff = self._hists[key].snapshot() - base[key]
             if diff.count > 0:
                 snap[name] = {
                     "mean": round(diff.mean * 1e3, 3),

@@ -1,5 +1,7 @@
 """Test-support utilities shipped with the library (not the test suite):
 fault injectors for chaos-testing checkpoint restore, host p2p, and
-memory-budget behavior. See :mod:`raft_tpu.testing.faults`."""
+memory-budget behavior (:mod:`raft_tpu.testing.faults`), plus the
+seeded schedule amplifier for concurrency tests
+(:mod:`raft_tpu.testing.interleave`)."""
 
-from raft_tpu.testing import faults  # noqa: F401
+from raft_tpu.testing import faults, interleave  # noqa: F401

@@ -1,0 +1,21 @@
+"""T001 fixture: shared counter written from two thread entry points
+with no guarded_by declaration — genuinely racy at runtime (the
+read-modify-write spans two lines, so a preemption between them loses
+increments), which is what tests/test_interleave.py demonstrates."""
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()  # declared but never used
+        self.count = 0
+
+    def add(self, n):
+        for _ in range(n):
+            v = self.count
+            self.count = v + 1
+
+    def spin(self, n):
+        t = threading.Thread(target=self.add, args=(n,))
+        t.start()
+        return t

@@ -1,0 +1,20 @@
+"""T001 clean twin: the same two-line read-modify-write, but guarded
+(and the guard declared) — exact under any interleaving."""
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0  # guarded_by: _lock
+
+    def add(self, n):
+        for _ in range(n):
+            with self._lock:
+                v = self.count
+                self.count = v + 1
+
+    def spin(self, n):
+        t = threading.Thread(target=self.add, args=(n,))
+        t.start()
+        return t

@@ -1,0 +1,23 @@
+"""T002 clean twin: both methods take the locks in the same order, so
+the acquires-while-holding graph is acyclic."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._debit_lock = threading.Lock()
+        self._credit_lock = threading.Lock()
+        self.debits = 0  # guarded_by: _debit_lock
+        self.credits = 0  # guarded_by: _credit_lock
+
+    def move(self, n):
+        with self._debit_lock:
+            with self._credit_lock:
+                self.debits += n
+                self.credits += n
+
+    def refund(self, n):
+        with self._debit_lock:
+            with self._credit_lock:
+                self.credits -= n
+                self.debits -= n

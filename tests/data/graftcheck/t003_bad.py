@@ -1,0 +1,17 @@
+"""T003 fixture: an unbounded Future.result() while holding the lock —
+every other method on the object stalls behind a result that may never
+come."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.results = []  # guarded_by: _lock
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def run(self, fn):
+        fut = self._pool.submit(fn)
+        with self._lock:
+            self.results.append(fut.result())

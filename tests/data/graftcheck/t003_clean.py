@@ -1,0 +1,17 @@
+"""T003 clean twin: the blocking wait happens outside the lock (and
+with a timeout); the lock only covers the shared append."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.results = []  # guarded_by: _lock
+        self._pool = ThreadPoolExecutor(max_workers=1)
+
+    def run(self, fn):
+        fut = self._pool.submit(fn)
+        value = fut.result(timeout=30.0)
+        with self._lock:
+            self.results.append(value)

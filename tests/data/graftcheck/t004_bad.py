@@ -1,0 +1,21 @@
+"""T004 fixture: Condition.wait under `if` instead of `while` — a
+spurious wakeup (or a stolen wakeup between notify and resume) proceeds
+with the predicate still false."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.ready = False  # guarded_by: _lock
+
+    def await_ready(self):
+        with self._cv:
+            if not self.ready:
+                self._cv.wait()
+
+    def open(self):
+        with self._cv:
+            self.ready = True
+            self._cv.notify_all()

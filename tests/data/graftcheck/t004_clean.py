@@ -1,0 +1,20 @@
+"""T004 clean twin: the wait re-checks its predicate in a while loop,
+so spurious/stolen wakeups just go back to sleep."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self.ready = False  # guarded_by: _lock
+
+    def await_ready(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait()
+
+    def open(self):
+        with self._cv:
+            self.ready = True
+            self._cv.notify_all()

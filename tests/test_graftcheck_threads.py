@@ -1,0 +1,239 @@
+"""graftcheck --threads suite: T001–T004 on one-violation fixture twins,
+the derived thread model, the lock-order DOT export, and the repo gate
+(every live finding fixed or baseline-justified)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.analysis import ModuleInfo, load_baseline, split_by_baseline
+from raft_tpu.analysis.concurrency import (THREAD_RULES, build_class_models,
+                                           lock_order_dot,
+                                           rule_blocking_while_locked,
+                                           rule_condition_wait_loop,
+                                           rule_lock_order,
+                                           rule_unguarded_shared_state,
+                                           run_threads)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
+
+
+def _mod(fname, modname=None):
+    return ModuleInfo(os.path.join(FIXDIR, fname),
+                      f"tests/data/graftcheck/{fname}",
+                      modname or f"raft_tpu.fixture_pkg_b.{fname[:-3]}")
+
+
+def _tmp_mod(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    return ModuleInfo(str(p), name, f"raft_tpu.fixture.{name[:-3]}")
+
+
+# ------------------------------------------------------------ T-rule twins
+
+@pytest.mark.parametrize("rule,bad,clean,expect_qual", [
+    (rule_unguarded_shared_state, "t001_bad.py", "t001_clean.py",
+     "SharedCounter.count"),
+    (rule_lock_order, "t002_bad.py", "t002_clean.py",
+     "cycle:Transfer._credit_lock->Transfer._debit_lock"),
+    (rule_blocking_while_locked, "t003_bad.py", "t003_clean.py",
+     "Collector.run"),
+    (rule_condition_wait_loop, "t004_bad.py", "t004_clean.py",
+     "Gate.await_ready"),
+], ids=["T001", "T002", "T003", "T004"])
+def test_rule_flags_bad_and_passes_clean(rule, bad, clean, expect_qual):
+    rule_id = {rule_unguarded_shared_state: "T001",
+               rule_lock_order: "T002",
+               rule_blocking_while_locked: "T003",
+               rule_condition_wait_loop: "T004"}[rule]
+    found = rule(_mod(bad))
+    assert [(f.rule, f.qualname) for f in found] == [(rule_id, expect_qual)]
+    assert rule(_mod(clean)) == []
+
+
+def test_clean_twins_pass_every_thread_rule():
+    for fname in ("t001_clean.py", "t002_clean.py", "t003_clean.py",
+                  "t004_clean.py"):
+        mod = _mod(fname)
+        for rule in THREAD_RULES:
+            assert rule(mod) == [], (fname, rule.__name__)
+
+
+def test_t001_suppression_on_write_line(tmp_path):
+    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    src = src.replace("self.count = v + 1",
+                      "self.count = v + 1  # graftcheck: T001")
+    mod = _tmp_mod(tmp_path, "t001_suppressed.py", src)
+    assert rule_unguarded_shared_state(mod) == []
+
+
+def test_t001_bogus_guard_name_is_its_own_finding(tmp_path):
+    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    src = src.replace("self.count = 0",
+                      "self.count = 0  # guarded_by: _no_such_lock")
+    mod = _tmp_mod(tmp_path, "t001_bogus.py", src)
+    found = rule_unguarded_shared_state(mod)
+    assert [f.qualname for f in found] == ["SharedCounter.count"]
+    assert "no such attribute" in found[0].message
+
+
+def test_t001_atomic_escape_hatch(tmp_path):
+    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    src = src.replace("self.count = 0",
+                      "self.count = 0  # guarded_by: atomic")
+    mod = _tmp_mod(tmp_path, "t001_atomic.py", src)
+    assert rule_unguarded_shared_state(mod) == []
+
+
+def test_t001_guarded_by_decorator_covers_method_writes(tmp_path):
+    src = (
+        "import threading\n"
+        "from raft_tpu.analysis.concurrency import guarded_by\n\n\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.value = 0\n\n"
+        "    @guarded_by(\"_lock\")\n"
+        "    def _set_locked(self, v):\n"
+        "        self.value = v\n\n"
+        "    def set(self, v):\n"
+        "        with self._lock:\n"
+        "            self._set_locked(v)\n"
+    )
+    mod = _tmp_mod(tmp_path, "t001_decorated.py", src)
+    assert rule_unguarded_shared_state(mod) == []
+
+
+def test_guarded_by_runtime_decorator_is_a_noop():
+    from raft_tpu.analysis.concurrency import guarded_by
+
+    @guarded_by("_lock")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+
+
+# --------------------------------------------------- derived thread model
+
+def test_thread_targets_derived_from_spawn_sites():
+    models = build_class_models(_mod("t001_bad.py"))
+    (model,) = models
+    assert model.roots["add"] == "thread"  # Thread(target=self.add)
+    # public methods are client pseudo-roots, always multi-instance
+    assert model.roots["spin"] == "client"
+    assert "spin" in model.multi_roots
+
+
+def test_spawn_under_loop_marks_root_multi_instance(tmp_path):
+    src = (
+        "import threading\n\n\n"
+        "class Pool:\n"
+        "    def __init__(self, n):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = n\n\n"
+        "    def _work(self):\n"
+        "        pass\n\n"
+        "    def start(self):\n"
+        "        for _ in range(self.n):\n"
+        "            threading.Thread(target=self._work).start()\n"
+    )
+    mod = _tmp_mod(tmp_path, "pool.py", src)
+    (model,) = build_class_models(mod)
+    assert model.roots["_work"] == "thread"
+    assert "_work" in model.multi_roots
+
+
+def test_http_handler_do_methods_are_roots(tmp_path):
+    src = (
+        "from http.server import BaseHTTPRequestHandler\n\n\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        pass\n"
+    )
+    mod = _tmp_mod(tmp_path, "handler.py", src)
+    (model,) = build_class_models(mod)
+    assert model.roots["do_GET"] == "http"
+    assert "do_GET" in model.multi_roots
+
+
+def test_condition_canonicalizes_to_underlying_lock():
+    (model,) = build_class_models(_mod("t004_bad.py"))
+    assert model.canon_lock("_cv") == "_lock"
+
+
+# ---------------------------------------------------------- lock-order DOT
+
+def test_lock_order_dot_renders_cycle_red(tmp_path):
+    pkg = tmp_path / "raft_tpu"
+    pkg.mkdir()
+    bad = open(os.path.join(FIXDIR, "t002_bad.py")).read()
+    (pkg / "transfer.py").write_text(bad)
+    dot = lock_order_dot(str(tmp_path))
+    assert dot.startswith("digraph lock_order")
+    assert '"Transfer._debit_lock" -> "Transfer._credit_lock"' in dot
+    assert '"Transfer._credit_lock" -> "Transfer._debit_lock"' in dot
+    assert "color=red" in dot
+
+
+def test_repo_lock_order_graph_is_edge_free():
+    """The serving/comms/obs stack follows a leaf-lock discipline: no
+    code path holds two analyzer-visible locks at once, so the graph is
+    all nodes, no edges — the authoritative lock-order statement that
+    docs/serving.md and docs/robustness.md point at."""
+    dot = lock_order_dot(REPO)
+    assert "->" not in dot
+    assert '"Engine._swap_lock"' in dot  # nodes still documented
+
+
+# --------------------------------------------------------------- the gate
+
+def test_repo_is_clean_under_committed_baseline():
+    findings = run_threads(REPO)
+    baseline = load_baseline(os.path.join(REPO, "graftcheck_baseline.json"))
+    new, _ = split_by_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_cli_threads_nonzero_on_injected_violation(tmp_path):
+    pkg = tmp_path / "raft_tpu"
+    pkg.mkdir()
+    bad = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    (pkg / "injected.py").write_text(bad)
+    dot_path = tmp_path / "lock_order.dot"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+         "--root", str(tmp_path), "--no-baseline", "--threads",
+         "--dot", str(dot_path)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "T001" in proc.stdout
+    assert "SharedCounter.count" in proc.stdout
+    # the derived thread model is reported alongside the findings
+    assert "[threads]" in proc.stdout
+    assert dot_path.read_text().startswith("digraph lock_order")
+
+
+def test_cli_dot_requires_threads(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+         "--root", str(tmp_path), "--dot", "-"],
+        capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "--dot requires --threads" in proc.stderr
+
+
+def test_cli_without_threads_skips_t_rules(tmp_path):
+    pkg = tmp_path / "raft_tpu"
+    pkg.mkdir()
+    bad = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    (pkg / "injected.py").write_text(bad)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+         "--root", str(tmp_path), "--no-baseline"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "T001" not in proc.stdout

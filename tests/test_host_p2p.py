@@ -255,3 +255,94 @@ def test_close_fails_pending_irecv():
         r.wait(10)  # bounded, not a hang
     with pytest.raises(ConnectionError):
         ep.irecv(source=0)
+
+
+# ------------------------------------------------------- injectable clock
+
+class _FakeClock:
+    """Manually advanced monotonic clock (the fake-clock batcher idiom):
+    time moves only when the test says so."""
+
+    def __init__(self):
+        import threading
+        self._t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            return self._t
+
+    def advance(self, dt):
+        with self._lock:
+            self._t += dt
+
+
+def test_fake_clock_drives_request_deadline():
+    """Every Request deadline runs on the endpoint's injected clock: a
+    60s wait expires the instant synthetic time passes it (bounded real
+    time), and never while synthetic time stands still."""
+    import threading
+    import time as _time
+
+    ports = _ports(1)
+    clk = _FakeClock()
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=30,
+                 clock=clk)
+    try:
+        r = ep.irecv(source=0, tag=1)
+        t0 = _time.monotonic()
+        done = threading.Event()
+        raised = []
+
+        def waiter():
+            try:
+                r.wait(60.0)
+            except TimeoutError as e:
+                raised.append(e)
+            done.set()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        _time.sleep(0.2)
+        assert not done.is_set()  # synthetic time has not moved
+        clk.advance(61.0)
+        assert done.wait(5.0), "wait() must notice the synthetic expiry"
+        th.join()
+        assert raised, "expired deadline must raise TimeoutError"
+        assert _time.monotonic() - t0 < 5.0  # never 60 real seconds
+    finally:
+        ep.close()
+
+
+def test_fake_clock_drives_waitall_deadline():
+    """waitall's single batch deadline runs on the same injected clock
+    (it borrows the first request's endpoint clock)."""
+    import threading
+    import time as _time
+
+    ports = _ports(1)
+    clk = _FakeClock()
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=30,
+                 clock=clk)
+    try:
+        reqs = [ep.irecv(source=0, tag=t) for t in (1, 2, 3)]
+        done = threading.Event()
+        raised = []
+
+        def waiter():
+            try:
+                HostP2P.waitall(reqs, timeout=10.0)
+            except TimeoutError as e:
+                raised.append(e)
+            done.set()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        _time.sleep(0.2)
+        assert not done.is_set()
+        clk.advance(11.0)
+        assert done.wait(5.0)
+        th.join()
+        assert raised
+    finally:
+        ep.close()

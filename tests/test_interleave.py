@@ -1,0 +1,172 @@
+"""Seeded schedule amplification (raft_tpu.testing.interleave).
+
+Fast tier: the amplifier's mechanics (seed plumbing, guarded-field
+discovery, state restoration). Slow ``interleave`` tier: the T001
+fixture twins actually race/stay-exact under amplified preemption (the
+"fixture flips racy-fail -> pass when its flagged code is fixed"
+evidence for the analyzer), and the serving engine keeps its
+zero-dropped / zero-duplicated futures contract across 200 seeds."""
+import importlib.util
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.testing.interleave import (ENV_SEED, InterleaveAmplifier,
+                                         env_seed, guarded_fields, seeds)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
+
+
+def _load_fixture(fname, modname):
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(FIXDIR, fname))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- fast tier
+
+def test_env_seed_reads_environment(monkeypatch):
+    monkeypatch.delenv(ENV_SEED, raising=False)
+    assert env_seed() == 0
+    assert env_seed(7) == 7
+    monkeypatch.setenv(ENV_SEED, "41")
+    assert env_seed() == 41
+    monkeypatch.setenv(ENV_SEED, "not-an-int")
+    assert env_seed(3) == 3
+
+
+def test_seeds_sweep_is_anchored_and_distinct(monkeypatch):
+    monkeypatch.setenv(ENV_SEED, "100")
+    assert seeds(3) == [100, 101, 102]
+    assert seeds(2, base=7) == [7, 8]
+
+
+def test_guarded_fields_discovers_annotations():
+    fields = guarded_fields(
+        os.path.join(REPO, "raft_tpu", "serving", "batcher.py"))
+    assert "_queue" in fields and "_stopping" in fields
+
+
+def test_amplifier_restores_interpreter_state():
+    before_interval = sys.getswitchinterval()
+    with InterleaveAmplifier(seed=1, path_filters=("nothing-matches",)):
+        assert sys.getswitchinterval() != before_interval
+    assert sys.getswitchinterval() == before_interval
+    assert sys.gettrace() is None
+
+
+# ------------------------------------------- fixture twins actually race
+
+def _run_counter(counter_cls, seed, n=400, threads=2):
+    c = counter_cls()
+    with InterleaveAmplifier(seed=seed, yield_probability=0.2,
+                             path_filters=("t001_",)):
+        ts = [threading.Thread(target=c.add, args=(n,))
+              for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    return c.count, n * threads
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_t001_bad_fixture_races_under_amplifier():
+    """The code T001 flags demonstrably loses updates under amplified
+    preemption — within a handful of seeds, never needing luck."""
+    mod = _load_fixture("t001_bad.py", "t001_bad_runtime")
+    for seed in seeds(10):
+        got, want = _run_counter(mod.SharedCounter, seed)
+        if got != want:
+            return  # racy, as the finding claims
+    pytest.fail("t001_bad.SharedCounter never lost an increment "
+                "across 10 amplified seeds")
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_t001_clean_fixture_exact_under_amplifier():
+    """...and the fixed twin (the clean fixture) stays exact under the
+    same amplification: the racy-fail flips to pass."""
+    mod = _load_fixture("t001_clean.py", "t001_clean_runtime")
+    for seed in seeds(5):
+        got, want = _run_counter(mod.SharedCounter, seed)
+        assert got == want, f"seed {seed}: {got} != {want}"
+
+
+# --------------------------------- serving engine under amplified seeds
+
+def _fake_searcher(dim=8):
+    """Pure-numpy Searcher duck-type: no JAX compile per seed, instant
+    'device' results, so 200 amplified engine lifecycles stay cheap."""
+    from types import SimpleNamespace
+
+    from raft_tpu.serving.searchers import Searcher
+
+    def search(batch, k):
+        n = batch.shape[0]
+        d = np.tile(np.arange(k, dtype=np.float32), (n, 1))
+        i = np.tile(np.arange(k, dtype=np.int64), (n, 1))
+        return d, i
+
+    return Searcher("fake", dim, SimpleNamespace(), search)
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_engine_no_dropped_or_duplicated_futures_across_seeds():
+    """The chaos contract under amplified preemption: every submitted
+    future resolves exactly once (done, correct row shape), across 200
+    interleaving seeds with 3 concurrent submitters."""
+    from raft_tpu.obs import metrics as obs_metrics
+    from raft_tpu.serving.engine import Engine, EngineConfig
+
+    K, DIM, PER_THREAD, SUBMITTERS = 5, 8, 5, 3
+    fields = guarded_fields(
+        os.path.join(REPO, "raft_tpu", "serving", "engine.py"))
+    for seed in seeds(200):
+        cfg = EngineConfig(max_batch=4, max_wait_us=300,
+                           warm_ks=(K,), warm_buckets=(1, 4),
+                           persistent_cache=False, hang_timeout_s=None,
+                           flight_recorder=False,
+                           registry=obs_metrics.Registry())
+        engine = Engine(_fake_searcher(DIM), cfg)
+        futures = []
+        fut_lock = threading.Lock()
+
+        def submitter(eng=engine):
+            rng = np.random.default_rng(0)
+            for _ in range(PER_THREAD):
+                f = eng.submit(
+                    rng.standard_normal(DIM).astype(np.float32), K)
+                with fut_lock:
+                    futures.append(f)
+
+        with InterleaveAmplifier(
+                seed=seed, yield_probability=0.05,
+                path_filters=("raft_tpu",), fields=fields):
+            engine.start()
+            ts = [threading.Thread(target=submitter)
+                  for _ in range(SUBMITTERS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            engine.stop(drain=True)
+
+        assert len(futures) == PER_THREAD * SUBMITTERS, seed
+        for f in futures:  # resolved exactly once, with a real row
+            assert f.done(), f"seed {seed}: future never resolved"
+            d, i = f.result(timeout=0)
+            assert d.shape == (K,) and i.shape == (K,), seed
+        stats = engine.stats
+        assert stats.n_submitted == PER_THREAD * SUBMITTERS, seed
+        assert stats.n_completed == PER_THREAD * SUBMITTERS, seed
+        assert stats.n_failed == 0 and stats.n_cancelled == 0, seed

@@ -58,18 +58,11 @@ def test_small_batches_agree_across_bucket_sizes(setup):
                 atol=1e-5, err_msg=f"{name} batch {b}")
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="seed-state miss, present since the seed commit: CAGRA's "
-    "deterministic seed draw (jax.random.fold_in on the padded batch "
-    "size) can pick 32 seeds that all miss query 0's cluster at bucket "
-    "8 — this dataset is 16 well-separated clusters, so the kNN graph "
-    "has disconnected components the walk cannot cross (recall 0.0 for "
-    "that query at b in {1,3}; bucket 16 re-rolls the draw and passes). "
-    "Fixing it means cross-component seeding, not a re-rolled seed.")
 def test_cagra_small_batch_shapes_and_recall(setup):
-    """CAGRA seeds vary with the padded batch, so exact equality across
-    batch sizes isn't guaranteed — gate shape + per-query quality."""
+    """CAGRA's seed lattice is batch-size independent (row q's seeds
+    depend only on q), so small batches hit the same per-query recall
+    as large ones — 16 well-separated clusters make the kNN graph
+    disconnected, and the stratified lattice seeds every component."""
     db, q = setup
     res = Resources(seed=0)
     cg = cagra.build(db, cagra.IndexParams(graph_degree=16,

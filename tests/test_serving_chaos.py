@@ -398,3 +398,46 @@ def test_stop_drain_races_concurrent_submitters(searcher):
         d, i = f.result(timeout=0)
         assert d.shape == (K,) and i.shape == (K,)
     assert eng.stats.snapshot()["n_completed"] == len(futures)
+
+
+# ------------------------------------- amplified interleavings (slow tier)
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_stop_drain_race_amplified(searcher):
+    """The stop-drain stranded-future invariant, re-run under the seeded
+    schedule amplifier (raft_tpu.testing.interleave): forced preemptions
+    inside raft_tpu/serving must not surface a dropped or unresolved
+    future at any seed. Seed base via RAFT_TPU_INTERLEAVE_SEED."""
+    from raft_tpu.testing.interleave import InterleaveAmplifier, seeds
+
+    for seed in seeds(10):
+        eng = _engine(searcher, queue_high_watermark=4096)
+        futures = []
+        lock = threading.Lock()
+
+        def worker(ti):
+            trng = np.random.default_rng(300 + ti)
+            for _ in range(30):
+                try:
+                    f = eng.submit(_q(trng), K)
+                except serving.EngineStopped:
+                    return
+                with lock:
+                    futures.append(f)
+
+        with InterleaveAmplifier(seed=seed, yield_probability=0.05,
+                                 path_filters=("raft_tpu/serving",)):
+            eng.start()
+            threads = [threading.Thread(target=worker, args=(ti,))
+                       for ti in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            eng.stop(drain=True)
+            for t in threads:
+                t.join()
+
+        for f in futures:
+            assert f.done(), f"seed {seed}: stranded future"
+            d, i = f.result(timeout=0)
+            assert d.shape == (K,) and i.shape == (K,), seed

@@ -14,6 +14,8 @@ Typical use::
 
     python tools/graftcheck.py                    # Tier A, gate on baseline
     python tools/graftcheck.py --jaxpr-audit      # Tier A + Tier B
+    python tools/graftcheck.py --threads          # + concurrency T001-T004
+    python tools/graftcheck.py --threads --dot lock_order.dot
     python tools/graftcheck.py --update-baseline  # re-record the baseline
 """
 
@@ -27,8 +29,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-from raft_tpu.analysis import (load_baseline, run_tier_a,  # noqa: E402
-                               save_baseline, split_by_baseline,
+from raft_tpu.analysis import (load_baseline, run_threads,  # noqa: E402
+                               run_tier_a, save_baseline, split_by_baseline,
                                unjustified_keys)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftcheck_baseline.json")
@@ -50,6 +52,15 @@ def main(argv=None) -> int:
     ap.add_argument("--jaxpr-audit", action="store_true",
                     help="also run the Tier-B jaxpr memory-budget audit "
                          "(imports JAX)")
+    ap.add_argument("--threads", action="store_true",
+                    help="also run the concurrency-discipline rules "
+                         "T001-T004 over raft_tpu/ (pure AST; derives "
+                         "the thread model from Thread/Timer/HTTP-handler "
+                         "call sites)")
+    ap.add_argument("--dot", metavar="PATH", default=None,
+                    help="with --threads: write the acquires-while-"
+                         "holding lock-order graph as Graphviz DOT "
+                         "('-' = stdout)")
     ap.add_argument("--costs", action="store_true",
                     help="also run the Tier-C compiled-cost calibration "
                          "audit: AOT-compile the canonical cores and flag "
@@ -66,7 +77,26 @@ def main(argv=None) -> int:
                     help="print only the summary line")
     args = ap.parse_args(argv)
 
+    if args.dot is not None and not args.threads:
+        ap.error("--dot requires --threads")
+
     findings = run_tier_a(args.root)
+
+    if args.threads:
+        findings.extend(run_threads(args.root))
+        if not args.quiet:
+            from raft_tpu.analysis.concurrency import thread_model_summary
+            for line in thread_model_summary(args.root):
+                print(f"  [threads] {line}")
+        if args.dot is not None:
+            from raft_tpu.analysis.concurrency import lock_order_dot
+            dot = lock_order_dot(args.root)
+            if args.dot == "-":
+                sys.stdout.write(dot)
+            else:
+                with open(args.dot, "w") as f:
+                    f.write(dot)
+                print(f"graftcheck: lock-order graph -> {args.dot}")
 
     if args.jaxpr_audit:
         from raft_tpu.analysis import jaxpr_audit
