@@ -468,7 +468,7 @@ def make_cagra_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
     itopk=64, width=1 — the IndexParams/SearchParams defaults). No byte
     planner: the beam state is O(nq·itopk), shape-independent of n, so
     there is nothing for a workspace solver to tile. Not part of the
-    seven audited entries (the walker's upper bound over a 74-iteration
+    audited entries (the walker's upper bound over a 74-iteration
     while_loop is vacuous); it exists for the compiled-cost layer, which
     needs all four ANN families in the roofline report."""
     from raft_tpu.neighbors import cagra
@@ -500,13 +500,196 @@ def make_cagra_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES, **kw):
     return jax.make_jaxpr(core)(*args)
 
 
+# The fused (Pallas scan+select) variants. Their planners solve the
+# ~16 MiB VMEM budget, not ``budget_bytes`` — the HBM workspace the
+# walker audits is whatever the dispatch stages around the kernel, which
+# the ``fused_*_workspace_bytes`` accounting predicts for C001. The
+# cores are traced with ``interpret=True`` so the obs.costs layer can
+# AOT-compile them on the CPU backend; the pallas_call eqn carries its
+# kernel jaxpr, which the walker recurses into (the on-chip live set is
+# VMEM-scale, so it never threatens the HBM budget).
+
+def make_brute_force_fused_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                                n_db: int = 1_000_000, nq: int = 10_000,
+                                dim: int = 128, k: int = 100):
+    """brute_force fused scan+select at 1M×128, VMEM tiles from the
+    public plan."""
+    from raft_tpu.neighbors import brute_force
+    from raft_tpu.ops import pallas_kernels as pk
+
+    tm, tn = pk.plan_fused_topk_tiles(nq, n_db, dim, k)
+    meta = {"family": "brute_force",
+            "planner": "pallas_kernels.plan_fused_topk_tiles",
+            "predicted_bytes": pk.fused_topk_workspace_bytes(
+                nq, n_db, dim, k, tm, tn),
+            "tiles": {"tm": tm, "tn": tn}}
+
+    def core(queries, dataset, db_norms):
+        return brute_force.knn_fused_core(
+            queries, dataset, db_norms, k=k, tm=tm, tn=tn, sqrt=False,
+            interpret=True)
+
+    args = (
+        _sds((nq, dim), np.float32),
+        _sds((n_db, dim), np.float32),
+        _sds((n_db,), np.float32))
+    return core, args, meta
+
+
+def make_brute_force_fused_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                                 **kw):
+    core, args, _ = make_brute_force_fused_core(budget_bytes, **kw)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_flat_fused_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                             shape: Optional[Sift1MCrashShape] = None):
+    """ivf_flat fused scan+select at the 1M shape (fp32 slab resident,
+    probed tiles DMA'd per (query, probe) grid step)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.ops import pallas_kernels as pk
+    from raft_tpu.ops.distance import DistanceType
+
+    s = shape or Sift1MCrashShape()
+    pad_tile = pk.plan_fused_ivf_tile(s.list_pad, s.dim, s.k, 4)
+    meta = {"family": "ivf_flat",
+            "planner": "pallas_kernels.plan_fused_ivf_tile",
+            "predicted_bytes": pk.fused_ivf_workspace_bytes(
+                s.nq, s.n_probes, s.dim, s.n_lists, s.list_pad, s.k, 4,
+                pad_tile),
+            "tiles": {"pad_tile": pad_tile}}
+
+    def core(queries, centers, list_data, list_indices, list_sizes,
+             row_norms):
+        return ivf_flat.search_fused_core(
+            queries, centers, list_data, list_indices, list_sizes,
+            row_norms, jnp.zeros((0, s.dim), jnp.float32),
+            jnp.zeros((0,), jnp.int32), DistanceType.L2Expanded, s.k,
+            s.n_probes, pad_tile, has_overflow=False, interpret=True)
+
+    args = (
+        _sds((s.nq, s.dim), np.float32),
+        _sds((s.n_lists, s.dim), np.float32),
+        _sds((s.n_lists, s.list_pad, s.dim), np.float32),
+        _sds((s.n_lists, s.list_pad), np.int32),
+        _sds((s.n_lists,), np.int32),
+        _sds((s.n_lists, s.list_pad), np.float32))
+    return core, args, meta
+
+
+def make_ivf_flat_fused_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                              shape: Optional[Sift1MCrashShape] = None):
+    core, args, _ = make_ivf_flat_fused_core(budget_bytes, shape)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_pq_fused_lut_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                               shape: Optional[Sift1MCrashShape] = None):
+    """ivf_pq fused LUT engine at the sift-1M crash shape: the per-probe
+    LUT is built in VMEM from the resident codebooks and the packed code
+    slab is read directly — the candidate slab that crashed PR-1's
+    unbounded planning never exists in HBM."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.ops import pallas_kernels as pk
+    from raft_tpu.ops.distance import DistanceType
+
+    s = shape or Sift1MCrashShape()
+    pad_tile = pk.plan_fused_pq_tile(s.list_pad, s.pq_dim, s.book,
+                                     s.pq_len, s.k)
+    meta = {"family": "ivf_pq",
+            "planner": "pallas_kernels.plan_fused_pq_tile",
+            "predicted_bytes": pk.fused_pq_workspace_bytes(
+                s.nq, s.n_probes, s.rot_dim, s.n_lists, s.list_pad,
+                s.pq_dim, s.book, s.pq_len, s.k, pad_tile),
+            "tiles": {"pad_tile": pad_tile}}
+
+    def core(queries, centers, rotation, codebooks, list_codes,
+             list_indices, list_sizes):
+        return ivf_pq.search_fused_lut_core(
+            queries, centers, rotation, codebooks, list_codes,
+            list_indices, list_sizes,
+            jnp.zeros((0, s.rot_dim), jnp.float32),
+            jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32),
+            DistanceType.L2Expanded, s.k, s.n_probes, pad_tile,
+            has_overflow=False, interpret=True)
+
+    args = (
+        _sds((s.nq, s.dim), np.float32),
+        _sds((s.n_lists, s.dim), np.float32),
+        _sds((s.rot_dim, s.dim), np.float32),
+        _sds((s.pq_dim, s.book, s.pq_len), np.float32),
+        _sds((s.n_lists, s.list_pad, s.n_code_bytes), np.uint8),
+        _sds((s.n_lists, s.list_pad), np.int32),
+        _sds((s.n_lists,), np.int32))
+    return core, args, meta
+
+
+def make_ivf_pq_fused_lut_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                                shape: Optional[Sift1MCrashShape] = None):
+    core, args, _ = make_ivf_pq_fused_lut_core(budget_bytes, shape)
+    return jax.make_jaxpr(core)(*args)
+
+
+def make_ivf_pq_fused_cache_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                                 shape: Optional[Sift1MCrashShape] = None):
+    """ivf_pq fused cache engine at the sift-1M shape (fp32 decoded
+    cache; same kernel as ivf_flat but in the rotated ADC space, so no
+    clamp)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.ops import pallas_kernels as pk
+    from raft_tpu.ops.distance import DistanceType
+
+    s = shape or Sift1MCrashShape()
+    pad_tile = pk.plan_fused_ivf_tile(s.list_pad, s.rot_dim, s.k, 4)
+    meta = {"family": "ivf_pq",
+            "planner": "pallas_kernels.plan_fused_ivf_tile",
+            "predicted_bytes": pk.fused_ivf_workspace_bytes(
+                s.nq, s.n_probes, s.rot_dim, s.n_lists, s.list_pad, s.k,
+                4, pad_tile),
+            "tiles": {"pad_tile": pad_tile}}
+
+    def core(queries, centers, rotation, list_decoded, decoded_norms,
+             list_indices, list_sizes):
+        return ivf_pq.search_fused_cache_core(
+            queries, centers, rotation, list_decoded, decoded_norms,
+            list_indices, list_sizes,
+            jnp.zeros((0, s.rot_dim), jnp.float32),
+            jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32),
+            DistanceType.L2Expanded, s.k, s.n_probes, pad_tile,
+            has_overflow=False, interpret=True)
+
+    args = (
+        _sds((s.nq, s.dim), np.float32),
+        _sds((s.n_lists, s.dim), np.float32),
+        _sds((s.rot_dim, s.dim), np.float32),
+        _sds((s.n_lists, s.list_pad, s.rot_dim), np.float32),
+        _sds((s.n_lists, s.list_pad), np.float32),
+        _sds((s.n_lists, s.list_pad), np.int32),
+        _sds((s.n_lists,), np.int32))
+    return core, args, meta
+
+
+def make_ivf_pq_fused_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                                  shape: Optional[Sift1MCrashShape] = None):
+    core, args, _ = make_ivf_pq_fused_cache_core(budget_bytes, shape)
+    return jax.make_jaxpr(core)(*args)
+
+
 def canonical_cores(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
-    """The seven canonical entrypoints as ``(name, make_core)`` pairs —
+    """The eleven canonical entrypoints as ``(name, make_core)`` pairs —
     the SAME names and shapes ``default_entries`` audits, exposed so the
     compiled-cost layer (:mod:`raft_tpu.obs.costs`) lowers and compiles
     exactly what the jaxpr walker abstract-evals. ``make_core()`` →
     ``(core, args, meta)`` with the planner name + predicted workspace
-    bytes in ``meta``."""
+    bytes in ``meta``. The four ``[fused*]`` entries are the Pallas
+    scan+select variants, traced in interpret mode so they compile on
+    CPU."""
     b = budget_bytes
     return [
         ("ivf_pq.search[lut]@sift1m-crash",
@@ -523,6 +706,14 @@ def canonical_cores(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
          lambda: make_select_k_core(b)),
         ("fused_l2_nn@100kx4096",
          lambda: make_fused_l2_nn_core(b)),
+        ("brute_force.knn[fused]@1m",
+         lambda: make_brute_force_fused_core(b)),
+        ("ivf_flat.search[fused]@sift1m",
+         lambda: make_ivf_flat_fused_core(b)),
+        ("ivf_pq.search[fused-lut]@sift1m-crash",
+         lambda: make_ivf_pq_fused_lut_core(b)),
+        ("ivf_pq.search[fused-cache]@sift1m",
+         lambda: make_ivf_pq_fused_cache_core(b)),
     ]
 
 
@@ -543,6 +734,14 @@ def default_entries(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
                    lambda: make_select_k_jaxpr(b)),
         AuditEntry("fused_l2_nn@100kx4096", b,
                    lambda: make_fused_l2_nn_jaxpr(b)),
+        AuditEntry("brute_force.knn[fused]@1m", b,
+                   lambda: make_brute_force_fused_jaxpr(b)),
+        AuditEntry("ivf_flat.search[fused]@sift1m", b,
+                   lambda: make_ivf_flat_fused_jaxpr(b)),
+        AuditEntry("ivf_pq.search[fused-lut]@sift1m-crash", b,
+                   lambda: make_ivf_pq_fused_lut_jaxpr(b)),
+        AuditEntry("ivf_pq.search[fused-cache]@sift1m", b,
+                   lambda: make_ivf_pq_fused_cache_jaxpr(b)),
     ]
 
 

@@ -197,6 +197,64 @@ def solve_joint_tiles(
     return outer, inner
 
 
+def solve_vmem_tiles(
+    budget_bytes: int,
+    cell_bytes: int,
+    outer_bytes: int,
+    inner_bytes: int,
+    inner_max: int,
+    fixed_bytes: int = 0,
+    outer_cap: int = 256,
+    outer_multiple: int = 8,
+    inner_multiple: int = 128,
+) -> tuple:
+    """``solve_joint_tiles`` generalized from the HBM workspace to a fused
+    kernel's ~16 MiB VMEM arena: size an (outer_tile, inner_tile) grid so
+
+        fixed + outer·outer_bytes + inner·inner_bytes + outer·inner·cell_bytes
+
+    stays within ``budget_bytes``. The affine terms are what VMEM adds over
+    the HBM model: per-row blocks (query vectors, the running top-k carry)
+    scale with ONE axis while the distance tile scales with both, and the
+    whole set must be simultaneously resident on-chip for the kernel's
+    revisited output block to stay live across inner iterations.
+
+    Mirrors ``solve_joint_tiles``' preference order: the full inner extent
+    at the largest aligned outer tile first; shrink the inner tile only
+    when a minimal outer tile cannot hold the full extent; degrade to
+    ``(outer_multiple, inner_multiple)`` when even one aligned cell
+    exceeds the budget (the kernel still runs; past that point the budget
+    is a target, not a guarantee).
+
+    Returns ``(outer_tile, inner_tile)`` with ``outer_tile`` a multiple of
+    ``outer_multiple`` capped at ``outer_cap`` and ``inner_tile`` a
+    multiple of ``inner_multiple`` capped at ``inner_max`` (rounded up to
+    the multiple — lane alignment on TPU)."""
+    budget = max(int(budget_bytes) - int(fixed_bytes), 1)
+    cell = max(int(cell_bytes), 0)
+    outer_b = max(int(outer_bytes), 0)
+    inner_b = max(int(inner_bytes), 0)
+    inner_max = max(int(inner_max), 1)
+    inner_max += (-inner_max) % inner_multiple
+    # full inner extent: budget pays inner_max·inner_bytes once, then each
+    # outer row costs outer_bytes + inner_max·cell
+    per_outer = outer_b + inner_max * cell
+    outer = (budget - inner_max * inner_b) // max(per_outer, 1)
+    if outer >= outer_multiple:
+        outer = min(outer, outer_cap)
+        outer -= outer % outer_multiple
+        return outer, inner_max
+    # tile the inner axis at the minimal aligned outer tile
+    outer = outer_multiple
+    per_inner = inner_b + outer * cell
+    inner = (budget - outer * outer_b) // max(per_inner, 1)
+    inner = min(inner, inner_max)
+    inner -= inner % inner_multiple
+    if inner >= inner_multiple:
+        return outer, inner
+    return outer_multiple, inner_multiple
+
+
 _default_resources: Optional[Resources] = None
 _default_lock = threading.Lock()
 

@@ -38,6 +38,7 @@ from raft_tpu.ops.distance import (
     row_norms_sq,
     pairwise_core,
 )
+from raft_tpu.ops import pallas_kernels as pk
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
 from raft_tpu.utils.shape import (as_query_array, balanced_tile, cdiv, pad_rows,
@@ -261,11 +262,52 @@ def _knn_jit(queries, dataset, db_norms, filter_words, metric, metric_arg, k,
 knn_core = _knn_jit
 
 
+@functools.partial(
+    jax.jit, static_argnames=("k", "tm", "tn", "sqrt", "interpret"))
+def _knn_fused_jit(queries, dataset, db_norms, k: int, tm: int, tn: int,
+                   sqrt: bool, interpret: bool):
+    """Fused-Pallas brute-force core: the [nq, ndb] distance slab never
+    touches HBM — each [tm, tn] tile feeds the VMEM-resident top-k carry
+    (``ops.pallas_kernels.fused_l2_topk``). Selection happens in-kernel,
+    so no ``select_k`` call and no TOPK_PAD padding applies here."""
+    qn = row_norms_sq(queries)
+    dbn = row_norms_sq(dataset) if db_norms is None else db_norms
+    v, i = pk.fused_l2_topk(queries, dataset, k, x_norms=qn, y_norms=dbn,
+                            tm=tm, tn=tn, interpret=interpret)
+    if sqrt:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+#: public traceable-core name for the fused path (R004; audited by
+#: graftcheck --jaxpr-audit at the VMEM-budget canonical shape)
+knn_fused_core = _knn_fused_jit
+
+
+#: metrics the fused scan+select kernel serves exactly (the minimize-only
+#: VMEM carry is not rank-safe for IP/cosine without negation plumbing)
+_FUSED_SCAN_METRICS = (
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+)
+
+
+def _fused_eligible(index: Index, k: int, has_filter: bool,
+                    fast_scan: bool) -> bool:
+    """The fallback matrix for ``scan_mode="pallas"`` (docs/tuning.md):
+    L2 metrics, float data, small k, no bitset filter (the kernel has no
+    in-carry filter epilogue), not combined with the bf16 fast scan."""
+    return (index.metric in _FUSED_SCAN_METRICS
+            and not has_filter and not fast_scan and k <= 1024
+            and jnp.issubdtype(index.dataset.dtype, jnp.floating))
+
+
 @tracing.range("brute_force.search")
 def search(index: Index, queries, k: int, filter=None,
            res: Optional[Resources] = None, scan_dtype=None,
            refine_ratio: float = 4.0,
-           select_recall: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+           select_recall: float = 1.0,
+           scan_mode: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """Exact kNN search → (distances [nq, k], indices [nq, k]).
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
@@ -278,8 +320,20 @@ def search(index: Index, queries, k: int, filter=None,
     analog of the reference's TF32/CUTLASS Ampere path (detail/
     pairwise_matrix/dispatch_sm80.cuh). Returned distances are exact fp32;
     ranking is exact except for candidates the bf16 screen misses
-    (recall ≥ 0.999 at refine_ratio=4 in practice)."""
+    (recall ≥ 0.999 at refine_ratio=4 in practice).
+
+    ``scan_mode`` selects the scan/select engine: ``"xla"`` forces the
+    tiled XLA two-step, ``"pallas"`` requests the fused Pallas
+    scan+select kernel (VMEM-resident top-k carry, docs/tuning.md), and
+    ``"auto"`` picks pallas on TPU only where the committed probe artifact
+    shows it winning. Unsupported combinations (non-L2 metric, filter,
+    fast scan, k > 1024, CPU without the interpret hook) fall back to XLA
+    silently — the mode is a performance hint, never a correctness
+    switch."""
     res = ensure_resources(res)
+    if scan_mode not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"scan_mode={scan_mode!r}: expected 'auto', 'xla' or 'pallas'")
     # host inputs stay host-side: the jit call transfers the padded
     # batch in ONE dispatch
     queries = as_query_array(queries, dtype=index.dataset.dtype)
@@ -303,6 +357,14 @@ def search(index: Index, queries, k: int, filter=None,
     refine_mult = refine_multiplier(refine_ratio, fast_scan)
     nq = queries.shape[0]
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
+    use_fused, fused_interp = pk.fused_dispatch("brute_force", scan_mode)
+    if use_fused and _fused_eligible(index, k, filter is not None, fast_scan):
+        tm, tn = pk.plan_fused_topk_tiles(
+            queries.shape[0], index.size, index.dim, k)
+        v, i = _knn_fused_jit(
+            queries, index.dataset, index.norms, k, tm, tn,
+            index.metric == DistanceType.L2SqrtExpanded, fused_interp)
+        return v[:nq], i[:nq]
     q_tile, db_tile = _choose_tiles(
         queries.shape[0], index.size, index.dim, k, res.workspace_limit_bytes
     )
@@ -328,11 +390,12 @@ def search(index: Index, queries, k: int, filter=None,
 def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
         res: Optional[Resources] = None, scan_dtype=None,
         refine_ratio: float = 4.0,
-        select_recall: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+        select_recall: float = 1.0,
+        scan_mode: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """One-shot exact kNN (reference: brute_force::knn)."""
     return search(build(dataset, metric, metric_arg, res), queries, k,
                   res=res, scan_dtype=scan_dtype, refine_ratio=refine_ratio,
-                  select_recall=select_recall)
+                  select_recall=select_recall, scan_mode=scan_mode)
 
 
 _SERIAL_VERSION = 1

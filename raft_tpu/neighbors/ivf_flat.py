@@ -99,6 +99,12 @@ class SearchParams:
     # bf16 screen width as a multiple of k for the exact fp32 re-rank
     # (scan_dtype="bfloat16" only); wider = higher recall, more re-rank
     refine_ratio: float = 4.0
+    # "pallas" requests the fused Pallas scan+select (probed slabs DMA'd to
+    # VMEM, top-k carried in-kernel — docs/tuning.md); "auto" picks it on
+    # TPU where the committed probe artifact shows it winning; unsupported
+    # combinations (non-L2 metric, filter, bf16 fast scan, k > 1024) fall
+    # back to the XLA engine silently
+    scan_mode: str = "auto"
     # <1.0 routes internal top-k through the TPU PartialReduce engine
     # (ops.select_k APPROX) at this per-element recall target — measured
     # 10-40x faster than exact top_k at IVF shapes on v5e; the recall
@@ -537,6 +543,72 @@ _search_jit = jax.jit(
 search_core = _search_core
 
 
+def _search_fused_core(queries, centers, list_data, list_indices, list_sizes,
+                       row_norms, overflow_data, overflow_indices,
+                       metric: DistanceType, k: int, n_probes: int,
+                       pad_tile: int, has_overflow: bool,
+                       interpret: bool = False):
+    """Fused-Pallas search body (``scan_mode="pallas"``, L2 metrics only):
+    coarse selection stays XLA, then the probed slabs are DMA'd straight
+    to VMEM and merged into an in-kernel top-k carry
+    (``ops.pallas_kernels.fused_ivf_topk``) — the [nq, P, pad] candidate
+    slab never materializes in HBM and no ``select_k``/TOPK_PAD padding
+    applies to the fine scan. Overflow rows (spilled past the capped
+    list_pad) are scanned by the XLA brute pass in squared space and
+    merged with the kernel's survivors through one unpadded ``select_k``."""
+    from raft_tpu.ops import pallas_kernels as pk
+
+    nq, dim = queries.shape
+    list_pad = list_data.shape[1]
+    qf = queries.astype(jnp.float32)
+
+    # ---- coarse: top-n_probes clusters per query (XLA, tiny)
+    scores, coarse_min = _coarse_scores(queries, centers, metric)
+    _, probes = select_k(scores, n_probes, select_min=coarse_min)
+
+    # unfilled slots must carry the -1 null id the kernel masks on; the
+    # class invariant already puts -1 there, this re-derives it from
+    # list_sizes so a stale slot can never alias a real row
+    valid_slot = jnp.arange(list_pad)[None, :] < list_sizes[:, None]
+    safe_ids = jnp.where(valid_slot, list_indices, -1)
+
+    qv = jnp.broadcast_to(qf[:, None, :], (nq, n_probes, dim))
+    qn = jnp.broadcast_to(row_norms_sq(qf)[:, None], (nq, n_probes))
+    v, i = pk.fused_ivf_topk(probes, qv, qn, list_data, row_norms, safe_ids,
+                             k, pad_tile=pad_tile, clamp=True,
+                             interpret=interpret)
+
+    if has_overflow:
+        o_f32 = overflow_data.astype(jnp.float32)
+        od, oi, _ = _overflow_scan(
+            queries, qf, o_f32, row_norms_sq(o_f32),
+            overflow_indices >= 0, overflow_indices,
+            jnp.zeros((0,), jnp.uint32),
+            # squared space: the kernel's carry is squared-L2; one sqrt at
+            # the end covers both sources
+            DistanceType.L2Expanded, False, False, jnp.inf)
+        cand_v = jnp.concatenate([v, od], axis=1)
+        cand_i = jnp.concatenate([i, oi], axis=1)
+        # selection already happened in-kernel — the merge select runs with
+        # pad_rules=False so TOPK_PAD cannot double-pad it (ISSUE 10)
+        v, i = select_k(cand_v, k, select_min=True, indices=cand_i,
+                        pad_rules=False)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+_search_fused_jit = jax.jit(
+    _search_fused_core,
+    static_argnames=("metric", "k", "n_probes", "pad_tile", "has_overflow",
+                     "interpret"),
+)
+
+#: public traceable-core name for the fused path (R004; audited by
+#: graftcheck --jaxpr-audit at the VMEM-budget canonical shape)
+search_fused_core = _search_fused_core
+
+
 def scan_bytes_per_query(n_probes: int, list_pad: int, dim: int) -> int:
     """TRUE peak live-set bytes of the flat scan per query: the gathered
     probe tile [P, pad, dim] fp32, ×2 for the distance/score temporaries
@@ -590,22 +662,49 @@ def search(
     from raft_tpu.ops import pallas_kernels as pk
 
     fast_scan = params.scan_dtype is not None
-    # an explicit bf16 request wins over the env-gated Pallas fp32 scan —
-    # never silently benchmark fp32 under a bf16 label
-    use_pallas = pk.pallas_enabled() and not fast_scan
+    scan_mode = getattr(params, "scan_mode", "auto")
+    if scan_mode not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"scan_mode={scan_mode!r}: expected 'auto', 'xla' or 'pallas'")
     if fast_scan:
         if jnp.dtype(params.scan_dtype) != jnp.bfloat16:
             raise ValueError(
                 f"scan_dtype={params.scan_dtype!r}: only bfloat16 is supported")
         if index.list_data.dtype != jnp.float32:
             raise ValueError("scan_dtype requires fp32 list data")
+    has_overflow = index.overflow_data.shape[0] > 0
+    # ---- fused Pallas scan+select (the VMEM top-k carry). Fallback
+    # matrix (docs/tuning.md): L2 metrics, no filter (no in-carry filter
+    # epilogue), no bf16 fast scan, small k.
+    use_fused, fused_interp = pk.fused_dispatch("ivf_flat", scan_mode)
+    use_fused = (use_fused and not fast_scan and filter is None
+                 and k <= 1024 and index.metric in (
+                     DistanceType.L2Expanded, DistanceType.L2SqrtExpanded))
+    if use_fused:
+        pad_tile = pk.plan_fused_ivf_tile(
+            list_pad, index.dim, int(k),
+            jnp.dtype(index.list_data.dtype).itemsize)
+        v, i = _search_fused_jit(
+            queries, index.centers, index.list_data, index.list_indices,
+            index.list_sizes, index.ensure_row_norms(),
+            index.overflow_data, index.overflow_indices,
+            index.metric, int(k), n_probes, pad_tile, has_overflow,
+            fused_interp,
+        )
+        return v[:nq], i[:nq]
+    # The unfused ivf_scan kernel only routes where a committed probe
+    # artifact shows it beating XLA — PALLAS_PROBE_tpu.json currently says
+    # it does not (22.3 ms vs 10.9 ms), so this stays off without a
+    # measured verdict; the RAFT_TPU_PALLAS=1 env override is retired.
+    # An explicit bf16 request still wins over any fp32 Pallas scan —
+    # never silently benchmark fp32 under a bf16 label.
+    use_pallas = pk.fused_crossover("ivf_scan") and not fast_scan
     # Cached exact norms are required by the Pallas path and the bf16 fast
     # scan; the plain XLA path keeps computing norms per probed tile instead
     # (materializing [L, pad] fp32 norms for a large narrow-dtype index is a
     # needless device-memory spike there).
     need_norms = use_pallas or (
         fast_scan and index.metric != DistanceType.InnerProduct)
-    has_overflow = index.overflow_data.shape[0] > 0
     v, i = _search_jit(
         queries, index.centers, index.list_data, index.list_indices,
         index.list_sizes,

@@ -51,7 +51,7 @@ from raft_tpu.core.resources import (Resources, ensure_resources,
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.ops.distance import DistanceType, resolve_metric
-from raft_tpu.ops.select_k import select_k_maybe_approx
+from raft_tpu.ops.select_k import select_k, select_k_maybe_approx
 from raft_tpu.neighbors import list_packing
 from raft_tpu.ops import rng as rrng
 from raft_tpu.utils.shape import (as_query_array, balanced_tile, cdiv, pad_rows,
@@ -121,6 +121,14 @@ class SearchParams:
     #     index and invalidated by extend().
     #   "lut": force the reference-shaped LUT gather path (lower memory —
     #     only the packed codes are resident).
+    #   "pallas": fused Pallas scan+select — probed slabs (or packed codes
+    #     + in-kernel LUT) are DMA'd to VMEM and the top-k is carried
+    #     in-kernel, so no candidate slab touches HBM (docs/tuning.md).
+    #     L2 metrics, no filter, k <= 1024; the LUT regime additionally
+    #     needs pq_bits=8, PER_SUBSPACE, fp32 LUT dtypes. Unsupported
+    #     combinations (and CPU without the interpret hook) fall back to
+    #     the XLA engines silently; "auto" picks pallas on TPU only where
+    #     the committed probe artifact shows it winning.
     scan_mode: str = "auto"
     # dtype of the decoded scan cache: bf16 (default; halves scan HBM
     # traffic, ~1e-3 recall cost — the reference's fp16/fp8-LUT trade) or
@@ -1144,6 +1152,130 @@ _search_jit = jax.jit(
 search_lut_core = _search_lut_core
 
 
+def _coarse_probes_rot(queries, centers, rotation, n_probes: int):
+    """Shared coarse step of the fused cores: rotate the queries and pick
+    the top-n_probes clusters in rotated space — the same math (and the
+    same tie behavior) as the XLA engines' q_body preamble."""
+    q_rot = jax.lax.dot_general(
+        queries.astype(jnp.float32), rotation, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    centers_rot = jax.lax.dot_general(
+        centers, rotation, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    dots_c = jax.lax.dot_general(
+        q_rot, centers_rot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    cn = jnp.sum(centers_rot * centers_rot, -1)
+    _, probes = select_k(cn[None, :] - 2.0 * dots_c, n_probes,
+                         select_min=True)
+    return q_rot, centers_rot, probes
+
+
+def _fused_merge_overflow(v, i, q_rot, overflow_decoded, overflow_norms,
+                          overflow_indices, k: int):
+    """Merge the kernel's VMEM-carry survivors with the XLA overflow scan
+    (squared space on both sides). Selection already happened in-kernel,
+    so the merge select runs with ``pad_rules=False`` — TOPK_PAD models an
+    HBM slab select and must not re-pad the short candidate list
+    (ISSUE 10)."""
+    od, oi = _pq_overflow_scan(q_rot, overflow_decoded, overflow_norms,
+                               overflow_indices,
+                               jnp.zeros((0,), jnp.uint32),
+                               DistanceType.L2Expanded, False, jnp.inf)
+    return select_k(jnp.concatenate([v, od], axis=1), k, select_min=True,
+                    indices=jnp.concatenate([i, oi], axis=1),
+                    pad_rules=False)
+
+
+def _search_fused_cache_core(queries, centers, rotation, list_decoded,
+                             decoded_norms, list_indices, list_sizes,
+                             overflow_decoded, overflow_norms,
+                             overflow_indices, metric: DistanceType, k: int,
+                             n_probes: int, pad_tile: int,
+                             has_overflow: bool, interpret: bool = False):
+    """Fused-Pallas ADC scan over the decoded-residual cache
+    (``scan_mode="pallas"``, L2 metrics): coarse selection stays XLA, then
+    ``ops.pallas_kernels.fused_ivf_topk`` DMAs each probed cache slab to
+    VMEM and merges ``||q_res||² − 2·q_res·dec + ||dec||²`` partials into
+    an in-kernel top-k carry — the [nq, P, pad] candidate slab never
+    exists in HBM and no TOPK_PAD padding applies to the fine scan.
+    Unclamped, exactly like the XLA cache engine (ADC space)."""
+    from raft_tpu.ops import pallas_kernels as pk
+
+    list_pad = list_decoded.shape[1]
+    q_rot, centers_rot, probes = _coarse_probes_rot(
+        queries, centers, rotation, n_probes)
+    valid_slot = jnp.arange(list_pad)[None, :] < list_sizes[:, None]
+    safe_ids = jnp.where(valid_slot, list_indices, -1)
+    qr_res = q_rot[:, None, :] - centers_rot[probes]  # [nq, P, rot]
+    qn = jnp.sum(qr_res * qr_res, -1)  # [nq, P]
+    v, i = pk.fused_ivf_topk(probes, qr_res, qn, list_decoded,
+                             decoded_norms, safe_ids, k, pad_tile=pad_tile,
+                             clamp=False, interpret=interpret)
+    if has_overflow:
+        v, i = _fused_merge_overflow(v, i, q_rot, overflow_decoded,
+                                     overflow_norms, overflow_indices, k)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+_search_fused_cache_jit = jax.jit(
+    _search_fused_cache_core,
+    static_argnames=("metric", "k", "n_probes", "pad_tile", "has_overflow",
+                     "interpret"),
+)
+
+
+def _search_fused_lut_core(queries, centers, rotation, codebooks,
+                           list_codes, list_indices, list_sizes,
+                           overflow_decoded, overflow_norms,
+                           overflow_indices, metric: DistanceType, k: int,
+                           n_probes: int, pad_tile: int, has_overflow: bool,
+                           interpret: bool = False):
+    """Fused-Pallas LUT engine (``scan_mode="pallas"`` at the LUT memory
+    regime; pq_bits=8, PER_SUBSPACE, fp32 LUT only): the per-probe LUT is
+    built from the resident codebooks INSIDE the kernel and consumed by
+    the one-hot code accumulation feeding the same VMEM top-k carry —
+    neither the [nq, P, s, book] LUT nor the [nq, P, pad] candidate slab
+    ever materializes in HBM (``ops.pallas_kernels.fused_pq_topk``)."""
+    from raft_tpu.ops import pallas_kernels as pk
+
+    list_pad = list_codes.shape[1]
+    q_rot, centers_rot, probes = _coarse_probes_rot(
+        queries, centers, rotation, n_probes)
+    valid_slot = jnp.arange(list_pad)[None, :] < list_sizes[:, None]
+    safe_ids = jnp.where(valid_slot, list_indices, -1)
+    cb_norms = jnp.sum(codebooks.astype(jnp.float32) ** 2, -1)
+    v, i = pk.fused_pq_topk(probes, q_rot, centers_rot, codebooks,
+                            cb_norms, list_codes, safe_ids, k,
+                            pad_tile=pad_tile, interpret=interpret)
+    if has_overflow:
+        v, i = _fused_merge_overflow(v, i, q_rot, overflow_decoded,
+                                     overflow_norms, overflow_indices, k)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+_search_fused_lut_jit = jax.jit(
+    _search_fused_lut_core,
+    static_argnames=("metric", "k", "n_probes", "pad_tile", "has_overflow",
+                     "interpret"),
+)
+
+#: public traceable-core names for the fused paths (R004; audited by
+#: graftcheck --jaxpr-audit at the VMEM-budget canonical shapes)
+search_fused_cache_core = _search_fused_cache_core
+search_fused_lut_core = _search_fused_lut_core
+
+
 def lut_bytes_per_query_probe(list_pad: int, pq_dim: int, pq_bits: int,
                               lut_itemsize: int = 4,
                               dist_itemsize: int = 4) -> int:
@@ -1284,33 +1416,86 @@ def search(
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
     n_probes = int(min(params.n_probes, index.n_lists))
     list_pad = index.list_codes.shape[1]
-    if params.scan_mode not in ("auto", "cache", "lut"):
+    if params.scan_mode not in ("auto", "cache", "lut", "pallas"):
         raise ValueError(f"unknown scan_mode: {params.scan_mode}")
     scan_mode = params.scan_mode
-    if scan_mode == "auto":
+    has_overflow = index.overflow_codes.shape[0] > 0
+    if has_overflow:
+        ensure_overflow_decoded(index, params.scan_cache_dtype)
+    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
+    from raft_tpu.ops import pallas_kernels as pk
+
+    # ---- fused Pallas scan+select (the VMEM top-k carry). Fallback
+    # matrix (docs/tuning.md): L2 metrics, no filter, small k; the fused
+    # LUT regime additionally needs byte codes (pq_bits=8), PER_SUBSPACE
+    # codebooks and fp32 LUT/distance dtypes. Anything else falls through
+    # to the XLA engines below — the mode is a performance hint, never a
+    # correctness switch.
+    use_fused = fused_interp = False
+    if scan_mode in ("auto", "pallas"):
+        use_fused, fused_interp = pk.fused_dispatch("ivf_pq", scan_mode)
+    use_fused = (use_fused and filter is None and k <= 1024
+                 and index.metric in (DistanceType.L2Expanded,
+                                      DistanceType.L2SqrtExpanded))
+    if use_fused:
+        # the same HBM model that splits cache/lut splits the fused
+        # engines: the decoded cache is the faster scan when it fits
+        engine = resolve_scan_mode(
+            index.n_lists, list_pad, index.rot_dim,
+            index.list_codes.shape[2],
+            jnp.dtype(params.scan_cache_dtype).itemsize,
+            device_memory_bytes=res.device_memory_bytes,
+            workspace_limit_bytes=res.workspace_limit_bytes)
+        if engine == "cache":
+            ensure_scan_cache(index, params.scan_cache_dtype)
+            pad_tile = pk.plan_fused_ivf_tile(
+                list_pad, index.rot_dim, int(k),
+                jnp.dtype(index.list_decoded.dtype).itemsize)
+            v, i = _search_fused_cache_jit(
+                queries, index.centers, index.rotation, index.list_decoded,
+                index.decoded_norms, index.list_indices, index.list_sizes,
+                index.overflow_decoded, index.overflow_norms,
+                index.overflow_indices, index.metric, int(k), n_probes,
+                pad_tile, has_overflow, fused_interp,
+            )
+            return v[:nq], i[:nq]
+        if (not per_cluster and index.pq_bits == 8
+                and jnp.dtype(params.lut_dtype) == jnp.float32
+                and jnp.dtype(params.internal_distance_dtype)
+                == jnp.float32):
+            pad_tile = pk.plan_fused_pq_tile(
+                list_pad, index.pq_dim, 1 << index.pq_bits,
+                index.codebooks.shape[2], int(k))
+            v, i = _search_fused_lut_jit(
+                queries, index.centers, index.rotation, index.codebooks,
+                index.list_codes, index.list_indices, index.list_sizes,
+                index.overflow_decoded, index.overflow_norms,
+                index.overflow_indices, index.metric, int(k), n_probes,
+                pad_tile, has_overflow, fused_interp,
+            )
+            return v[:nq], i[:nq]
+        # fused LUT regime unsupported at these params -> XLA engines
+    if scan_mode in ("auto", "pallas"):
         scan_mode = resolve_scan_mode(
             index.n_lists, list_pad, index.rot_dim,
             index.list_codes.shape[2],
             jnp.dtype(params.scan_cache_dtype).itemsize,
             device_memory_bytes=res.device_memory_bytes,
             workspace_limit_bytes=res.workspace_limit_bytes)
-    has_overflow = index.overflow_codes.shape[0] > 0
-    if has_overflow:
-        ensure_overflow_decoded(index, params.scan_cache_dtype)
     if scan_mode == "cache":  # resolve_scan_mode never returns "auto"
         ensure_scan_cache(index, params.scan_cache_dtype)
         # workspace: gathered decoded cache [t,P,pad,rot] bf16 + dists
         q_tile = plan_cache_tiles(n_probes, list_pad, index.rot_dim,
                                   res.workspace_limit_bytes)
-        from raft_tpu.ops import pallas_kernels as pk
-
         v, i = _search_cache_jit(
             queries, index.centers, index.rotation, index.list_decoded,
             index.decoded_norms, index.list_indices, index.list_sizes,
             filter.words if filter is not None else jnp.zeros((0,),
                                                               jnp.uint32),
             index.metric, int(k), n_probes, q_tile, filter is not None,
-            pk.pallas_enabled(), False,
+            # unfused ivf_scan routes only on a measured probe verdict
+            # (PALLAS_PROBE "fused" table); the env flag is retired
+            pk.fused_crossover("ivf_scan"), False,
             index.overflow_decoded, index.overflow_norms,
             index.overflow_indices, has_overflow,
             select_recall=float(params.select_recall),
@@ -1325,7 +1510,6 @@ def search(
         res.workspace_limit_bytes,
         jnp.dtype(params.lut_dtype).itemsize,
         jnp.dtype(params.internal_distance_dtype).itemsize)
-    per_cluster = index.params.codebook_kind == CodebookGen.PER_CLUSTER
     v, i = _search_jit(
         queries, index.centers, index.rotation, index.codebooks,
         index.list_codes, index.list_indices, index.list_sizes,

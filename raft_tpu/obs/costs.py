@@ -20,7 +20,8 @@ own accounting —
   batch size, one that under-predicts re-creates the LUT crash.
 
 Everything here is AOT: no index is built, no input allocated; compiling
-the seven audit cores plus cagra takes seconds on CPU. Consumed by
+the canonical audit cores (including the fused Pallas variants in
+interpret mode) plus cagra takes seconds on CPU. Consumed by
 ``tools/perf_report.py`` (JSON artifact + registry gauges) and
 ``tools/graftcheck.py --costs`` (C001 findings vs the baseline).
 """

@@ -93,7 +93,10 @@ def fused_l2_nn_argmin(
     y = jnp.asarray(y)
     from raft_tpu.ops import pallas_kernels
 
-    if pallas_kernels.pallas_enabled():
+    # measured crossover, not an env flag: the probe artifact must show the
+    # standalone Pallas kernel actually beating XLA on this platform
+    # (PALLAS_PROBE_tpu.json currently says it does not — 22.3 ms vs 10.9)
+    if pallas_kernels.fused_crossover("l2_argmin"):
         val, idx = pallas_kernels.fused_l2_argmin(
             x, y, x_norms=x_norms, y_norms=y_norms)
         if sqrt:

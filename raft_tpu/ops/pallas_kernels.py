@@ -11,11 +11,22 @@ HBM, the exact property the CUDA kernel gets from its fused epilogue. The
 grid walks (x_tiles × y_tiles) with the y axis innermost so each x tile's
 output block stays resident while y streams through.
 
-Selection: ``fused_l2_argmin`` dispatches to the Pallas kernel on TPU when
-``RAFT_TPU_PALLAS=1`` (opt-in until profiled on hardware) or in interpret
-mode for tests; otherwise the XLA path in ops.fused_l2_nn serves (XLA
-already fuses the epilogue well — the kernel exists to control tiling and
-VMEM residency explicitly at large n_clusters)."""
+Selection: the fused scan+select kernels (``fused_l2_topk``,
+``fused_ivf_topk``, ``fused_pq_topk``) carry a query tile's running top-k
+(values + global row ids) in VMEM across database/probe tiles — the
+candidate-distance slab never round-trips through HBM before ``select_k``
+reads it back, the exact traffic CUDA RAFT eliminates by fusing distance +
+selection in registers/SMEM. Tile sizes come from a VMEM-budget planner
+(``core.resources.solve_vmem_tiles``, the ~16 MiB on-chip analog of
+``solve_joint_tiles``); dispatch is MEASURED, not env-gated: ``search``
+entry points route here only when the committed ``PALLAS_PROBE`` artifact
+records the fused kernel winning for that family on this platform
+(``fused_crossover``) or when the caller forces ``scan_mode="pallas"``.
+The standalone (unfused) ``fused_l2_argmin``/``ivf_scan`` kernels lost to
+XLA on hardware (PALLAS_PROBE_tpu.json: 22.3 ms vs 10.9 ms at 8192
+clusters) — they stay for the same crossover-gated dispatch and as the
+building blocks the fused kernels grew from, but nothing routes to them
+unconditionally anymore."""
 
 from __future__ import annotations
 
@@ -98,12 +109,95 @@ def _fused_l2_argmin_pallas(x, y, x_norms, y_norms, tm: int, tn: int,
     return val[:m, 0], idx[:m, 0]
 
 
-def pallas_enabled() -> bool:
-    """Opt-in gate for the Pallas paths (RAFT_TPU_PALLAS=1 on TPU)."""
+# ------------------------------------------------- measured crossover gate
+#
+# The unconditional RAFT_TPU_PALLAS=1 env flag is retired: routing to a
+# Pallas kernel is now a MEASURED decision recorded by tools/pallas_probe.py
+# into PALLAS_PROBE_<platform>.json ("fused" section, per-family
+# ``fused_wins`` verdicts). The artifact self-arms exactly like the
+# SELECT_K_TABLE / TOPK_PAD tables (repo root + cwd scan, env override
+# loaded last and loudly) so a hardware window's probe run flips the
+# dispatch for subsequent runs with no env plumbing.
+
+_fused_table_cache = None
+
+
+def _extract_fused_table(art: dict) -> dict:
+    fused = art.get("fused", {})
+    return {fam: bool(row.get("fused_wins"))
+            for fam, row in fused.items() if isinstance(row, dict)}
+
+
+def _load_fused_table() -> dict:
+    global _fused_table_cache
+    if _fused_table_cache is None:
+        from raft_tpu.ops.select_k import _scan_artifacts
+
+        _fused_table_cache = _scan_artifacts(
+            {}, "PALLAS_PROBE", "RAFT_TPU_PALLAS_PROBE",
+            _extract_fused_table)
+    return _fused_table_cache
+
+
+def fused_platform_key() -> str:
+    """The platform key fused-crossover verdicts are recorded under —
+    select_k's artifact key (device kind on TPU, backend name elsewhere),
+    public so probes/tests can target ``set_fused_crossover`` at the
+    running host without reaching into select_k internals."""
+    from raft_tpu.ops.select_k import _platform_key
+
+    return _platform_key()
+
+
+def set_fused_crossover(platform: str, families) -> None:
+    """Install (or with None, drop) measured fused-kernel verdicts for a
+    platform: ``{"brute_force": True, "ivf_flat": False, ...}`` (the test
+    hook mirroring select_k.set_auto_table)."""
+    global _fused_table_cache
+    tables = _load_fused_table()
+    if families is None:
+        tables.pop(platform, None)
+    else:
+        tables[platform] = {k: bool(v) for k, v in families.items()}
+    _fused_table_cache = tables
+
+
+def fused_crossover(family: str) -> bool:
+    """True when the measured PALLAS_PROBE artifact for this platform
+    records the fused kernel beating XLA for ``family`` ("brute_force",
+    "ivf_flat", "ivf_pq", "l2_argmin"). Conservative default: with no
+    measurement (or a pre-fused-schema artifact) every family reads
+    False, so ``scan_mode="auto"`` stays on XLA until hardware evidence
+    lands."""
+    from raft_tpu.ops.select_k import _platform_key
+
+    return bool(_load_fused_table().get(_platform_key(), {}).get(
+        family, False))
+
+
+def fused_dispatch(family: str, scan_mode: str):
+    """Resolve ``(use_fused, interpret)`` for a family's search dispatch.
+
+    ``scan_mode="pallas"``: fused on TPU (hardware Mosaic kernels), or on
+    any backend when ``RAFT_TPU_PALLAS_INTERPRET=1`` opts into the Mosaic
+    interpreter (the parity-test hook); on CPU without that opt-in the
+    request silently falls back to the XLA engines — ``scan_mode="pallas"``
+    must never error on a TPU-free host (serving configs are shared
+    between CPU canaries and TPU fleets).
+
+    ``scan_mode="auto"``: fused only on TPU at shapes/families where the
+    committed PALLAS_PROBE crossover records a win (``fused_crossover``).
+
+    Anything else: never fused."""
+    interp = os.environ.get("RAFT_TPU_PALLAS_INTERPRET") == "1"
     # the axon tunnel registers its backend name as "axon" while the
     # devices report platform "tpu"; accept both (cf. select_k._platform_key)
-    return (os.environ.get("RAFT_TPU_PALLAS") == "1"
-            and jax.default_backend() in ("tpu", "axon"))
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if scan_mode == "pallas":
+        return (on_tpu or interp), (interp and not on_tpu)
+    if scan_mode == "auto":
+        return (on_tpu and fused_crossover(family)), False
+    return False, False
 
 
 def fused_l2_argmin(x, y, x_norms=None, y_norms=None, tm: int = 256,
@@ -316,3 +410,563 @@ def pallas_select_k(values, k: int, select_min: bool = True,
     out_v = out_v if select_min else -out_v
     # match DIRECT/TWO_PHASE: values come back in the input dtype
     return out_v.astype(values.dtype), out_i
+
+
+# ---------------------------------------------------- fused scan + select
+#
+# The tentpole kernels: distance tile production and top-k selection fused
+# into one Pallas program whose output block (the running [tile, kp] top-k
+# carry) is REVISITED across the inner grid axis — the out_specs index map
+# ignores the streaming axis, so Mosaic keeps the carry resident in VMEM
+# while database/probe tiles flow through, and only the final k survivors
+# are ever written to HBM. This is the TPU expression of the reference's
+# fusedL2NN/select_k register pipeline (fused_l2_nn-inl.cuh:76 +
+# matrix/detail/select_warpsort.cuh): no [queries, candidates] slab exists
+# off-chip at any point.
+
+#: per-core VMEM arena (v4/v5e/v6e: 16 MiB) and the default planning
+#: budget — headroom left for Mosaic's own double-buffering and scratch
+VMEM_LIMIT_BYTES = 16 << 20
+DEFAULT_VMEM_BUDGET = 12 << 20
+
+
+def _kp(k: int) -> int:
+    """Lane-padded carry width (the _extract_topk column convention)."""
+    return max(round_up_to(k, 128), 128)
+
+
+def fused_topk_tile_bytes(tm: int, tn: int, dim: int, k: int) -> int:
+    """TRUE VMEM live set of one fused brute-force grid step: the x/y
+    blocks and norm rows, the [tm, tn] distance tile ×3 (dots, d, the
+    extraction working copy), and the running-merge set (carry val/idx
+    blocks, the [tm, 2·kp] concat pair, the extraction accumulators).
+    The itemized accounting ``plan_fused_topk_tiles`` solves against —
+    public so the obs.costs calibration audit can compare the planner's
+    prediction to compiled ground truth."""
+    kp = _kp(k)
+    return (tm * (dim * 4 + 4 + 32 * kp)
+            + tn * (dim * 4 + 4)
+            + tm * tn * 12)
+
+
+def plan_fused_topk_tiles(m: int, n: int, dim: int, k: int,
+                          vmem_budget: int = None):
+    """(tm, tn) for ``fused_l2_topk`` from the VMEM budget via
+    ``core.resources.solve_vmem_tiles`` — the ~16 MiB on-chip analog of
+    the HBM ``solve_joint_tiles`` every other planner uses. Prefers
+    streaming the full database extent per query tile; shrinks the db
+    tile when the query-row terms (x block + top-k carry) crowd it out."""
+    from raft_tpu.core.resources import solve_vmem_tiles
+
+    budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
+    kp = _kp(k)
+    tm, tn = solve_vmem_tiles(
+        budget,
+        cell_bytes=12,
+        outer_bytes=dim * 4 + 4 + 32 * kp,
+        inner_bytes=dim * 4 + 4,
+        inner_max=round_up_to(max(n, 1), 128),
+        outer_cap=256,
+    )
+    tm = min(tm, round_up_to(max(m, 1), 8))
+    tm = max(8, tm - tm % 8)
+    tn = min(tn, round_up_to(max(n, 1), 128))
+    tn = max(128, tn - tn % 128)
+    return tm, tn
+
+
+def fused_topk_workspace_bytes(m: int, n: int, dim: int, k: int,
+                               tm: int = None, tn: int = None,
+                               vmem_budget: int = None) -> int:
+    """HBM-side workspace of one fused brute-force dispatch: the padded
+    query/db copies and norm rows staged for the kernel, the [mp, kp]
+    val/idx outputs (temps of the enclosing jit — the caller slices
+    [:m, :k]), plus one grid step's block set (the interpreter's block
+    buffers on CPU; the VMEM live set on TPU). The db slab is counted
+    TWICE: the pipeline stages it once for the pad and once as the
+    kernel operand held across the grid loop (measured on the CPU
+    interpreter; on TPU the kernel DMAs the staged copy in place, so
+    this over-predicts by ~2× — the safe direction for a crash audit).
+    Public for the graftcheck ``--costs`` C001 calibration audit."""
+    if tm is None or tn is None:
+        tm, tn = plan_fused_topk_tiles(m, n, dim, k, vmem_budget)
+    mp = round_up_to(max(m, 1), tm)
+    np_ = round_up_to(max(n, 1), tn)
+    kp = _kp(k)
+    return (mp * dim * 4 + 2 * np_ * dim * 4 + np_ * 8 + mp * 4
+            + mp * kp * 8 + fused_topk_tile_bytes(tm, tn, dim, k))
+
+
+def _fused_topk_kernel(x_ref, y_ref, xn_ref, yn_ref, val_ref, idx_ref, *,
+                       k: int, kp: int, tn: int):
+    """One (query-tile, db-tile) step: expanded-L2 tile on the MXU, per-tile
+    top-k extraction, merge into the resident carry. Global row ids are
+    reconstructed from the db-tile offset (j·tn); padded db rows carry
+    +inf norms so their distances hit the extraction sentinel and emit the
+    -1 null id."""
+    j = pl.program_id(1)
+    dots = jax.lax.dot_general(
+        x_ref[:], y_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [TM, TN]
+    d = xn_ref[:] + yn_ref[:] - 2.0 * dots
+    # match ops.distance.l2_expanded's clamp (exact-parity requirement);
+    # +inf pad norms survive the maximum untouched
+    d = jnp.maximum(d, 0.0)
+    tv, ti = _extract_topk(d, None, k, kp)  # ascending, [TM, kp]
+    ti = jnp.where(ti >= 0, ti + j * tn, -1)
+
+    @pl.when(j == 0)
+    def _():
+        val_ref[...] = tv
+        idx_ref[...] = ti
+
+    @pl.when(j > 0)
+    def _():
+        cv = jnp.concatenate([val_ref[...], tv], axis=1)  # [TM, 2·kp]
+        ci = jnp.concatenate([idx_ref[...], ti], axis=1)
+        mv, mi = _extract_topk(cv, ci, k, kp)
+        val_ref[...] = mv
+        idx_ref[...] = mi
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tm", "tn", "interpret"))
+def _fused_topk_pallas(x, y, x_norms, y_norms, k: int, tm: int, tn: int,
+                       interpret: bool):
+    m, d = x.shape
+    n, _ = y.shape
+    mp = round_up_to(m, tm)
+    np_ = round_up_to(n, tn)
+    kp = _kp(k)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, mp - m), (0, 0)))
+    yp = jnp.pad(y.astype(jnp.float32), ((0, np_ - n), (0, 0)))
+    xn = jnp.pad(x_norms.astype(jnp.float32), (0, mp - m)).reshape(mp, 1)
+    # padded y rows must never reach the carry
+    yn = jnp.where(jnp.arange(np_) < n,
+                   jnp.pad(y_norms.astype(jnp.float32), (0, np_ - n)),
+                   jnp.inf).reshape(1, np_)
+    grid = (mp // tm, np_ // tn)
+    val, idx = pl.pallas_call(
+        functools.partial(_fused_topk_kernel, k=k, kp=kp, tn=tn),
+        out_shape=(jax.ShapeDtypeStruct((mp, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((mp, kp), jnp.int32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tm, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tn, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tn), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            # index map ignores j: the carry block stays VMEM-resident
+            # while db tiles stream through
+            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tm, kp), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(xp, yp, xn, yn)
+    return val[:m, :k], idx[:m, :k]
+
+
+def fused_l2_topk(x, y, k: int, x_norms=None, y_norms=None,
+                  tm: int = None, tn: int = None,
+                  vmem_budget: int = None, interpret: bool = False):
+    """Fused squared-L2 scan + top-k: ``(distances [m, k], ids [m, k])``
+    ascending, distances clamped at 0 (the l2_expanded convention), ids
+    -1 where fewer than k rows exist. The [m, n] distance matrix never
+    materializes — each [tm, tn] tile is consumed on-chip by the running
+    VMEM top-k merge. Tile sizes default to the VMEM-budget solve
+    (``plan_fused_topk_tiles``); ``interpret=True`` runs the Mosaic
+    interpreter (CPU CI)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if k > 1024:
+        raise ValueError(
+            f"fused_l2_topk is a small-k kernel (k={k} > 1024); "
+            "use the XLA engines")
+    m, _ = x.shape
+    n = y.shape[0]
+    if x_norms is None:
+        x_norms = jnp.sum(x.astype(jnp.float32) ** 2, -1)
+    if y_norms is None:
+        y_norms = jnp.sum(y.astype(jnp.float32) ** 2, -1)
+    ptm, ptn = plan_fused_topk_tiles(m, n, x.shape[1], k, vmem_budget)
+    tm = ptm if tm is None else int(tm)
+    tn = ptn if tn is None else int(tn)
+    tm = max(8, min(tm, round_up_to(m, 8)))
+    tm -= tm % 8
+    tn = max(128, min(tn, round_up_to(n, 128)))
+    tn -= tn % 128
+    return _fused_topk_pallas(x, y, x_norms, y_norms, int(k), tm, tn,
+                              bool(interpret))
+
+
+# ------------------------------------------------------- fused ivf top-k
+
+
+def fused_ivf_vmem_bytes(pad_tile: int, rot: int, k: int,
+                         itemsize: int = 4) -> int:
+    """TRUE VMEM live set of one fused IVF grid step: the probed slab's
+    [pad_tile, rot] block (+ its fp32 upcast when the cache is bf16), the
+    norm/id/distance/mask rows, the residual vector, and the running-merge
+    set. Public for the C001 calibration audit."""
+    kp = _kp(k)
+    return (pad_tile * rot * (itemsize + 4)
+            + pad_tile * 16
+            + rot * 4 + 32 * kp)
+
+
+def plan_fused_ivf_tile(list_pad: int, rot: int, k: int,
+                        itemsize: int = 4, vmem_budget: int = None) -> int:
+    """The list-slab row tile for ``fused_ivf_topk``: the largest divisor
+    of ``list_pad`` whose grid-step live set fits the VMEM budget (the
+    slab cannot be re-padded — that would copy the whole index — so the
+    tile must divide the layout exactly; 8-multiples preferred for
+    sublane alignment). Returns ``list_pad`` itself whenever the whole
+    slab fits (one DMA per probe, no inner axis)."""
+    budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
+    best = 1
+    best_aligned = 0
+    for pt in range(1, list_pad + 1):
+        if list_pad % pt:
+            continue
+        if fused_ivf_vmem_bytes(pt, rot, k, itemsize) <= budget:
+            best = pt
+            if pt % 8 == 0:
+                best_aligned = pt
+    return best_aligned or best
+
+
+def fused_ivf_workspace_bytes(nq: int, n_probes: int, rot: int,
+                              n_lists: int, list_pad: int, k: int,
+                              itemsize: int = 4,
+                              pad_tile: int = None) -> int:
+    """HBM-side workspace of one fused IVF dispatch: the probed slab
+    counted twice (staged + held as the kernel operand across the grid
+    loop, measured on the CPU interpreter; on TPU the slab is DMA'd in
+    place so this over-predicts ~2× — the safe direction), the
+    [nq, n_probes, rot] residual broadcast and its norms, the masked id
+    copy, the [nq, kp] val/idx outputs, and one grid step's block set.
+    Public for the graftcheck ``--costs`` C001 calibration audit."""
+    if pad_tile is None:
+        pad_tile = plan_fused_ivf_tile(list_pad, rot, k, itemsize)
+    kp = _kp(k)
+    return (2 * n_lists * list_pad * rot * itemsize
+            + nq * n_probes * (rot * 4 + 4)
+            + n_lists * list_pad * 4
+            + nq * kp * 8
+            + fused_ivf_vmem_bytes(pad_tile, rot, k, itemsize))
+
+
+def _fused_ivf_topk_kernel(probes_ref, qres_ref, qn_ref, dec_ref, norms_ref,
+                           ids_ref, val_ref, idx_ref, *, k: int, kp: int,
+                           clamp: bool):
+    """One (query, probe, slab-tile) step: partial distances of the probed
+    slab rows against this query's residual, merged into the resident
+    top-k carry. Source row ids come straight from the DMA'd
+    ``list_indices`` block (-1 at unfilled slots → masked to the +inf
+    sentinel, so padding can never reach the carry); distances are
+    comparable ACROSS probes because the per-(query, probe) ``||q_res||²``
+    base is added in-kernel."""
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    dots = jax.lax.dot_general(
+        dec_ref[0].astype(jnp.float32),  # bf16 cache; f32 math in VMEM
+        qres_ref[0, 0].reshape(-1, 1).astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [pt, 1]
+    d = qn_ref[0, 0] + norms_ref[0] - 2.0 * dots[:, 0]  # [pt]
+    if clamp:
+        d = jnp.maximum(d, 0.0)  # ivf_flat's exact-L2 clamp
+    ids = ids_ref[0]  # [pt] int32
+    d = jnp.where(ids < 0, jnp.inf, d)
+    tv, ti = _extract_topk(d[None, :], ids[None, :], k, kp)  # [1, kp]
+
+    @pl.when((j == 0) & (r == 0))
+    def _():
+        val_ref[...] = tv
+        idx_ref[...] = ti
+
+    @pl.when((j > 0) | (r > 0))
+    def _():
+        cv = jnp.concatenate([val_ref[...], tv], axis=1)
+        ci = jnp.concatenate([idx_ref[...], ti], axis=1)
+        mv, mi = _extract_topk(cv, ci, k, kp)
+        val_ref[...] = mv
+        idx_ref[...] = mi
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "pad_tile", "clamp", "interpret"))
+def _fused_ivf_topk_pallas(probes, qres, qres_norms, list_data, row_norms,
+                           list_indices, k: int, pad_tile: int, clamp: bool,
+                           interpret: bool):
+    nq, n_probes = probes.shape
+    n_lists, list_pad, rot = list_data.shape
+    pt = pad_tile
+    n_r = list_pad // pt
+    kp = _kp(k)
+    qres_c = qres.astype(jnp.float32)
+    qn_c = qres_norms.astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_probes, n_r),
+        in_specs=[
+            pl.BlockSpec((1, 1, rot), lambda i, j, r, probes: (i, j, 0)),
+            pl.BlockSpec((1, 1), lambda i, j, r, probes: (i, j)),
+            pl.BlockSpec((1, pt, rot),
+                         lambda i, j, r, probes: (probes[i, j], r, 0)),
+            pl.BlockSpec((1, pt),
+                         lambda i, j, r, probes: (probes[i, j], r)),
+            pl.BlockSpec((1, pt),
+                         lambda i, j, r, probes: (probes[i, j], r)),
+        ],
+        # carry blocks revisited across BOTH probe and slab-tile axes
+        out_specs=(pl.BlockSpec((1, kp), lambda i, j, r, probes: (i, 0)),
+                   pl.BlockSpec((1, kp), lambda i, j, r, probes: (i, 0))),
+    )
+    val, idx = pl.pallas_call(
+        functools.partial(_fused_ivf_topk_kernel, k=k, kp=kp, clamp=clamp),
+        out_shape=(jax.ShapeDtypeStruct((nq, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((nq, kp), jnp.int32)),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(probes.astype(jnp.int32), qres_c, qn_c, list_data, row_norms,
+      list_indices)
+    return val[:, :k], idx[:, :k]
+
+
+def fused_ivf_topk(probes, qres, qres_norms, list_data, row_norms,
+                   list_indices, k: int, pad_tile: int = None,
+                   clamp: bool = True, vmem_budget: int = None,
+                   interpret: bool = False):
+    """Fused probe-gather + scan + top-k for the IVF families.
+
+    probes [nq, P] int32; qres [nq, P, rot] (per-probe query residual for
+    ivf_pq's decoded cache, or the query replicated for flat scans);
+    qres_norms [nq, P] = ||q_res||² (the per-probe base making distances
+    comparable across probes); list_data [L, pad, rot] (fp32 or bf16 —
+    upcast in-kernel, fp32 accumulation); row_norms [L, pad] fp32;
+    list_indices [L, pad] int32 with -1 padding. Returns
+    ``(distances [nq, k], ids [nq, k])`` ascending squared-L2, -1 ids
+    where fewer than k valid candidates were probed.
+
+    Unlike ``ivf_scan`` the [nq, P, pad] candidate slab never exists in
+    HBM: each probed slab tile is DMA'd to VMEM (scalar-prefetch block
+    index) and merged straight into the query's resident top-k carry.
+    ``pad_tile`` must divide the list layout's pad exactly (default: the
+    VMEM-budget solve, ``plan_fused_ivf_tile``); ``clamp`` applies
+    ivf_flat's max(d, 0) exact-L2 clamp (ivf_pq's ADC space is unclamped)."""
+    if k > 1024:
+        raise ValueError(
+            f"fused_ivf_topk is a small-k kernel (k={k} > 1024); "
+            "use the XLA engines")
+    list_pad = list_data.shape[1]
+    if pad_tile is None:
+        pad_tile = plan_fused_ivf_tile(
+            list_pad, list_data.shape[2], k,
+            jnp.dtype(list_data.dtype).itemsize, vmem_budget)
+    if list_pad % pad_tile:
+        raise ValueError(
+            f"pad_tile={pad_tile} does not divide list_pad={list_pad}")
+    return _fused_ivf_topk_pallas(probes, qres, qres_norms, list_data,
+                                  row_norms, list_indices, int(k),
+                                  int(pad_tile), bool(clamp),
+                                  bool(interpret))
+
+
+# ---------------------------------------------------- fused pq-lut top-k
+
+
+def fused_pq_vmem_bytes(pad_tile: int, pq_dim: int, book: int, pq_len: int,
+                        k: int) -> int:
+    """TRUE VMEM live set of one fused PQ grid step: the resident
+    codebooks + norms, the packed-code block and its int32 unpack, the
+    per-subspace one-hot compare/select pair, the accumulator rows, and
+    the running-merge set. Public for the C001 calibration audit."""
+    kp = _kp(k)
+    return (pq_dim * book * (pq_len * 4 + 8)
+            + pad_tile * pq_dim * 5
+            + pad_tile * book * 8
+            + pad_tile * 12 + 32 * kp)
+
+
+def plan_fused_pq_tile(list_pad: int, pq_dim: int, book: int, pq_len: int,
+                       k: int, vmem_budget: int = None) -> int:
+    """Code-slab row tile for ``fused_pq_topk`` — largest divisor of
+    ``list_pad`` fitting the VMEM budget (8-multiples preferred), exactly
+    like ``plan_fused_ivf_tile``."""
+    budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
+    best = 1
+    best_aligned = 0
+    for pt in range(1, list_pad + 1):
+        if list_pad % pt:
+            continue
+        if fused_pq_vmem_bytes(pt, pq_dim, book, pq_len, k) <= budget:
+            best = pt
+            if pt % 8 == 0:
+                best_aligned = pt
+    return best_aligned or best
+
+
+def fused_pq_workspace_bytes(nq: int, n_probes: int, rot: int,
+                             n_lists: int, list_pad: int, pq_dim: int,
+                             book: int, pq_len: int, k: int,
+                             pad_tile: int = None) -> int:
+    """HBM-side workspace of one fused PQ (LUT-engine) dispatch: the
+    packed code slab counted twice (staged + kernel operand, same CPU
+    interpreter measurement / TPU over-prediction note as
+    ``fused_ivf_workspace_bytes``), the rotated queries and centers, the
+    codebook norms, the masked id copy, the [nq, kp] outputs, and one
+    grid step's block set. No per-probe LUT or candidate slab appears —
+    that is the point of the fusion. Public for the C001 audit."""
+    if pad_tile is None:
+        pad_tile = plan_fused_pq_tile(list_pad, pq_dim, book, pq_len, k)
+    kp = _kp(k)
+    return (2 * n_lists * list_pad * pq_dim
+            + n_lists * list_pad * 4
+            + (nq + n_lists) * rot * 4
+            + pq_dim * book * 4
+            + nq * kp * 8
+            + fused_pq_vmem_bytes(pad_tile, pq_dim, book, pq_len, k))
+
+
+def _fused_pq_topk_kernel(probes_ref, q_ref, c_ref, cb_ref, cbn_ref,
+                          codes_ref, ids_ref, val_ref, idx_ref, *, k: int,
+                          kp: int, pq_dim: int, book: int):
+    """One (query, probe, slab-tile) step of the LUT engine, entirely
+    on-chip: build this probe's LUT from the residual and the resident
+    codebooks, accumulate per-code contributions across subspaces, merge
+    into the top-k carry. The per-probe LUT and the code slab never exist
+    in HBM. Mosaic has no per-row gather lowering, so the LUT lookup is a
+    one-hot compare/select/sum per subspace — book·pad_tile VPU lanes per
+    subspace, the price of keeping the slab on-chip."""
+    j = pl.program_id(1)
+    r = pl.program_id(2)
+    res = q_ref[0] - c_ref[0]  # [rot] — query residual vs probed center
+    pq_len = cb_ref.shape[2]
+    sub = res.reshape(pq_dim, pq_len)
+    base = jnp.sum(res * res)  # ||q_res||² (the ADC base term)
+    codes = codes_ref[0].astype(jnp.int32)  # [pt, pq_dim] (pq_bits=8: raw)
+    cbn = cbn_ref[...]  # [pq_dim, book]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, book), 1)
+
+    def body(s, acc):
+        cb_s = pl.load(cb_ref, (pl.dslice(s, 1), slice(None), slice(None)))
+        sub_s = jax.lax.dynamic_slice_in_dim(sub, s, 1, 0)  # [1, l]
+        dots_s = jax.lax.dot_general(
+            sub_s, cb_s[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [1, book]
+        lut_s = jax.lax.dynamic_slice_in_dim(cbn, s, 1, 0) - 2.0 * dots_s
+        code_s = jax.lax.dynamic_slice_in_dim(codes, s, 1, 1)  # [pt, 1]
+        hit = code_s == col  # [pt, book]
+        return acc + jnp.sum(jnp.where(hit, lut_s, 0.0), axis=1)
+
+    d = base + jax.lax.fori_loop(
+        0, pq_dim, body, jnp.zeros((codes.shape[0],), jnp.float32))
+    ids = ids_ref[0]
+    d = jnp.where(ids < 0, jnp.inf, d)
+    tv, ti = _extract_topk(d[None, :], ids[None, :], k, kp)
+
+    @pl.when((j == 0) & (r == 0))
+    def _():
+        val_ref[...] = tv
+        idx_ref[...] = ti
+
+    @pl.when((j > 0) | (r > 0))
+    def _():
+        cv = jnp.concatenate([val_ref[...], tv], axis=1)
+        ci = jnp.concatenate([idx_ref[...], ti], axis=1)
+        mv, mi = _extract_topk(cv, ci, k, kp)
+        val_ref[...] = mv
+        idx_ref[...] = mi
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "pad_tile", "interpret"))
+def _fused_pq_topk_pallas(probes, q_rot, centers_rot, codebooks, cb_norms,
+                          list_codes, list_indices, k: int, pad_tile: int,
+                          interpret: bool):
+    nq, n_probes = probes.shape
+    n_lists, list_pad, n_code_bytes = list_codes.shape
+    pq_dim, book, pq_len = codebooks.shape
+    rot = q_rot.shape[1]
+    pt = pad_tile
+    n_r = list_pad // pt
+    kp = _kp(k)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq, n_probes, n_r),
+        in_specs=[
+            pl.BlockSpec((1, rot), lambda i, j, r, probes: (i, 0)),
+            pl.BlockSpec((1, rot),
+                         lambda i, j, r, probes: (probes[i, j], 0)),
+            # codebooks + norms: whole-array blocks, revisited every step
+            pl.BlockSpec((pq_dim, book, pq_len),
+                         lambda i, j, r, probes: (0, 0, 0)),
+            pl.BlockSpec((pq_dim, book), lambda i, j, r, probes: (0, 0)),
+            pl.BlockSpec((1, pt, n_code_bytes),
+                         lambda i, j, r, probes: (probes[i, j], r, 0)),
+            pl.BlockSpec((1, pt),
+                         lambda i, j, r, probes: (probes[i, j], r)),
+        ],
+        out_specs=(pl.BlockSpec((1, kp), lambda i, j, r, probes: (i, 0)),
+                   pl.BlockSpec((1, kp), lambda i, j, r, probes: (i, 0))),
+    )
+    val, idx = pl.pallas_call(
+        functools.partial(_fused_pq_topk_kernel, k=k, kp=kp, pq_dim=pq_dim,
+                          book=book),
+        out_shape=(jax.ShapeDtypeStruct((nq, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((nq, kp), jnp.int32)),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(probes.astype(jnp.int32), q_rot.astype(jnp.float32),
+      centers_rot.astype(jnp.float32), codebooks.astype(jnp.float32),
+      cb_norms.astype(jnp.float32), list_codes, list_indices)
+    return val[:, :k], idx[:, :k]
+
+
+def fused_pq_topk(probes, q_rot, centers_rot, codebooks, cb_norms,
+                  list_codes, list_indices, k: int, pad_tile: int = None,
+                  vmem_budget: int = None, interpret: bool = False):
+    """Fused PQ LUT build + code gather + accumulate + top-k (ivf_pq's
+    LUT regime without the per-probe candidate slab in HBM).
+
+    Restricted to ``pq_bits=8`` PER_SUBSPACE codebooks: the packed code
+    bytes ARE the codes (no unpack shuffle in-kernel). probes [nq, P];
+    q_rot [nq, rot]; centers_rot [L, rot]; codebooks [pq_dim, book,
+    pq_len] with cb_norms [pq_dim, book] = ||codebook row||²; list_codes
+    [L, pad, pq_dim] uint8; list_indices [L, pad] int32, -1 padding.
+    Returns ascending ADC squared-L2 ``(distances [nq, k], ids [nq, k])``."""
+    if k > 1024:
+        raise ValueError(
+            f"fused_pq_topk is a small-k kernel (k={k} > 1024); "
+            "use the XLA engines")
+    n_lists, list_pad, n_code_bytes = list_codes.shape
+    pq_dim, book, pq_len = codebooks.shape
+    if n_code_bytes != pq_dim:
+        raise ValueError(
+            f"fused_pq_topk requires pq_bits=8 (one byte per code); got "
+            f"{n_code_bytes} code bytes for pq_dim={pq_dim}")
+    if pad_tile is None:
+        pad_tile = plan_fused_pq_tile(list_pad, pq_dim, book, pq_len, k,
+                                      vmem_budget)
+    if list_pad % pad_tile:
+        raise ValueError(
+            f"pad_tile={pad_tile} does not divide list_pad={list_pad}")
+    return _fused_pq_topk_pallas(probes, q_rot, centers_rot, codebooks,
+                                 cb_norms, list_codes, list_indices,
+                                 int(k), int(pad_tile), bool(interpret))

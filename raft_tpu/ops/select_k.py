@@ -384,6 +384,7 @@ def select_k(
     indices: Optional[jax.Array] = None,
     algo: SelectAlgo = SelectAlgo.AUTO,
     recall_target: float = 0.95,
+    pad_rules: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Select k smallest (or largest) per row of ``values`` [batch, len].
 
@@ -396,11 +397,17 @@ def select_k(
     primitive stays exact, matching matrix::select_k); ANN searches opt
     in through their search params where the recall trade is theirs to
     make.
+
+    ``pad_rules=False`` skips the TOPK_PAD k-padding lookup. The measured
+    rules model an HBM-resident select over a raw scan slab; callers whose
+    selection already happened inside a fused Pallas kernel (the input is
+    a short merged candidate list, not a slab) must not be re-padded on
+    top of the in-kernel carry width.
     """
     values = jnp.asarray(values)
     if values.ndim == 1:
         v, i = select_k(values[None], k, select_min, None, algo,
-                        recall_target)
+                        recall_target, pad_rules)
         v, i = v[0], i[0]
         if indices is not None:
             # preserve -1 null markers (PALLAS exhausted-row convention)
@@ -419,7 +426,7 @@ def select_k(
                              jnp.issubdtype(values.dtype, jnp.floating))
     # pad rules resolve pre-jit too: the padded k is part of the compile
     # key, so installing/dropping TOPK_PAD rules retraces fresh calls
-    k_pad = _pad_k(values.shape[-1], int(k)) if algo in (
+    k_pad = _pad_k(values.shape[-1], int(k)) if pad_rules and algo in (
         SelectAlgo.DIRECT, SelectAlgo.SCREEN) else 0
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo,
                                  float(recall_target), k_pad)

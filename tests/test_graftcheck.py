@@ -207,7 +207,7 @@ def test_audit_detects_pre_tiling_unbounded_variant():
 def test_audit_default_entries_all_within_budget():
     from raft_tpu.analysis import jaxpr_audit as ja
     results, findings = ja.run_audit()
-    assert len(results) == 7
+    assert len(results) == 11
     assert findings == [], [f.format() for f in findings]
     assert all(r.ok for r in results)
 

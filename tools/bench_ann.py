@@ -1,7 +1,9 @@
 """ANN micro-bench on the current backend.
 
 Usage: python tools/bench_ann.py [ivf_flat|ivf_pq|cagra|bf|all] [n_rows]
-Set RAFT_TPU_PALLAS=1 to route IVF scans through the Pallas fused kernel.
+Scan-engine routing follows the committed PALLAS_PROBE artifact (fused
+scan+select on TPU where the probe shows it winning; scan_mode="pallas"
+in SearchParams forces it) — the RAFT_TPU_PALLAS env flag is retired.
 Clustered (make_blobs) data so recall reflects the IVF regime.
 Fence-based timing (bench/timing.py): block_until_ready under-waits on
 the axon tunnel, and queries are uploaded once before any timed region.

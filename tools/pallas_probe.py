@@ -4,13 +4,22 @@ Every Pallas kernel in this repo had only ever run under the Mosaic
 interpreter until round 3; the first hardware attempts exposed missing
 lowerings (take_along_axis in the streaming top-k; block-alignment in
 the DMA scan). This probes what actually lowers and how it compares to
-the XLA paths, writing PALLAS_PROBE_tpu.json:
+the XLA paths, writing PALLAS_PROBE_tpu.json (schema v2):
 
 - fused_l2_argmin (k-means assignment kernel) vs the XLA fused_l2_nn
   at n_clusters ∈ {1024, 8192} — the hot loop of every IVF build.
 - pallas_select_k (streaming k-extraction) vs DIRECT/APPROX at small k.
+- the fused scan+select engines (``scan_mode="pallas"``: VMEM-resident
+  top-k carry) vs the XLA two-step through the public search APIs at
+  the sift-1M shape grid, one A/B per family — plus the retired
+  per-kernel routes (the unfused DMA ivf_scan, fused_l2_argmin inside
+  k-means). Each row ends in a ``fused_wins`` verdict;
+  ``ops.pallas_kernels.fused_crossover`` reads the committed artifact's
+  verdicts, so THIS FILE is where ``scan_mode="auto"`` routing is
+  decided — re-run after kernel or compiler changes.
 
 Usage: python tools/pallas_probe.py [--out PALLAS_PROBE_tpu.json]
+       [--n 1000000]  (database rows for the fused A/B grid)
 """
 
 import argparse
@@ -24,9 +33,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def _overlap(i_a, i_b, rows: int = 2048) -> float:
+    """Mean per-row fraction of shared neighbor ids (order-insensitive —
+    ties at the k boundary reorder freely between engines)."""
+    a = np.asarray(i_a)[:rows]
+    b = np.asarray(i_b)[:rows]
+    return float(np.mean([
+        len(np.intersect1d(r, s)) / max(r.shape[0], 1)
+        for r, s in zip(a, b)]))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="PALLAS_PROBE_tpu.json")
+    ap.add_argument("--n", type=int, default=1_000_000,
+                    help="database rows for the fused scan+select grid")
     args = ap.parse_args()
 
     import jax
@@ -36,7 +57,8 @@ def main():
     from raft_tpu.ops import pallas_kernels as pk
     from raft_tpu.ops.select_k import SelectAlgo, select_k
 
-    art = {"platform": jax.default_backend(),
+    art = {"schema": "raft_tpu.pallas_probe/v2",
+           "platform": jax.default_backend(),
            "when": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
     rng = np.random.default_rng(0)
 
@@ -80,6 +102,127 @@ def main():
             lambda: select_k(v, k, algo=SelectAlgo.APPROX), iters=5) * 1e3, 2)
         art["select_k"][f"k_{k}"] = row
         print(f"select_k k={k}: {row}", flush=True)
+
+    # ---- fused scan+select engines vs the XLA two-step (sift-1M grid).
+    # The fused_wins verdicts below ARE the scan_mode="auto" routing
+    # table (pallas_kernels.fused_crossover) once this artifact is
+    # committed.
+    from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+    from raft_tpu.ops import rng as rrng
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    art["fused"] = {}
+    n, dim, kk = args.n, 128, 100
+    xb, _ = rrng.make_blobs(jax.random.key(7), n, dim, n_clusters=1024,
+                            cluster_std=0.3)
+    db = np.asarray(xb, np.float32)
+    q = prepare(db[rng.integers(0, n, 1024)]
+                + 0.05 * rng.standard_normal((1024, dim)).astype(np.float32))
+
+    def fused_ab(fam, run_pallas, run_xla, extra=None):
+        row = dict(extra or {})
+        try:
+            _, pi = run_pallas()
+            _, xi = run_xla()
+            row["agreement"] = round(_overlap(pi, xi), 5)
+            row["pallas_ms"] = round(
+                time_dispatches(run_pallas, iters=5) * 1e3, 2)
+            row["xla_ms"] = round(
+                time_dispatches(run_xla, iters=5) * 1e3, 2)
+            row["fused_wins"] = bool(
+                on_tpu and row["agreement"] >= 0.99
+                and row["pallas_ms"] < row["xla_ms"])
+            if not on_tpu:
+                # scan_mode="pallas" silently falls back off-TPU, so the
+                # timings compare XLA with itself — never a verdict
+                row["note"] = "xla-fallback (no TPU): not a verdict"
+        except Exception as e:
+            row["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+            row["fused_wins"] = False
+        art["fused"][fam] = row
+        print(f"fused {fam}: {row}", flush=True)
+
+    qb = prepare(db[rng.integers(0, n, 10_000)]
+                 + 0.05 * rng.standard_normal((10_000, dim)).astype(
+                     np.float32))
+    bf = brute_force.build(db, metric="sqeuclidean")
+    fused_ab(
+        "brute_force",
+        lambda: brute_force.search(bf, qb, kk, scan_mode="pallas"),
+        lambda: brute_force.search(bf, qb, kk, scan_mode="xla"))
+
+    fi = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=1024,
+                                                 kmeans_n_iters=10))
+    sp_p = ivf_flat.SearchParams(n_probes=64, scan_mode="pallas")
+    sp_x = ivf_flat.SearchParams(n_probes=64, scan_mode="xla")
+    fused_ab(
+        "ivf_flat",
+        lambda: ivf_flat.search(fi, q, kk, sp_p),
+        lambda: ivf_flat.search(fi, q, kk, sp_x))
+
+    # the retired per-kernel route: the unfused DMA ivf_scan inside the
+    # XLA engine, toggled via the crossover hook it is now gated behind
+    key = pk.fused_platform_key()
+    try:
+        pk.set_fused_crossover(key, {"ivf_scan": True})
+        old_ms = round(time_dispatches(
+            lambda: ivf_flat.search(fi, q, kk, sp_x), iters=5) * 1e3, 2)
+        pk.set_fused_crossover(key, {"ivf_scan": False})
+        xla_ms = round(time_dispatches(
+            lambda: ivf_flat.search(fi, q, kk, sp_x), iters=5) * 1e3, 2)
+        row = {"pallas_ms": old_ms, "xla_ms": xla_ms,
+               "fused_wins": bool(on_tpu and old_ms < xla_ms)}
+    except Exception as e:
+        row = {"pallas_error": f"{type(e).__name__}: {e}"[:300],
+               "fused_wins": False}
+    finally:
+        pk.set_fused_crossover(key, None)
+    art["fused"]["ivf_scan"] = row
+    print(f"fused ivf_scan: {row}", flush=True)
+
+    pq = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=1024, pq_dim=64,
+                                             pq_bits=8, kmeans_n_iters=10))
+    sp_pp = ivf_pq.SearchParams(n_probes=64, scan_mode="pallas")
+    sp_pc = ivf_pq.SearchParams(n_probes=64, scan_mode="cache")
+    sp_pl = ivf_pq.SearchParams(n_probes=64, scan_mode="lut")
+    cache_ms = round(time_dispatches(
+        lambda: ivf_pq.search(pq, q, kk, sp_pc), iters=5) * 1e3, 2)
+    lut_ms = round(time_dispatches(
+        lambda: ivf_pq.search(pq, q, kk, sp_pl), iters=5) * 1e3, 2)
+    fused_ab(
+        "ivf_pq",
+        lambda: ivf_pq.search(pq, q, kk, sp_pp),
+        (lambda: ivf_pq.search(pq, q, kk, sp_pc)) if cache_ms <= lut_ms
+        else (lambda: ivf_pq.search(pq, q, kk, sp_pl)),
+        extra={"cache_ms": cache_ms, "lut_ms": lut_ms})
+
+    # per-kernel fused_l2_argmin verdict, derived from the section above
+    # (it must win at EVERY probed cluster count to earn the k-means
+    # routing — ops/fused_l2_nn.py consults this family)
+    l2_rows = list(art["fused_l2_argmin"].values())
+    art["fused"]["l2_argmin"] = {
+        "derived_from": "fused_l2_argmin",
+        "fused_wins": bool(on_tpu and l2_rows and all(
+            "pallas_ms" in r and r["pallas_ms"] < r["xla_ms"]
+            for r in l2_rows))}
+    print(f"fused l2_argmin: {art['fused']['l2_argmin']}", flush=True)
+
+    # flat mirror for tools/bench_gate.py (its "metrics" document shape):
+    # "<section>.<row>.<field>" → number, so queue runs can diff probe
+    # rounds with the noise-aware tolerance band. Bools stay out — a
+    # verdict flip is a routing decision, not a regression metric.
+    flat = {}
+
+    def _flatten(prefix, d):
+        for key, val in d.items():
+            if isinstance(val, dict):
+                _flatten(f"{prefix}{key}.", val)
+            elif isinstance(val, (int, float)) and not isinstance(val, bool):
+                flat[f"{prefix}{key}"] = val
+
+    for section in ("fused_l2_argmin", "select_k", "fused"):
+        _flatten(f"{section}.", art.get(section, {}))
+    art["metrics"] = flat
 
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)

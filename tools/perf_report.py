@@ -2,7 +2,8 @@
 """perf_report — compiled-cost roofline + planner-calibration artifact.
 
 AOT-compiles the canonical entrypoint cores (the graftcheck jaxpr-audit
-seven plus cagra — all four ANN families) on the current backend, reads
+set — all four ANN families, XLA and fused-Pallas engines — plus cagra)
+on the current backend, reads
 XLA's cost/memory analysis, and writes ``PERF_REPORT_<platform>.json``:
 FLOPs, HBM bytes, peak temp memory, roofline placement (TPU only — on
 CPU absolutes are reported without a peaks table), and the planner

@@ -81,8 +81,20 @@ run_step latency /tmp/q5_latency.done timeout 2400 \
 run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
   python tools/bench_ann.py cagra 100000
 
-# pallas + aot verdicts (VERDICT #7) — quick, settles two-round limbo
-run_step pallas /tmp/q5_pallas.done timeout 1800 python tools/pallas_probe.py
+# pallas + aot verdicts (VERDICT #7). The probe is schema v2 now (fused
+# scan+select A/B at the sift-1M grid — builds two 1M indexes, so it
+# needs a longer slice); fresh marker so hosts with the v1 marker re-run
+# it. The committed artifact is stashed first, then diffed against the
+# fresh one with the noise-aware gate — non-fatal, like benchgate: a
+# crossover shift is a finding for the wrap-up commit, not a reason to
+# starve the queue.
+run_step pallasbase /tmp/q5_pallasbase.done \
+  cp PALLAS_PROBE_tpu.json /tmp/q_pallas_baseline.json
+run_step pallas2 /tmp/q5_pallas2.done timeout 3600 python tools/pallas_probe.py
+run_step pallasgate /tmp/q5_pallasgate.done timeout 600 \
+  python tools/bench_gate.py --allow-missing \
+  --json /tmp/q_pallasgate_verdicts.json \
+  /tmp/q_pallas_baseline.json PALLAS_PROBE_tpu.json
 run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
 # micro-batching serving engine: closed-loop QPS vs the sequential-b1
