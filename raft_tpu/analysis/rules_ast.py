@@ -1,4 +1,4 @@
-"""Tier-A rules R001/R002/R003/R005/R006 — pure-AST, no JAX import.
+"""Tier-A rules R001/R002/R003/R005/R006/R007 — pure-AST, no JAX import.
 
 Each rule is a function ``(ModuleInfo) -> list[Finding]``. Precision over
 recall: every pattern here is one that has actually burned a TPU window
@@ -425,6 +425,69 @@ def rule_untraced_entry_point(mod: ModuleInfo) -> list:
     return out
 
 
+# ----------------------------------------------------------------- R007
+#: calls that resolve an engine choice which may silently fall back
+DISPATCH_CALLS = frozenset({
+    "raft_tpu.ops.pallas_kernels.fused_dispatch",
+    "raft_tpu.ops.pallas_kernels.fused_dispatch_explained",
+})
+#: attribution emitters that satisfy R007 — each produces a reason-coded
+#: ExplainRecord / dispatch-counter increment (or the select_k note)
+ATTRIBUTION_CALLS = frozenset({
+    "raft_tpu.obs.explain.record_dispatch",
+    "raft_tpu.obs.explain.note_select_k",
+})
+#: packages whose dispatch sites must be attributed
+R007_SCOPES = ("raft_tpu.neighbors.", "raft_tpu.ops.")
+#: the module that DEFINES the dispatch helpers is not a dispatch site
+R007_EXEMPT = frozenset({"raft_tpu.ops.pallas_kernels"})
+
+
+def rule_unattributed_dispatch(mod: ModuleInfo) -> list:
+    """R007: dispatch decision without execution-plan attribution.
+
+    A function in ``raft_tpu.neighbors``/``raft_tpu.ops`` that consults
+    ``fused_dispatch``/``fused_dispatch_explained`` is choosing between
+    engines — and historically the losing branch fell back *silently*
+    (the scan_mode="auto" XLA fallback that motivated the explain layer,
+    docs/observability.md). Such a function must also call
+    ``obs.explain.record_dispatch`` (or ``note_select_k`` for trace-time
+    resolution) so every resolved branch is reason-coded. Nested defs
+    count toward their top-level function: the fused/xla split often
+    lives in a closure, and attribution anywhere in the function body
+    covers it.
+    """
+    if (not mod.modname.startswith(R007_SCOPES)
+            or mod.modname in R007_EXEMPT):
+        return []
+    out = []
+    for qual, info in sorted(mod.functions.items()):
+        if info.parent is not None:
+            continue  # rolled up into the enclosing top-level function
+        dispatch_nodes = []
+        attributed = False
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.resolve(node.func)
+            if dotted in DISPATCH_CALLS:
+                dispatch_nodes.append(node)
+            elif dotted in ATTRIBUTION_CALLS:
+                attributed = True
+        if attributed:
+            continue
+        for node in dispatch_nodes:
+            if mod.suppressed(node.lineno, "R007"):
+                continue
+            out.append(Finding(
+                "R007", mod.relfile, qual, node.lineno,
+                "dispatch decision (fused_dispatch) with no execution-"
+                "plan attribution in this function: call "
+                "obs.explain.record_dispatch on every resolved branch "
+                "so fallbacks are reason-coded, never silent"))
+    return out
+
+
 def _enclosing_qualname(mod: ModuleInfo, node) -> str:
     """Innermost function whose span contains ``node`` (by line)."""
     best, best_span = "<module>", None
@@ -438,4 +501,5 @@ def _enclosing_qualname(mod: ModuleInfo, node) -> str:
 
 
 AST_RULES = (rule_host_sync, rule_traced_branch, rule_recompile_hazard,
-             rule_unguarded_broadcast, rule_untraced_entry_point)
+             rule_unguarded_broadcast, rule_untraced_entry_point,
+             rule_unattributed_dispatch)

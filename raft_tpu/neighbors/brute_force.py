@@ -18,6 +18,7 @@ the reference's internal naive_knn.cuh:82).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional, Tuple
 
@@ -38,6 +39,7 @@ from raft_tpu.ops.distance import (
     row_norms_sq,
     pairwise_core,
 )
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.ops import pallas_kernels as pk
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
                                    select_k_maybe_approx)
@@ -297,9 +299,29 @@ def _fused_eligible(index: Index, k: int, has_filter: bool,
     """The fallback matrix for ``scan_mode="pallas"`` (docs/tuning.md):
     L2 metrics, float data, small k, no bitset filter (the kernel has no
     in-carry filter epilogue), not combined with the bf16 fast scan."""
-    return (index.metric in _FUSED_SCAN_METRICS
-            and not has_filter and not fast_scan and k <= 1024
-            and jnp.issubdtype(index.dataset.dtype, jnp.floating))
+    return fused_ineligible_reason(index.metric, index.dataset.dtype, k,
+                                   has_filter, fast_scan) is None
+
+
+def fused_ineligible_reason(metric, dtype, k: int, has_filter: bool,
+                            fast_scan: bool,
+                            require_float: bool = True) -> Optional[str]:
+    """First failing clause of the fused fallback matrix as an
+    ``obs.explain`` reason code, or None when fully eligible — shared by
+    brute_force and ivf_flat (same conjunction, except ivf_flat's fused
+    scan accepts narrow list dtypes → ``require_float=False``) so the
+    explain record names the same cause docs/tuning.md documents."""
+    if metric not in _FUSED_SCAN_METRICS:
+        return "non_l2"
+    if has_filter:
+        return "filtered"
+    if fast_scan:
+        return "fast_scan"
+    if k > 1024:
+        return "k_gt_1024"
+    if require_float and not jnp.issubdtype(dtype, jnp.floating):
+        return "non_float_dtype"
+    return None
 
 
 @tracing.range("brute_force.search")
@@ -307,7 +329,8 @@ def search(index: Index, queries, k: int, filter=None,
            res: Optional[Resources] = None, scan_dtype=None,
            refine_ratio: float = 4.0,
            select_recall: float = 1.0,
-           scan_mode: str = "auto") -> Tuple[jax.Array, jax.Array]:
+           scan_mode: str = "auto",
+           explain: bool = False):
     """Exact kNN search → (distances [nq, k], indices [nq, k]).
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
@@ -329,7 +352,9 @@ def search(index: Index, queries, k: int, filter=None,
     shows it winning. Unsupported combinations (non-L2 metric, filter,
     fast scan, k > 1024, CPU without the interpret hook) fall back to XLA
     silently — the mode is a performance hint, never a correctness
-    switch."""
+    switch. Every resolution is attributed: a reason-coded dispatch
+    counter increments per call, and ``explain=True`` additionally
+    returns ``(distances, indices, ExplainRecord)``."""
     res = ensure_resources(res)
     if scan_mode not in ("auto", "xla", "pallas"):
         raise ValueError(
@@ -357,32 +382,57 @@ def search(index: Index, queries, k: int, filter=None,
     refine_mult = refine_multiplier(refine_ratio, fast_scan)
     nq = queries.shape[0]
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
-    use_fused, fused_interp = pk.fused_dispatch("brute_force", scan_mode)
-    if use_fused and _fused_eligible(index, k, filter is not None, fast_scan):
-        tm, tn = pk.plan_fused_topk_tiles(
-            queries.shape[0], index.size, index.dim, k)
-        v, i = _knn_fused_jit(
-            queries, index.dataset, index.norms, k, tm, tn,
-            index.metric == DistanceType.L2SqrtExpanded, fused_interp)
-        return v[:nq], i[:nq]
-    q_tile, db_tile = _choose_tiles(
-        queries.shape[0], index.size, index.dim, k, res.workspace_limit_bytes
-    )
-    if fast_scan:
-        # Budget the refine gather too: [q_tile, k_refine, dim] fp32
-        # candidates must fit the workspace like the scan tile does.
-        k_refine = max(min(refine_mult * k, db_tile), k)
-        per_row = k_refine * index.dim * 4
-        q_cap = max(8, res.workspace_limit_bytes // (4 * max(per_row, 1)))
-        q_tile = min(q_tile, q_cap - q_cap % 8 or 8)
-    v, i = _knn_jit(
-        queries, index.dataset, index.norms,
-        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
-        index.metric, index.metric_arg,
-        k, q_tile, db_tile, res.workspace_limit_bytes, filter is not None,
-        fast_scan, refine_mult,
-        select_recall=float(select_recall),
-    )
+    use_fused, fused_interp, dreason = pk.fused_dispatch_explained(
+        "brute_force", scan_mode)
+    ineligible = fused_ineligible_reason(
+        index.metric, index.dataset.dtype, k, filter is not None, fast_scan)
+    ex_params = {"k": k, "nq": nq, "bucket": queries.shape[0],
+                 "n_db": index.size, "dim": index.dim,
+                 "metric": index.metric.name}
+    with contextlib.ExitStack() as stack:
+        cap = stack.enter_context(obs_explain.capture()) if explain else None
+        if use_fused and ineligible is None:
+            tm, tn = pk.plan_fused_topk_tiles(
+                queries.shape[0], index.size, index.dim, k)
+            obs_explain.record_dispatch(
+                "brute_force", scan_mode, "pallas", dreason,
+                params=ex_params, plan={"tm": tm, "tn": tn,
+                                        "interpret": fused_interp})
+            v, i = _knn_fused_jit(
+                queries, index.dataset, index.norms, k, tm, tn,
+                index.metric == DistanceType.L2SqrtExpanded, fused_interp)
+        else:
+            q_tile, db_tile = _choose_tiles(
+                queries.shape[0], index.size, index.dim, k,
+                res.workspace_limit_bytes)
+            if fast_scan:
+                # Budget the refine gather too: [q_tile, k_refine, dim] fp32
+                # candidates must fit the workspace like the scan tile does.
+                k_refine = max(min(refine_mult * k, db_tile), k)
+                per_row = k_refine * index.dim * 4
+                q_cap = max(
+                    8, res.workspace_limit_bytes // (4 * max(per_row, 1)))
+                q_tile = min(q_tile, q_cap - q_cap % 8 or 8)
+            # fused was dispatchable but this request's shape wasn't
+            # eligible -> the matrix clause outranks the dispatch verdict
+            reason = ineligible if (use_fused and ineligible) else dreason
+            obs_explain.record_dispatch(
+                "brute_force", scan_mode, "xla", reason, params=ex_params,
+                plan={"q_tile": q_tile, "db_tile": db_tile,
+                      "predicted_peak_bytes": planned_peak_bytes(
+                          queries.shape[0], index.size, index.dim, k,
+                          res.workspace_limit_bytes)})
+            v, i = _knn_jit(
+                queries, index.dataset, index.norms,
+                filter.words if filter is not None
+                else jnp.zeros((0,), jnp.uint32),
+                index.metric, index.metric_arg,
+                k, q_tile, db_tile, res.workspace_limit_bytes,
+                filter is not None, fast_scan, refine_mult,
+                select_recall=float(select_recall),
+            )
+    if explain:
+        return v[:nq], i[:nq], cap.last
     return v[:nq], i[:nq]
 
 
@@ -391,11 +441,12 @@ def knn(queries, dataset, k: int, metric="euclidean", metric_arg: float = 2.0,
         res: Optional[Resources] = None, scan_dtype=None,
         refine_ratio: float = 4.0,
         select_recall: float = 1.0,
-        scan_mode: str = "auto") -> Tuple[jax.Array, jax.Array]:
+        scan_mode: str = "auto", explain: bool = False):
     """One-shot exact kNN (reference: brute_force::knn)."""
     return search(build(dataset, metric, metric_arg, res), queries, k,
                   res=res, scan_dtype=scan_dtype, refine_ratio=refine_ratio,
-                  select_recall=select_recall, scan_mode=scan_mode)
+                  select_recall=select_recall, scan_mode=scan_mode,
+                  explain=explain)
 
 
 _SERIAL_VERSION = 1

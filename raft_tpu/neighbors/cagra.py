@@ -42,6 +42,7 @@ import numpy as np
 from raft_tpu.core import serialize as ser
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.ops.distance import (
     DistanceType,
     gathered_distances,
@@ -512,9 +513,14 @@ def search(
     params: Optional[SearchParams] = None,
     filter=None,
     res: Optional[Resources] = None,
-) -> Tuple[jax.Array, jax.Array]:
+    explain: bool = False,
+):
     """Greedy graph search (reference: cagra::search, cagra.cuh:299 →
-    search_single_cta_kernel-inl.cuh). Returns (distances, indices).
+    search_single_cta_kernel-inl.cuh). Returns (distances, indices); with
+    ``explain=True`` a third element carries the dispatch
+    :class:`raft_tpu.obs.explain.ExplainRecord` (cagra has one engine —
+    pure XLA, no fused kernel yet — so the record exists for parity with
+    the other families and to carry the resolved beam params).
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
     dataset row ids; cleared bits are excluded from results (and from the
@@ -568,11 +574,20 @@ def search(
         if index.dataset.dtype != jnp.float32:
             raise ValueError("scan_dtype requires an fp32 dataset")
     scan_data = index.ensure_scan_dataset() if fast_scan else index.dataset
+    rec = obs_explain.record_dispatch(
+        "cagra", "auto", "xla", "only_engine",
+        params={"k": int(k), "nq": nq, "bucket": queries.shape[0],
+                "metric": index.metric.name, "graph_degree":
+                index.graph_degree, "fast_scan": fast_scan},
+        plan={"itopk": itopk, "search_width": width, "max_iter": max_iter,
+              "n_seeds": n_seeds})
     v, i = _search_jit(
         queries, index.dataset, scan_data, index.graph, seed_ids,
         filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
         index.metric, int(k), itopk, width, max_iter, filter is not None,
         fast_scan)
+    if explain:
+        return v[:nq], i[:nq], rec
     return v[:nq], i[:nq]
 
 

@@ -26,6 +26,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Optional, Tuple
 
@@ -41,6 +42,8 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.neighbors import list_packing
+from raft_tpu.neighbors.brute_force import fused_ineligible_reason
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.ops.distance import (DistanceType, gathered_distances,
                                     resolve_metric, row_norms_sq)
 from raft_tpu.ops.select_k import (refine_multiplier, select_k,
@@ -639,11 +642,14 @@ def search(
     params: Optional[SearchParams] = None,
     filter: Optional[Bitset] = None,
     res: Optional[Resources] = None,
-) -> Tuple[jax.Array, jax.Array]:
+    explain: bool = False,
+):
     """Search (reference: ivf_flat::search, ivf_flat-inl.cuh:430).
 
     Returns (distances [nq, k], indices [nq, k]); indices are source row ids,
-    -1 where fewer than k valid candidates were probed.
+    -1 where fewer than k valid candidates were probed. With
+    ``explain=True`` a third element carries the
+    :class:`raft_tpu.obs.explain.ExplainRecord` of the dispatch decision.
     """
     params = params or SearchParams()
     res = ensure_resources(res)
@@ -676,45 +682,64 @@ def search(
     # ---- fused Pallas scan+select (the VMEM top-k carry). Fallback
     # matrix (docs/tuning.md): L2 metrics, no filter (no in-carry filter
     # epilogue), no bf16 fast scan, small k.
-    use_fused, fused_interp = pk.fused_dispatch("ivf_flat", scan_mode)
-    use_fused = (use_fused and not fast_scan and filter is None
-                 and k <= 1024 and index.metric in (
-                     DistanceType.L2Expanded, DistanceType.L2SqrtExpanded))
-    if use_fused:
-        pad_tile = pk.plan_fused_ivf_tile(
-            list_pad, index.dim, int(k),
-            jnp.dtype(index.list_data.dtype).itemsize)
-        v, i = _search_fused_jit(
-            queries, index.centers, index.list_data, index.list_indices,
-            index.list_sizes, index.ensure_row_norms(),
-            index.overflow_data, index.overflow_indices,
-            index.metric, int(k), n_probes, pad_tile, has_overflow,
-            fused_interp,
-        )
-        return v[:nq], i[:nq]
-    # The unfused ivf_scan kernel only routes where a committed probe
-    # artifact shows it beating XLA — PALLAS_PROBE_tpu.json currently says
-    # it does not (22.3 ms vs 10.9 ms), so this stays off without a
-    # measured verdict; the RAFT_TPU_PALLAS=1 env override is retired.
-    # An explicit bf16 request still wins over any fp32 Pallas scan —
-    # never silently benchmark fp32 under a bf16 label.
-    use_pallas = pk.fused_crossover("ivf_scan") and not fast_scan
-    # Cached exact norms are required by the Pallas path and the bf16 fast
-    # scan; the plain XLA path keeps computing norms per probed tile instead
-    # (materializing [L, pad] fp32 norms for a large narrow-dtype index is a
-    # needless device-memory spike there).
-    need_norms = use_pallas or (
-        fast_scan and index.metric != DistanceType.InnerProduct)
-    v, i = _search_jit(
-        queries, index.centers, index.list_data, index.list_indices,
-        index.list_sizes,
-        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
-        index.metric, int(k), n_probes, q_tile, filter is not None,
-        index.ensure_row_norms() if need_norms else None, use_pallas, False,
-        fast_scan, index.overflow_data, index.overflow_indices, has_overflow,
-        float(params.select_recall),
-        refine_multiplier(params.refine_ratio, fast_scan),
-    )
+    use_fused, fused_interp, dreason = pk.fused_dispatch_explained(
+        "ivf_flat", scan_mode)
+    ineligible = fused_ineligible_reason(
+        index.metric, index.list_data.dtype, int(k), filter is not None,
+        fast_scan, require_float=False)
+    ex_params = {"k": int(k), "nq": nq, "bucket": queries.shape[0],
+                 "n_probes": n_probes, "n_lists": index.n_lists,
+                 "list_pad": list_pad, "dim": index.dim,
+                 "metric": index.metric.name}
+    with contextlib.ExitStack() as stack:
+        cap = stack.enter_context(obs_explain.capture()) if explain else None
+        if use_fused and ineligible is None:
+            pad_tile = pk.plan_fused_ivf_tile(
+                list_pad, index.dim, int(k),
+                jnp.dtype(index.list_data.dtype).itemsize)
+            obs_explain.record_dispatch(
+                "ivf_flat", scan_mode, "pallas", dreason, params=ex_params,
+                plan={"pad_tile": pad_tile, "interpret": fused_interp})
+            v, i = _search_fused_jit(
+                queries, index.centers, index.list_data, index.list_indices,
+                index.list_sizes, index.ensure_row_norms(),
+                index.overflow_data, index.overflow_indices,
+                index.metric, int(k), n_probes, pad_tile, has_overflow,
+                fused_interp,
+            )
+        else:
+            # The unfused ivf_scan kernel only routes where a committed probe
+            # artifact shows it beating XLA — PALLAS_PROBE_tpu.json currently
+            # says it does not (22.3 ms vs 10.9 ms), so this stays off
+            # without a measured verdict; the RAFT_TPU_PALLAS=1 env override
+            # is retired. An explicit bf16 request still wins over any fp32
+            # Pallas scan — never silently benchmark fp32 under a bf16 label.
+            use_pallas = pk.fused_crossover("ivf_scan") and not fast_scan
+            reason = ineligible if (use_fused and ineligible) else dreason
+            obs_explain.record_dispatch(
+                "ivf_flat", scan_mode, "xla", reason, params=ex_params,
+                plan={"q_tile": q_tile, "unfused_ivf_scan": use_pallas,
+                      "predicted_workspace_bytes": q_tile *
+                      scan_bytes_per_query(n_probes, list_pad, index.dim)})
+            # Cached exact norms are required by the Pallas path and the bf16
+            # fast scan; the plain XLA path keeps computing norms per probed
+            # tile instead (materializing [L, pad] fp32 norms for a large
+            # narrow-dtype index is a needless device-memory spike there).
+            need_norms = use_pallas or (
+                fast_scan and index.metric != DistanceType.InnerProduct)
+            v, i = _search_jit(
+                queries, index.centers, index.list_data, index.list_indices,
+                index.list_sizes,
+                filter.words if filter is not None
+                else jnp.zeros((0,), jnp.uint32),
+                index.metric, int(k), n_probes, q_tile, filter is not None,
+                index.ensure_row_norms() if need_norms else None, use_pallas,
+                False, fast_scan, index.overflow_data, index.overflow_indices,
+                has_overflow, float(params.select_recall),
+                refine_multiplier(params.refine_ratio, fast_scan),
+            )
+    if explain:
+        return v[:nq], i[:nq], cap.last
     return v[:nq], i[:nq]
 
 

@@ -33,6 +33,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import functools
@@ -53,6 +54,8 @@ from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.ops.distance import DistanceType, resolve_metric
 from raft_tpu.ops.select_k import select_k, select_k_maybe_approx
 from raft_tpu.neighbors import list_packing
+from raft_tpu.neighbors.brute_force import fused_ineligible_reason
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.ops import rng as rrng
 from raft_tpu.utils.shape import (as_query_array, balanced_tile, cdiv, pad_rows,
                                   query_bucket)
@@ -1400,10 +1403,13 @@ def search(
     params: Optional[SearchParams] = None,
     filter: Optional[Bitset] = None,
     res: Optional[Resources] = None,
-) -> Tuple[jax.Array, jax.Array]:
+    explain: bool = False,
+):
     """Search (reference: ivf_pq::search, ivf_pq-inl.cuh:480). Distances for
     L2 metrics exclude nothing — they are the full ADC approximation; indices
-    are source row ids, -1 where fewer than k candidates were probed."""
+    are source row ids, -1 where fewer than k candidates were probed. With
+    ``explain=True`` a third element carries the
+    :class:`raft_tpu.obs.explain.ExplainRecord` of the dispatch decision."""
     params = params or SearchParams()
     res = ensure_resources(res)
     if index.list_codes is None:
@@ -1430,99 +1436,158 @@ def search(
     # LUT regime additionally needs byte codes (pq_bits=8), PER_SUBSPACE
     # codebooks and fp32 LUT/distance dtypes. Anything else falls through
     # to the XLA engines below — the mode is a performance hint, never a
-    # correctness switch.
+    # correctness switch; each resolution records its reason code.
+    requested = scan_mode
     use_fused = fused_interp = False
+    dreason = "forced"  # explicit "cache"/"lut": honored as asked
     if scan_mode in ("auto", "pallas"):
-        use_fused, fused_interp = pk.fused_dispatch("ivf_pq", scan_mode)
-    use_fused = (use_fused and filter is None and k <= 1024
-                 and index.metric in (DistanceType.L2Expanded,
-                                      DistanceType.L2SqrtExpanded))
-    if use_fused:
-        # the same HBM model that splits cache/lut splits the fused
-        # engines: the decoded cache is the faster scan when it fits
-        engine = resolve_scan_mode(
-            index.n_lists, list_pad, index.rot_dim,
-            index.list_codes.shape[2],
-            jnp.dtype(params.scan_cache_dtype).itemsize,
-            device_memory_bytes=res.device_memory_bytes,
-            workspace_limit_bytes=res.workspace_limit_bytes)
-        if engine == "cache":
-            ensure_scan_cache(index, params.scan_cache_dtype)
-            pad_tile = pk.plan_fused_ivf_tile(
-                list_pad, index.rot_dim, int(k),
-                jnp.dtype(index.list_decoded.dtype).itemsize)
-            v, i = _search_fused_cache_jit(
-                queries, index.centers, index.rotation, index.list_decoded,
-                index.decoded_norms, index.list_indices, index.list_sizes,
-                index.overflow_decoded, index.overflow_norms,
-                index.overflow_indices, index.metric, int(k), n_probes,
-                pad_tile, has_overflow, fused_interp,
-            )
-            return v[:nq], i[:nq]
-        if (not per_cluster and index.pq_bits == 8
-                and jnp.dtype(params.lut_dtype) == jnp.float32
-                and jnp.dtype(params.internal_distance_dtype)
-                == jnp.float32):
-            pad_tile = pk.plan_fused_pq_tile(
-                list_pad, index.pq_dim, 1 << index.pq_bits,
-                index.codebooks.shape[2], int(k))
-            v, i = _search_fused_lut_jit(
-                queries, index.centers, index.rotation, index.codebooks,
-                index.list_codes, index.list_indices, index.list_sizes,
-                index.overflow_decoded, index.overflow_norms,
-                index.overflow_indices, index.metric, int(k), n_probes,
-                pad_tile, has_overflow, fused_interp,
-            )
-            return v[:nq], i[:nq]
-        # fused LUT regime unsupported at these params -> XLA engines
-    if scan_mode in ("auto", "pallas"):
-        scan_mode = resolve_scan_mode(
-            index.n_lists, list_pad, index.rot_dim,
-            index.list_codes.shape[2],
-            jnp.dtype(params.scan_cache_dtype).itemsize,
-            device_memory_bytes=res.device_memory_bytes,
-            workspace_limit_bytes=res.workspace_limit_bytes)
-    if scan_mode == "cache":  # resolve_scan_mode never returns "auto"
-        ensure_scan_cache(index, params.scan_cache_dtype)
-        # workspace: gathered decoded cache [t,P,pad,rot] bf16 + dists
-        q_tile = plan_cache_tiles(n_probes, list_pad, index.rot_dim,
-                                  res.workspace_limit_bytes)
-        v, i = _search_cache_jit(
-            queries, index.centers, index.rotation, index.list_decoded,
-            index.decoded_norms, index.list_indices, index.list_sizes,
-            filter.words if filter is not None else jnp.zeros((0,),
-                                                              jnp.uint32),
-            index.metric, int(k), n_probes, q_tile, filter is not None,
-            # unfused ivf_scan routes only on a measured probe verdict
-            # (PALLAS_PROBE "fused" table); the env flag is retired
-            pk.fused_crossover("ivf_scan"), False,
-            index.overflow_decoded, index.overflow_norms,
-            index.overflow_indices, has_overflow,
-            select_recall=float(params.select_recall),
-        )
-        return v[:nq], i[:nq]
-    # workspace: the TRUE peak live set of the scan body (LUT build +
-    # code gather + unpack/score temporaries — lut_bytes_per_query_probe),
-    # solved jointly into (q_tile, probe_tile) so the engine never
-    # materializes more than the budget however large n·n_probes grow
-    q_tile, probe_tile = plan_lut_tiles(
-        n_probes, list_pad, index.pq_dim, index.pq_bits,
-        res.workspace_limit_bytes,
-        jnp.dtype(params.lut_dtype).itemsize,
-        jnp.dtype(params.internal_distance_dtype).itemsize)
-    v, i = _search_jit(
-        queries, index.centers, index.rotation, index.codebooks,
-        index.list_codes, index.list_indices, index.list_sizes,
-        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
-        index.metric, int(k), n_probes, q_tile, per_cluster,
-        index.pq_dim, index.pq_bits, filter is not None,
-        jnp.dtype(params.lut_dtype).name, jnp.dtype(
-            params.internal_distance_dtype).name,
-        index.overflow_decoded, index.overflow_norms,
-        index.overflow_indices, has_overflow,
-        select_recall=float(params.select_recall),
-        probe_tile=probe_tile,
-    )
+        use_fused, fused_interp, dreason = pk.fused_dispatch_explained(
+            "ivf_pq", scan_mode)
+    ineligible = fused_ineligible_reason(
+        index.metric, index.list_codes.dtype, int(k), filter is not None,
+        False, require_float=False)
+    ex_params = {"k": int(k), "nq": nq, "bucket": queries.shape[0],
+                 "n_probes": n_probes, "n_lists": index.n_lists,
+                 "list_pad": list_pad, "pq_dim": index.pq_dim,
+                 "pq_bits": index.pq_bits, "metric": index.metric.name}
+    lut_unsupported = False
+    with contextlib.ExitStack() as stack:
+        cap = stack.enter_context(obs_explain.capture()) if explain else None
+        v = i = None
+        if use_fused and ineligible is None:
+            # the same HBM model that splits cache/lut splits the fused
+            # engines: the decoded cache is the faster scan when it fits
+            engine = resolve_scan_mode(
+                index.n_lists, list_pad, index.rot_dim,
+                index.list_codes.shape[2],
+                jnp.dtype(params.scan_cache_dtype).itemsize,
+                device_memory_bytes=res.device_memory_bytes,
+                workspace_limit_bytes=res.workspace_limit_bytes)
+            if engine == "cache":
+                ensure_scan_cache(index, params.scan_cache_dtype)
+                pad_tile = pk.plan_fused_ivf_tile(
+                    list_pad, index.rot_dim, int(k),
+                    jnp.dtype(index.list_decoded.dtype).itemsize)
+                obs_explain.record_dispatch(
+                    "ivf_pq", requested, "pallas_cache", dreason,
+                    params=ex_params,
+                    plan={"memory_model": "cache", "pad_tile": pad_tile,
+                          "interpret": fused_interp})
+                v, i = _search_fused_cache_jit(
+                    queries, index.centers, index.rotation,
+                    index.list_decoded, index.decoded_norms,
+                    index.list_indices, index.list_sizes,
+                    index.overflow_decoded, index.overflow_norms,
+                    index.overflow_indices, index.metric, int(k), n_probes,
+                    pad_tile, has_overflow, fused_interp,
+                )
+            elif (not per_cluster and index.pq_bits == 8
+                    and jnp.dtype(params.lut_dtype) == jnp.float32
+                    and jnp.dtype(params.internal_distance_dtype)
+                    == jnp.float32):
+                pad_tile = pk.plan_fused_pq_tile(
+                    list_pad, index.pq_dim, 1 << index.pq_bits,
+                    index.codebooks.shape[2], int(k))
+                obs_explain.record_dispatch(
+                    "ivf_pq", requested, "pallas_lut", dreason,
+                    params=ex_params,
+                    plan={"memory_model": "lut", "pad_tile": pad_tile,
+                          "interpret": fused_interp})
+                v, i = _search_fused_lut_jit(
+                    queries, index.centers, index.rotation, index.codebooks,
+                    index.list_codes, index.list_indices, index.list_sizes,
+                    index.overflow_decoded, index.overflow_norms,
+                    index.overflow_indices, index.metric, int(k), n_probes,
+                    pad_tile, has_overflow, fused_interp,
+                )
+            else:
+                # fused LUT regime unsupported at these params -> XLA engines
+                lut_unsupported = True
+        if v is None:
+            memory_resolved = scan_mode in ("auto", "pallas")
+            if memory_resolved:
+                scan_mode = resolve_scan_mode(
+                    index.n_lists, list_pad, index.rot_dim,
+                    index.list_codes.shape[2],
+                    jnp.dtype(params.scan_cache_dtype).itemsize,
+                    device_memory_bytes=res.device_memory_bytes,
+                    workspace_limit_bytes=res.workspace_limit_bytes)
+            if requested not in ("auto", "pallas"):
+                reason = "forced"
+            elif lut_unsupported:
+                reason = "lut_params_unsupported"
+            elif use_fused and ineligible:
+                reason = ineligible
+            else:
+                reason = dreason
+            if scan_mode == "cache":  # resolve_scan_mode never says "auto"
+                ensure_scan_cache(index, params.scan_cache_dtype)
+                # workspace: gathered decoded cache [t,P,pad,rot] bf16 +
+                # dists
+                q_tile = plan_cache_tiles(n_probes, list_pad, index.rot_dim,
+                                          res.workspace_limit_bytes)
+                obs_explain.record_dispatch(
+                    "ivf_pq", requested, "cache", reason, params=ex_params,
+                    plan={"memory_model": "cache",
+                          "memory_auto": memory_resolved,
+                          "q_tile": q_tile,
+                          "predicted_workspace_bytes": q_tile *
+                          cache_bytes_per_query(n_probes, list_pad,
+                                                index.rot_dim)})
+                v, i = _search_cache_jit(
+                    queries, index.centers, index.rotation,
+                    index.list_decoded, index.decoded_norms,
+                    index.list_indices, index.list_sizes,
+                    filter.words if filter is not None
+                    else jnp.zeros((0,), jnp.uint32),
+                    index.metric, int(k), n_probes, q_tile,
+                    filter is not None,
+                    # unfused ivf_scan routes only on a measured probe
+                    # verdict (PALLAS_PROBE "fused" table); the env flag is
+                    # retired
+                    pk.fused_crossover("ivf_scan"), False,
+                    index.overflow_decoded, index.overflow_norms,
+                    index.overflow_indices, has_overflow,
+                    select_recall=float(params.select_recall),
+                )
+            else:
+                # workspace: the TRUE peak live set of the scan body (LUT
+                # build + code gather + unpack/score temporaries —
+                # lut_bytes_per_query_probe), solved jointly into
+                # (q_tile, probe_tile) so the engine never materializes more
+                # than the budget however large n·n_probes grow
+                q_tile, probe_tile = plan_lut_tiles(
+                    n_probes, list_pad, index.pq_dim, index.pq_bits,
+                    res.workspace_limit_bytes,
+                    jnp.dtype(params.lut_dtype).itemsize,
+                    jnp.dtype(params.internal_distance_dtype).itemsize)
+                obs_explain.record_dispatch(
+                    "ivf_pq", requested, "lut", reason, params=ex_params,
+                    plan={"memory_model": "lut",
+                          "memory_auto": memory_resolved,
+                          "q_tile": q_tile, "probe_tile": probe_tile,
+                          "predicted_workspace_bytes": q_tile * probe_tile *
+                          lut_bytes_per_query_probe(
+                              list_pad, index.pq_dim, index.pq_bits,
+                              jnp.dtype(params.lut_dtype).itemsize,
+                              jnp.dtype(params.internal_distance_dtype)
+                              .itemsize)})
+                v, i = _search_jit(
+                    queries, index.centers, index.rotation, index.codebooks,
+                    index.list_codes, index.list_indices, index.list_sizes,
+                    filter.words if filter is not None
+                    else jnp.zeros((0,), jnp.uint32),
+                    index.metric, int(k), n_probes, q_tile, per_cluster,
+                    index.pq_dim, index.pq_bits, filter is not None,
+                    jnp.dtype(params.lut_dtype).name, jnp.dtype(
+                        params.internal_distance_dtype).name,
+                    index.overflow_decoded, index.overflow_norms,
+                    index.overflow_indices, has_overflow,
+                    select_recall=float(params.select_recall),
+                    probe_tile=probe_tile,
+                )
+    if explain:
+        return v[:nq], i[:nq], cap.last
     return v[:nq], i[:nq]
 
 

@@ -14,7 +14,13 @@ four pieces (docs/observability.md):
 - :mod:`~raft_tpu.obs.diagnostics` — flight-recorder bundles (the span
   tape + registry snapshot + health frozen at a moment of interest);
 - :mod:`~raft_tpu.obs.costs` — compiled-cost roofline reports and the
-  planner calibration audit (imports jax lazily; AOT only).
+  planner calibration audit (imports jax lazily; AOT only);
+- :mod:`~raft_tpu.obs.explain` — per-search execution-plan attribution
+  (ExplainRecord + the ``raft_tpu_dispatch_total`` reason counter);
+- :mod:`~raft_tpu.obs.quality` — shadow sampling and the online recall
+  estimator behind ``raft_tpu_online_recall``;
+- :mod:`~raft_tpu.obs.slo` — declarative SLOs → error-budget burn-rate
+  gauges and the ``/slo`` report.
 
 Layering: obs sits beside ``core`` — serving/parallel/neighbors import
 obs, never the reverse.
@@ -24,10 +30,15 @@ from raft_tpu.obs.device import (compile_count, compile_seconds,
                                  install_compile_metrics, profile_session)
 from raft_tpu.obs.diagnostics import (build_bundle, load_bundle,
                                       write_bundle)
+from raft_tpu.obs.explain import (REASONS, ExplainRecord, capture,
+                                  dispatch_counts, record_dispatch)
 from raft_tpu.obs.httpd import MetricsServer
 from raft_tpu.obs.metrics import (DEFAULT_LATENCY_BUCKETS, REGISTRY, Counter,
                                   Gauge, Histogram, HistogramSnapshot,
                                   Registry, exponential_buckets)
+from raft_tpu.obs.quality import (OnlineRecallEstimator, ShadowSampler,
+                                  overlap_at_k)
+from raft_tpu.obs.slo import SLO, SLOMonitor
 from raft_tpu.obs.spans import (JsonlSink, ListSink, NullSink, RingSink,
                                 new_trace_id, read_jsonl, safe_emit,
                                 timed_span)
@@ -44,6 +55,10 @@ __all__ = [
     # device
     "compile_count", "compile_seconds", "install_compile_metrics",
     "profile_session",
+    # explain / quality / slo
+    "ExplainRecord", "REASONS", "capture", "record_dispatch",
+    "dispatch_counts", "OnlineRecallEstimator", "ShadowSampler",
+    "overlap_at_k", "SLO", "SLOMonitor",
     # exposition
     "MetricsServer",
 ]

@@ -18,6 +18,10 @@ Routes:
   bundle (``bundle_fn``, typically ``Engine.dump_diagnostics`` — the
   span tape + registry snapshot + health + config in one JSON doc);
   404 when no ``bundle_fn`` is wired.
+- ``GET /slo`` → the SLO monitor's burn-rate report (``slo_fn``,
+  typically ``SLOMonitor.report`` — per-SLO burn rates, budget
+  remaining, and fast-burn flags as JSON); 404 when no ``slo_fn`` is
+  wired.
 - anything else → 404.
 """
 
@@ -44,11 +48,13 @@ class MetricsServer:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Optional[_metrics.Registry] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
-                 bundle_fn: Optional[Callable[[], dict]] = None) -> None:
+                 bundle_fn: Optional[Callable[[], dict]] = None,
+                 slo_fn: Optional[Callable[[], dict]] = None) -> None:
         self._registry = registry if registry is not None else \
             _metrics.REGISTRY
         self._health_fn = health_fn
         self._bundle_fn = bundle_fn
+        self._slo_fn = slo_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -98,6 +104,16 @@ class MetricsServer:
                                    json.dumps(doc, sort_keys=True).encode())
                     elif path == "/healthz":
                         self._do_healthz()
+                    elif path == "/slo":
+                        if server._slo_fn is None:
+                            self._send(404, "text/plain",
+                                       b"no SLO monitor wired\n")
+                        else:
+                            doc = server._slo_fn()
+                            self._send(200, "application/json",
+                                       (json.dumps(doc, sort_keys=True,
+                                                   default=str)
+                                        + "\n").encode())
                     elif path == "/debug/bundle":
                         if server._bundle_fn is None:
                             self._send(404, "text/plain",

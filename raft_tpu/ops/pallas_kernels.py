@@ -31,6 +31,7 @@ unconditionally anymore."""
 from __future__ import annotations
 
 import functools
+import logging
 import os
 
 import jax
@@ -189,15 +190,73 @@ def fused_dispatch(family: str, scan_mode: str):
     committed PALLAS_PROBE crossover records a win (``fused_crossover``).
 
     Anything else: never fused."""
+    use_fused, interpret, _ = fused_dispatch_explained(family, scan_mode)
+    return use_fused, interpret
+
+
+def _fused_verdict(family: str):
+    """The raw PALLAS_PROBE verdict for this platform+family: True/False
+    when measured, None when the artifact has no row — the distinction
+    the warn-once satellite hinges on (a measured loss is policy; a
+    missing verdict is the ROADMAP re-probe caveat)."""
+    from raft_tpu.ops.select_k import _platform_key
+
+    v = _load_fused_table().get(_platform_key(), {}).get(family)
+    return None if v is None else bool(v)
+
+
+_warned_no_verdict = False
+
+
+def _reset_fused_warn() -> None:
+    """Test hook: re-arm the once-per-process no-verdict warning."""
+    global _warned_no_verdict
+    _warned_no_verdict = False
+
+
+def _warn_no_verdict_once(family: str) -> None:
+    global _warned_no_verdict
+    if _warned_no_verdict:
+        return
+    _warned_no_verdict = True
+    logging.getLogger(__name__).warning(
+        "scan_mode='auto' is routing %s (and every family) to the XLA "
+        "engines on a TPU host because the loaded PALLAS_PROBE artifact "
+        "has no fused_wins verdicts — the fused Pallas hot path is OFF. "
+        "Run tools/pallas_probe.py on this hardware (tpu_queue2.sh "
+        "pallas2 step) to record verdicts, or force scan_mode='pallas'.",
+        family)
+
+
+def fused_dispatch_explained(family: str, scan_mode: str):
+    """``fused_dispatch`` plus the reason code: ``(use_fused, interpret,
+    reason)`` with reason from ``obs.explain.REASONS`` — the attributed
+    form the family ``search()`` entry points feed into their explain
+    records. Also the emission point for the once-per-process warning
+    when ``auto`` routes XLA on a TPU host only because the committed
+    probe artifact carries no verdict (ROADMAP caveat, now audible)."""
     interp = os.environ.get("RAFT_TPU_PALLAS_INTERPRET") == "1"
     # the axon tunnel registers its backend name as "axon" while the
     # devices report platform "tpu"; accept both (cf. select_k._platform_key)
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if scan_mode == "pallas":
-        return (on_tpu or interp), (interp and not on_tpu)
+        if on_tpu:
+            return True, False, "forced"
+        if interp:
+            return True, True, "interpret"
+        return False, False, "tpu_absent"
     if scan_mode == "auto":
-        return (on_tpu and fused_crossover(family)), False
-    return False, False
+        if not on_tpu:
+            return False, False, "tpu_absent"
+        verdict = _fused_verdict(family)
+        if verdict:
+            return True, False, "auto_fused_wins"
+        if verdict is None:
+            _warn_no_verdict_once(family)
+            return False, False, "no_fused_wins_verdict"
+        return False, False, "fused_loses"
+    # an explicit engine name ("xla", "cache", "lut"): honored as asked
+    return False, False, "forced"
 
 
 def fused_l2_argmin(x, y, x_norms=None, y_norms=None, tm: int = 256,

@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.utils.shape import cdiv
 
 
@@ -428,6 +429,11 @@ def select_k(
     # key, so installing/dropping TOPK_PAD rules retraces fresh calls
     k_pad = _pad_k(values.shape[-1], int(k)) if pad_rules and algo in (
         SelectAlgo.DIRECT, SelectAlgo.SCREEN) else 0
+    # capture-only explain note: this body runs at TRACE time inside the
+    # jitted search cores (once per compiled shape, not per call), so it
+    # attaches the resolved algo/pad to the active explain capture but
+    # never touches the per-call dispatch counter (obs/explain.py)
+    obs_explain.note_select_k(values.shape[-1], int(k), algo.name, k_pad)
     out_v, out_i = _select_k_jit(values, int(k), bool(select_min), algo,
                                  float(recall_target), k_pad)
     if indices is not None:
@@ -437,6 +443,19 @@ def select_k(
                                         jnp.maximum(out_i, 0), axis=1)
         out_i = jnp.where(out_i < 0, -1, relabeled)
     return out_v, out_i
+
+
+def select_k_plan(n: int, k: int, floating: bool = True,
+                  pad_rules: bool = True) -> dict:
+    """The resolution ``select_k`` would make for a [*, n] float/int row at
+    this k, WITHOUT running it: ``{"algo", "k_pad"}`` from the measured
+    AUTO table and TOPK_PAD rules. The dry-run surface ``tools/explain.py``
+    prints so an operator can see the selection plan of a query shape
+    before paying a compile."""
+    algo = _resolve_auto(int(n), int(k), bool(floating))
+    k_pad = _pad_k(int(n), int(k)) if pad_rules and algo in (
+        SelectAlgo.DIRECT, SelectAlgo.SCREEN) else 0
+    return {"algo": algo.name, "k_pad": int(k_pad)}
 
 
 def select_k_maybe_approx(values, k: int, select_min: bool,

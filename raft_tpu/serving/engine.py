@@ -87,6 +87,9 @@ import numpy as np
 
 from raft_tpu.obs import device as obs_device
 from raft_tpu.obs import diagnostics as obs_diagnostics
+from raft_tpu.obs import explain as obs_explain
+from raft_tpu.obs import quality as obs_quality
+from raft_tpu.obs import slo as obs_slo
 from raft_tpu.obs import spans as obs_spans
 from raft_tpu.obs.httpd import MetricsServer
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
@@ -248,6 +251,20 @@ class EngineConfig:
     process-global metrics registry (tests); ``deadline_budget_ms`` is
     the autoscale pressure denominator — the per-request latency budget
     the deployment promises (None derives 10x the flush deadline).
+
+    Quality & SLO knobs (docs/observability.md "Online recall" and
+    "SLOs"): ``shadow_oracle`` is a ``(queries, k) -> (dist, idx)``
+    callable (typically a brute-force exact sibling of the serving
+    index) that grades a ``shadow_sample_rate`` fraction of completed
+    batches on a background thread — off the hot path, deadline-capped
+    at ``shadow_deadline_ms``, shed (and counted) behind a
+    ``shadow_queue_limit``-deep queue. Results land in the
+    ``raft_tpu_online_recall`` gauges and ``kind="shadow_eval"`` spans.
+    ``slos`` is a tuple of :class:`raft_tpu.obs.SLO` objectives
+    evaluated over ``slo_window_s`` windows into burn-rate gauges and
+    the ``/slo`` endpoint; a fast-burn crossing auto-dumps the flight
+    recorder (reason ``slo_fast_burn``, same rate limit as the other
+    auto-dumps).
     """
 
     max_batch: int = 64
@@ -285,6 +302,14 @@ class EngineConfig:
     flight_recorder_capacity: int = 512
     diagnostics_dir: Optional[str] = None
     diagnostics_min_interval_s: float = 30.0
+    # ---- online quality (shadow sampling) + SLOs
+    shadow_oracle: Optional[object] = None  # (queries, k) -> (d, i)
+    shadow_sample_rate: float = 0.0  # fraction of batches graded
+    shadow_deadline_ms: float = 250.0
+    shadow_queue_limit: int = 64
+    shadow_seed: int = 0  # deterministic sampling draws (tests)
+    slos: Optional[Tuple[object, ...]] = None  # obs.SLO objectives
+    slo_window_s: float = 300.0
 
 
 def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -390,6 +415,26 @@ class Engine:
             "Requests admitted but not yet launched.",
             ("engine",)).labels(label).set_function(
                 lambda: float(len(self.batcher)))
+        # ---- online quality + SLOs (docs/observability.md)
+        self.shadow: Optional[obs_quality.ShadowSampler] = None
+        if cfg.shadow_oracle is not None and cfg.shadow_sample_rate > 0:
+            self.shadow = obs_quality.ShadowSampler(
+                cfg.shadow_oracle, cfg.shadow_sample_rate,
+                deadline_ms=cfg.shadow_deadline_ms,
+                queue_limit=cfg.shadow_queue_limit,
+                seed=cfg.shadow_seed,
+                record_event=self.stats.record_shadow,
+                span_sink=self._span_sink, engine_label=label,
+                registry=reg)
+        self.slo_monitor: Optional[obs_slo.SLOMonitor] = None
+        if cfg.slos:
+            self.slo_monitor = obs_slo.SLOMonitor(
+                cfg.slos, label, registry=reg,
+                # _auto_dump is already rate-limited, so a flapping
+                # burn can't spam bundles even across SLOs
+                on_fast_burn=lambda name, burn: self._auto_dump(
+                    "slo_fast_burn"),
+                window_s=cfg.slo_window_s)
 
     @property
     def searcher(self) -> Searcher:
@@ -472,7 +517,10 @@ class Engine:
                 port, host, registry=self.stats.registry,
                 health_fn=self.health,
                 bundle_fn=lambda: self.dump_diagnostics(
-                    reason="http")).start()
+                    reason="http"),
+                slo_fn=(self.slo_monitor.report
+                        if self.slo_monitor is not None
+                        else None)).start()
         return self.metrics_server
 
     def __enter__(self) -> "Engine":
@@ -641,6 +689,8 @@ class Engine:
         self._watchdog_stop.set()
         if self._watchdog_thread is not None:
             self._watchdog_thread.join(timeout)
+        if self.shadow is not None:
+            self.shadow.close()
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
@@ -1024,7 +1074,13 @@ class Engine:
             meta["pad_copy_ms"] = round((self.clock() - t_pad0) * 1e3, 3)
             call = self._begin_device_call(live, "dispatch", meta)
             try:
-                d, i = searcher.search(batch, live[0].k)
+                # execution-plan attribution: every family search
+                # records its dispatch decision into the open capture;
+                # briefs ride batch meta into every rider's span record
+                with obs_explain.capture() as cap:
+                    d, i = searcher.search(batch, live[0].k)
+                if cap.records:
+                    meta["explain"] = cap.briefs()
             finally:
                 hung = self._end_device_call(call)
         except BaseException as e:  # noqa: B036 — relay to callers
@@ -1086,6 +1142,16 @@ class Engine:
                     r.future.set_result((d_np[j], i_np[j]))
                     resolved += 1
                     self._emit_request_outcome(r, "ok", **meta)
+            if self.shadow is not None and resolved:
+                # the answers just served, offered for grading AFTER the
+                # futures resolved — a slow/hung oracle can never delay
+                # a caller, only fill the shadow queue (typed sheds)
+                self.shadow.offer(
+                    [r.query for r in b.requests],
+                    [i_np[j] for j in range(len(b.requests))],
+                    [r.trace_id for r in b.requests],
+                    [r.k for r in b.requests],
+                    b.searcher.family, b.bucket)
             self.breaker.on_batch_result(
                 True, b.meta.get("breaker_epoch") if b.meta else None)
             self.stats.record_batch(

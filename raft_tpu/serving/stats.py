@@ -66,6 +66,12 @@ def percentiles(samples: Sequence[float],
 _REQUEST_EVENTS = ("submitted", "completed", "cancelled", "shed_deadline",
                    "rejected_overload", "rejected_breaker", "failed")
 
+#: shadow-sampling accounting (shadow_total's ``event`` vocabulary) —
+#: mirrors obs.quality.SHADOW_EVENTS; sampled = evaluated + shed_queue +
+#: shed_deadline + error + still-queued at every instant
+_SHADOW_EVENTS = ("sampled", "evaluated", "shed_queue", "shed_deadline",
+                  "error")
+
 
 class ServingStats:
     """Counters + latency histograms for one :class:`Engine`, stored on a
@@ -122,6 +128,13 @@ class ServingStats:
         self._by_bucket = r.counter(
             "raft_tpu_serving_batches_by_bucket_total",
             "Completed batches by padded shape bucket.", ("engine", "bucket"))
+        shadow = r.counter(
+            "raft_tpu_serving_shadow_total",
+            "Shadow recall-sampling accounting by typed event.",
+            ("engine", "event"))
+        # pre-touched like requests_total: a scrape shows sheds at 0, and
+        # the span<->counter reconciliation can enumerate the vocabulary
+        self._shadow = {ev: shadow.labels(e, ev) for ev in _SHADOW_EVENTS}
         self._coverage = r.gauge(
             "raft_tpu_serving_coverage",
             "Current searcher shard coverage (1.0 = full index).",
@@ -200,21 +213,36 @@ class ServingStats:
     def coverage(self) -> float:
         return float(self._coverage.value)
 
+    def _engine_children(self, family):
+        """This engine's children of a shared registry family, with the
+        leading ``engine`` label stripped: ``[(rest-of-labels, child)]``.
+        Works for ANY label arity as long as ``engine`` is first — the
+        single filtering path batch/bucket/shadow views all ride, so a
+        family growing labels can't silently break one view (the PR 6
+        ``k[0] == engine`` + ``int(k[1])`` pattern was copy-pasted per
+        property and assumed exactly two labels)."""
+        return [(k[1:], c) for k, c in family.collect()
+                if k and k[0] == self.engine_label]
+
     @property
     def batch_size_hist(self) -> Dict[int, int]:
         # the registry family is shared process-wide; keep only THIS
         # engine's children (labels are (engine, size))
-        return {int(k[1]): int(c.value)
-                for k, c in sorted(self._by_size.collect(),
-                                   key=lambda kv: int(kv[0][1]))
-                if k[0] == self.engine_label}
+        return {int(rest[0]): int(c.value)
+                for rest, c in sorted(self._engine_children(self._by_size),
+                                      key=lambda kv: int(kv[0][0]))}
 
     @property
     def bucket_hist(self) -> Dict[int, int]:
-        return {int(k[1]): int(c.value)
-                for k, c in sorted(self._by_bucket.collect(),
-                                   key=lambda kv: int(kv[0][1]))
-                if k[0] == self.engine_label}
+        return {int(rest[0]): int(c.value)
+                for rest, c in sorted(self._engine_children(self._by_bucket),
+                                      key=lambda kv: int(kv[0][0]))}
+
+    @property
+    def shadow_counts(self) -> Dict[str, int]:
+        """This engine's shadow accounting ``{event: count}`` — all five
+        events always present (pre-touched)."""
+        return {ev: int(child.value) for ev, child in self._shadow.items()}
 
     # ---------------------------------------------------------- recording
     def record_submit(self, n: int = 1) -> None:
@@ -243,6 +271,11 @@ class ServingStats:
 
     def record_breaker_trip(self) -> None:
         self._breaker_trips.inc()
+
+    def record_shadow(self, event: str, n: int = 1) -> None:
+        """Shadow-sampling accounting (the ``record_event`` callable an
+        Engine hands its :class:`~raft_tpu.obs.quality.ShadowSampler`)."""
+        self._shadow[event].inc(n)
 
     def record_swap(self, old_coverage: float, new_coverage: float) -> None:
         self._swaps.inc()
@@ -301,7 +334,17 @@ class ServingStats:
             "coverage": self.coverage,
             "batch_size_hist": self.batch_size_hist,
             "bucket_hist": self.bucket_hist,
+            "shadow": self.shadow_counts,
         }
+        # dispatch attribution rides the snapshot too; the counter is
+        # process-global (families dispatch below the serving layer, so
+        # there is no serving-engine label to filter on) — the view names
+        # that scope explicitly
+        dispatch = self.registry.get("raft_tpu_dispatch_total")
+        if dispatch is not None:
+            snap["dispatch_reasons"] = {
+                "/".join(key): int(c.value)
+                for key, c in dispatch.collect() if int(c.value)}
         with self._lock:
             snap["coverage_transitions"] = list(self.coverage_transitions)
         if snap["n_batches"]:

@@ -1,0 +1,21 @@
+"""R007 fixture: dispatch decision with no execution-plan attribution
+(analysed under modname ``raft_tpu.neighbors.r007_bad``)."""
+
+import jax.numpy as jnp
+
+from raft_tpu.ops import pallas_kernels as pk
+
+
+def silently_falls_back(queries, k, scan_mode="auto"):
+    # flagged: consults fused_dispatch, then the losing branch runs with
+    # no record_dispatch anywhere in the function — the exact silent
+    # XLA fallback the explain layer exists to make visible
+    use_fused, interpret = pk.fused_dispatch("brute_force", scan_mode)
+    if use_fused:
+        return jnp.zeros((queries.shape[0], k))
+    return jnp.ones((queries.shape[0], k))
+
+
+def _helper_without_dispatch(queries, k):
+    # not flagged: no dispatch decision here
+    return jnp.zeros((queries.shape[0], k))

@@ -1,0 +1,32 @@
+"""R007 clean twin: every dispatch decision lands in a reason-coded
+ExplainRecord (analysed under modname ``raft_tpu.neighbors.r007_clean``)."""
+
+import jax.numpy as jnp
+
+from raft_tpu.obs import explain as obs_explain
+from raft_tpu.ops import pallas_kernels as pk
+
+
+def attributed_dispatch(queries, k, scan_mode="auto"):
+    # clean: both resolved branches record an attribution
+    use_fused, interpret, reason = pk.fused_dispatch_explained(
+        "brute_force", scan_mode)
+    if use_fused:
+        obs_explain.record_dispatch("brute_force", scan_mode, "pallas",
+                                    reason, params={"k": k})
+        return jnp.zeros((queries.shape[0], k))
+    obs_explain.record_dispatch("brute_force", scan_mode, "xla", reason,
+                                params={"k": k})
+    return jnp.ones((queries.shape[0], k))
+
+
+def attributed_in_closure(queries, k, scan_mode="auto"):
+    # clean: the dispatch lives in a nested def; attribution anywhere in
+    # the top-level function body satisfies the rule
+    def _core(q):
+        use_fused, _ = pk.fused_dispatch("brute_force", scan_mode)
+        return jnp.zeros((q.shape[0], k)) if use_fused else \
+            jnp.ones((q.shape[0], k))
+
+    obs_explain.record_dispatch("brute_force", scan_mode, "xla", "forced")
+    return _core(queries)

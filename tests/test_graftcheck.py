@@ -13,6 +13,7 @@ from raft_tpu.analysis import (AST_RULES, ModuleInfo, check_layering,
                                split_by_baseline)
 from raft_tpu.analysis.rules_ast import (rule_host_sync, rule_recompile_hazard,
                                          rule_traced_branch,
+                                         rule_unattributed_dispatch,
                                          rule_unguarded_broadcast,
                                          rule_untraced_entry_point)
 
@@ -98,6 +99,68 @@ def test_r006_repo_entry_points_are_all_traced():
                          f"raft_tpu.neighbors.{fn[:-3]}")
         findings.extend(rule_untraced_entry_point(mod))
     assert findings == [], [f.format() for f in findings]
+
+
+def test_r007_flags_unattributed_dispatch_in_scope():
+    # R007 is scoped to raft_tpu.neighbors/raft_tpu.ops modules
+    found = rule_unattributed_dispatch(
+        _mod("r007_bad.py", "raft_tpu.neighbors.r007_bad"))
+    assert [(f.rule, f.qualname) for f in found] == [
+        ("R007", "silently_falls_back")]
+    assert "record_dispatch" in found[0].message
+    assert rule_unattributed_dispatch(
+        _mod("r007_clean.py", "raft_tpu.neighbors.r007_clean")) == []
+
+
+def test_r007_ignores_out_of_scope_and_exempt_modules():
+    # the same silent fallback is fine outside neighbors/ops, and the
+    # module defining the dispatch helpers is not a dispatch site
+    for modname in ("raft_tpu.fixture_pkg_b.r007_bad",
+                    "raft_tpu.ops.pallas_kernels",
+                    "tools.r007_bad"):
+        assert rule_unattributed_dispatch(
+            _mod("r007_bad.py", modname)) == []
+
+
+def test_r007_suppression_on_dispatch_line(tmp_path):
+    src = open(os.path.join(FIXDIR, "r007_bad.py")).read()
+    src = src.replace(
+        'pk.fused_dispatch("brute_force", scan_mode)',
+        'pk.fused_dispatch("brute_force", scan_mode)  # graftcheck: R007')
+    p = tmp_path / "r007_suppressed.py"
+    p.write_text(src)
+    mod = ModuleInfo(str(p), "r007_suppressed.py",
+                     "raft_tpu.neighbors.r007_suppressed")
+    assert rule_unattributed_dispatch(mod) == []
+
+
+def test_r007_repo_dispatch_sites_are_all_attributed():
+    # the live neighbors/ops packages must satisfy R007 with zero
+    # baseline entries — and the rule must actually SEE the dispatch
+    # sites (a resolver regression would pass vacuously otherwise)
+    import ast as _ast
+
+    import raft_tpu.neighbors as npkg
+    import raft_tpu.ops as opkg
+    from raft_tpu.analysis.rules_ast import DISPATCH_CALLS
+    findings, seen_dispatch = [], 0
+    for pkg, prefix in ((npkg, "raft_tpu.neighbors"),
+                        (opkg, "raft_tpu.ops")):
+        pkg_dir = os.path.dirname(pkg.__file__)
+        for fn in sorted(os.listdir(pkg_dir)):
+            if not fn.endswith(".py"):
+                continue
+            mod = ModuleInfo(os.path.join(pkg_dir, fn),
+                             f"{prefix.replace('.', '/')}/{fn}",
+                             f"{prefix}.{fn[:-3]}")
+            findings.extend(rule_unattributed_dispatch(mod))
+            if mod.modname not in (f"{prefix}.pallas_kernels",):
+                seen_dispatch += sum(
+                    1 for n in _ast.walk(mod.tree)
+                    if isinstance(n, _ast.Call)
+                    and mod.resolve(n.func) in DISPATCH_CALLS)
+    assert findings == [], [f.format() for f in findings]
+    assert seen_dispatch >= 3  # brute_force + ivf_flat + ivf_pq
 
 
 def test_layering_flags_cross_package_private_import():

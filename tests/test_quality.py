@@ -1,0 +1,391 @@
+"""Online recall estimation (docs/observability.md "Online recall").
+
+The contract under test: shadow sampling grades a seeded, per-batch
+fraction of completed batches off the hot path; every shed is typed and
+counted (never silent); ``kind="shadow_eval"`` spans reconcile 1:1 with
+the ``raft_tpu_serving_shadow_total`` accounting and carry the ORIGINAL
+request's trace id; and the invariant ``sampled == evaluated + sheds +
+error`` holds after drain — including under the chaos injectors.
+"""
+
+import collections
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import metrics as obm
+from raft_tpu.obs.quality import (OnlineRecallEstimator, ShadowSampler,
+                                  overlap_at_k)
+from raft_tpu.obs.spans import ListSink
+from raft_tpu.serving.stats import ServingStats
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+# ------------------------------------------------------------ overlap@k
+
+def test_overlap_at_k_scoring():
+    assert overlap_at_k([1, 2, 3], [3, 2, 1]) == 1.0
+    assert overlap_at_k([1, 2, 3], [4, 5, 6]) == 0.0
+    # served -1 padding is a recall LOSS: numerator drops it, the
+    # denominator stays the oracle's full set
+    assert overlap_at_k([1, 2, -1], [1, 2, 3]) == pytest.approx(2 / 3)
+    # oracle padding shrinks the denominator (fewer true candidates)
+    assert overlap_at_k([1, 9, 9], [1, -1, -1]) == 1.0
+    # degenerate oracle: nothing to recall -> vacuous 1.0
+    assert overlap_at_k([1, 2], [-1, -1]) == 1.0
+
+
+# ------------------------------------------------------------ estimator
+
+def test_estimator_windowed_mean_and_gauge():
+    reg = obm.Registry()
+    est = OnlineRecallEstimator(registry=reg, window=4)
+    for r in (0.0, 0.0, 1.0, 1.0, 1.0, 1.0):  # window keeps the last 4
+        est.observe("ivf_flat", K, 8, r)
+    est.observe("ivf_pq", 10, 16, 0.5)
+    assert est.snapshot() == {("ivf_flat", K, 8): (4, 1.0),
+                              ("ivf_pq", 10, 16): (1, 0.5)}
+    gauge = {k: c.value
+             for k, c in reg.get("raft_tpu_online_recall").collect()}
+    assert gauge[("ivf_flat", str(K), "8")] == 1.0
+    assert gauge[("ivf_pq", "10", "16")] == 0.5
+
+
+# ----------------------------------------------------- sampler unit tests
+
+def _events():
+    """(record_event, Counter) pair for sampler accounting."""
+    tally = collections.Counter()
+
+    def record(event, n):
+        tally[event] += n
+
+    return record, tally
+
+
+def _exact_oracle(served):
+    """Oracle that agrees with the served ids -> recall 1.0."""
+    def oracle(queries, k):
+        n = np.asarray(queries).shape[0]
+        return np.zeros((n, k)), np.tile(np.asarray(served)[:k], (n, 1))
+    return oracle
+
+
+def _offer_one(sampler, trace_id="t0", ids=(1, 2, 3, 4, 5)):
+    q = np.zeros((1, DIM), np.float32)
+    return sampler.offer(q, [np.array(ids)], [trace_id], [K],
+                         "ivf_flat", 8)
+
+
+def test_sampler_rate_bounds_and_determinism():
+    with pytest.raises(ValueError, match="rate"):
+        ShadowSampler(_exact_oracle(range(K)), rate=1.5)
+    # the per-batch coin is seeded: same seed + same offer sequence
+    # -> identical sampling decisions
+    decisions = []
+    for _ in range(2):
+        s = ShadowSampler(_exact_oracle(range(K)), rate=0.5, seed=7)
+        decisions.append([_offer_one(s) for _ in range(32)])
+        s.close()
+    assert decisions[0] == decisions[1]
+    assert any(decisions[0]) and not all(decisions[0])
+
+
+def test_sampler_grades_and_spans_carry_trace_id():
+    record, tally = _events()
+    sink = ListSink()
+    reg = obm.Registry()
+    s = ShadowSampler(_exact_oracle((1, 2, 3, 4, 5)), rate=1.0,
+                      record_event=record, span_sink=sink,
+                      engine_label="e0", registry=reg)
+    assert _offer_one(s, trace_id="trace-a") is True
+    s.close()
+    assert tally == {"sampled": 1, "evaluated": 1}
+    assert s.estimator.snapshot() == {("ivf_flat", K, 8): (1, 1.0)}
+    (span,) = sink.records
+    assert span["kind"] == "shadow_eval"
+    assert span["trace_id"] == "trace-a"  # the ORIGINAL request's id
+    assert span["outcome"] == "ok" and span["recall"] == 1.0
+    assert span["engine"] == "e0" and span["bucket"] == 8
+
+
+def test_sampler_rate_zero_and_closed_never_sample():
+    record, tally = _events()
+    s = ShadowSampler(_exact_oracle(range(K)), rate=0.0,
+                      record_event=record)
+    assert _offer_one(s) is False
+    s.close()
+    assert _offer_one(s) is False  # closed sampler declines, no counts
+    assert not tally
+
+
+def test_sampler_sheds_on_full_queue():
+    record, tally = _events()
+    sink = ListSink()
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_oracle(queries, k):
+        entered.set()
+        release.wait(10)
+        n = np.asarray(queries).shape[0]
+        return np.zeros((n, k)), np.tile(np.arange(k), (n, 1))
+
+    s = ShadowSampler(slow_oracle, rate=1.0, queue_limit=1,
+                      record_event=record, span_sink=sink,
+                      registry=obm.Registry())
+    _offer_one(s, "t-worker")           # dequeued, wedges the worker
+    assert entered.wait(10)
+    _offer_one(s, "t-queued")           # occupies the single queue slot
+    _offer_one(s, "t-shed")             # full queue: typed shed, hot path
+    assert tally["shed_queue"] == 1     # counted synchronously
+    release.set()
+    s.close()
+    assert tally == {"sampled": 3, "evaluated": 2, "shed_queue": 1}
+    by_outcome = {r["outcome"]: r["trace_id"] for r in sink.records}
+    assert by_outcome["shed_queue"] == "t-shed"
+
+
+def test_sampler_sheds_stale_items_at_deadline():
+    record, tally = _events()
+    t = [0.0]
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_oracle(queries, k):
+        if not entered.is_set():
+            entered.set()
+            release.wait(10)
+        n = np.asarray(queries).shape[0]
+        return np.zeros((n, k)), np.tile(np.arange(k), (n, 1))
+
+    s = ShadowSampler(slow_oracle, rate=1.0, deadline_ms=250.0,
+                      record_event=record, registry=obm.Registry(),
+                      clock=lambda: t[0])
+    _offer_one(s, "t-worker")   # wedges the worker behind `release`
+    assert entered.wait(10)
+    _offer_one(s, "t-stale")    # queued at t=0
+    t[0] = 1.0                  # 1000 ms later: past the 250 ms deadline
+    release.set()
+    s.close()
+    assert tally == {"sampled": 2, "evaluated": 1, "shed_deadline": 1}
+
+
+def test_sampler_counts_oracle_errors():
+    record, tally = _events()
+    sink = ListSink()
+
+    def bad_oracle(queries, k):
+        raise RuntimeError("oracle down")
+
+    s = ShadowSampler(bad_oracle, rate=1.0, record_event=record,
+                      span_sink=sink, registry=obm.Registry())
+    _offer_one(s, "t-err")
+    s.close()  # drains: the error is graded before the sentinel lands
+    assert tally == {"sampled": 1, "error": 1}
+    (span,) = sink.records
+    assert span["outcome"] == "error" and "recall" not in span
+
+
+# ----------------------------------------------- engine integration/chaos
+
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16)), db
+
+
+@pytest.fixture()
+def searcher(flat_index):
+    idx, _ = flat_index
+    return serving.ivf_flat_searcher(idx,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _np_oracle(db):
+    db = np.asarray(db, np.float32)
+    db_sq = (db * db).sum(axis=1)
+
+    def oracle(qs, k):
+        qs = np.asarray(qs, np.float32)
+        d = db_sq[None, :] - 2.0 * (qs @ db.T)
+        idx = np.argpartition(d, kth=k - 1, axis=1)[:, :k]
+        return np.take_along_axis(d, idx, axis=1), idx
+
+    return oracle
+
+
+def _engine(s, db, sink=None, **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_us", 5000)
+    kw.setdefault("warm_ks", (K,))
+    kw.setdefault("span_sink", sink)
+    kw.setdefault("shadow_oracle", _np_oracle(db))
+    kw.setdefault("shadow_sample_rate", 1.0)
+    kw.setdefault("shadow_deadline_ms", 30_000.0)
+    return serving.Engine(s, serving.EngineConfig(**kw))
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def _reconcile_shadow(sink, stats):
+    """The chaos-suite invariant: after drain, sampled == evaluated +
+    sheds + error, and shadow_eval spans match the accounting 1:1."""
+    sc = stats.shadow_counts
+    assert sc["sampled"] == (sc["evaluated"] + sc["shed_queue"]
+                             + sc["shed_deadline"] + sc["error"]), sc
+    spans = [r for r in sink.records if r["kind"] == "shadow_eval"]
+    tally = collections.Counter(r["outcome"] for r in spans)
+    assert tally.get("ok", 0) == sc["evaluated"], (dict(tally), sc)
+    assert tally.get("shed_queue", 0) == sc["shed_queue"]
+    assert tally.get("shed_deadline", 0) == sc["shed_deadline"]
+    assert tally.get("error", 0) == sc["error"]
+    return spans, sc
+
+
+def test_engine_shadow_spans_reconcile_with_counters(searcher, flat_index):
+    _, db = flat_index
+    rng = np.random.default_rng(0)
+    sink = ListSink()
+    with _engine(searcher, db, sink, hang_timeout_s=None) as eng:
+        futs = [eng.submit(_q(rng), K) for _ in range(12)]
+        trace_ids = {f.trace_id for f in futs}
+        for f in futs:
+            f.result(timeout=60)
+        eng.drain(60)
+    # stop() closed the sampler: the queue is fully drained
+    spans, sc = _reconcile_shadow(sink, eng.stats)
+    assert sc["sampled"] == 12  # rate 1.0: every completed request
+    # every graded span joins back to a real request's trace id
+    assert {s["trace_id"] for s in spans} == trace_ids
+    # exact oracle vs n_probes=8 serving: recall lands in the gauge
+    (key, (n, mean)), = eng.shadow.estimator.snapshot().items()
+    assert key[0] == "ivf_flat" and key[1] == K and n == 12
+    assert 0.0 <= mean <= 1.0
+
+
+def test_engine_shadow_skips_failed_batches(searcher, flat_index):
+    _, db = flat_index
+    rng = np.random.default_rng(1)
+    sink = ListSink()
+    with _engine(searcher, db, sink, hang_timeout_s=None) as eng:
+        faults.fail_next_dispatch(searcher)
+        bad = eng.submit(_q(rng), K)
+        with pytest.raises(serving.BatchFailed):
+            bad.result(timeout=60)
+        oks = [eng.submit(_q(rng), K) for _ in range(6)]
+        for f in oks:
+            f.result(timeout=60)
+        eng.drain(60)
+    spans, sc = _reconcile_shadow(sink, eng.stats)
+    # only COMPLETED batches are offered: the failed request is never
+    # sampled and never graded
+    assert sc["sampled"] == 6
+    assert bad.trace_id not in {s["trace_id"] for s in spans}
+
+
+def test_engine_shadow_invariant_holds_after_hang(searcher, flat_index):
+    _, db = flat_index
+    rng = np.random.default_rng(2)
+    sink = ListSink()
+    with _engine(searcher, db, sink, hang_timeout_s=1.0,
+                 breaker_cooldown_s=0.05) as eng:
+        faults.hang_next_dispatch(searcher, hang_s=3.0)
+        with pytest.raises(serving.BatchFailed):
+            eng.submit(_q(rng), K).result(timeout=60)
+        # the engine recovers (breaker half-open probe) and later
+        # completions still get sampled and graded
+        deadline = 20.0
+        ok = 0
+        t0 = time.monotonic()
+        while ok < 4 and time.monotonic() - t0 < deadline:
+            try:
+                eng.submit(_q(rng), K).result(timeout=60)
+                ok += 1
+            except (serving.Overloaded, serving.BatchFailed):
+                time.sleep(0.01)
+        assert ok == 4
+        eng.drain(60)
+    _, sc = _reconcile_shadow(sink, eng.stats)
+    assert sc["sampled"] == 4  # the hung batch never reached the sampler
+
+
+def test_batch_spans_carry_explain_briefs_reconciling_with_counter(
+        searcher, flat_index):
+    """Acceptance: dispatch_total reason labels reconcile 1:1 with the
+    request spans' explain breadcrumbs — every served batch carries its
+    briefs, their histogram equals the counter delta, and a failed
+    dispatch contributes neither (it never reached a family search)."""
+    from raft_tpu.obs import explain as obs_explain
+
+    _, db = flat_index
+    rng = np.random.default_rng(5)
+    sink = ListSink()
+    with _engine(searcher, db, sink, hang_timeout_s=None,
+                 shadow_sample_rate=0.0) as eng:
+        # baseline AFTER start(): warm-up searches dispatch too, but
+        # outside any batch, so they must not skew the reconciliation
+        before = obs_explain.dispatch_counts()
+        faults.fail_next_dispatch(searcher)
+        with pytest.raises(serving.BatchFailed):
+            eng.submit(_q(rng), K).result(timeout=60)
+        for _ in range(9):
+            eng.search(_q(rng), K)
+        eng.drain(60)
+    after = obs_explain.dispatch_counts()
+
+    batches = sink.by_kind("batch")
+    ok = [b for b in batches if b["outcome"] == "ok"]
+    failed = [b for b in batches if b["outcome"] != "ok"]
+    assert failed and all("explain" not in b for b in failed)
+    briefs = [e for b in ok for e in b["explain"]]
+    assert len(briefs) == len(ok)  # one dispatch per served batch
+    tally = collections.Counter(
+        (e["family"], e["engine"], e["reason"]) for e in briefs)
+    delta = {k: after[k] - before.get(k, 0)
+             for k in after if after[k] != before.get(k, 0)}
+    assert delta == dict(tally)
+    assert all(k[2] != "unknown" for k in delta)
+
+
+# -------------------------------------------- ServingStats label hygiene
+
+def test_stats_views_isolate_engines_on_a_shared_registry():
+    """Two engines sharing one registry must not bleed into each
+    other's by-size / by-bucket / shadow views (the PR 6 two-label
+    assumption this PR's ``_engine_children`` helper replaced)."""
+    reg = obm.Registry()
+    a = ServingStats(registry=reg, engine_label="eng-a")
+    b = ServingStats(registry=reg, engine_label="eng-b")
+    a.record_batch(3, 8, [0.0] * 3, 0.01, [0.01] * 3)
+    a.record_batch(1, 8, [0.0], 0.01, [0.01])
+    b.record_batch(5, 16, [0.0] * 5, 0.01, [0.01] * 5)
+    a.record_shadow("sampled", 4)
+    a.record_shadow("evaluated", 3)
+    a.record_shadow("shed_queue", 1)
+    b.record_shadow("sampled", 1)
+
+    assert a.batch_size_hist == {1: 1, 3: 1}
+    assert b.batch_size_hist == {5: 1}
+    assert a.bucket_hist == {8: 2}
+    assert b.bucket_hist == {16: 1}
+    assert a.shadow_counts == {"sampled": 4, "evaluated": 3,
+                               "shed_queue": 1, "shed_deadline": 0,
+                               "error": 0}
+    assert b.shadow_counts["sampled"] == 1
+    assert b.shadow_counts["shed_queue"] == 0
+
+    # the snapshot carries the shadow block, and it is per-engine too
+    snap = a.snapshot()
+    assert snap["shadow"]["sampled"] == 4
+    assert snap["batch_size_hist"] == {1: 1, 3: 1}

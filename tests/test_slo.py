@@ -1,0 +1,261 @@
+"""SLO burn-rate monitoring (docs/observability.md "SLO catalog").
+
+The contract under test: burn math per kind (availability, latency_p99,
+recall_floor) against a live registry, window re-baselining, fast-burn
+firing exactly once per excursion, engine label isolation, the ``/slo``
+endpoint on a running engine's MetricsServer, and the fast-burn →
+flight-recorder auto-dump wiring.
+"""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs import metrics as obm
+from raft_tpu.obs.quality import OnlineRecallEstimator
+from raft_tpu.obs.slo import SLO, SLOMonitor
+from raft_tpu.serving.stats import ServingStats
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+# ----------------------------------------------------------- declaration
+
+def test_slo_declaration_validates():
+    with pytest.raises(ValueError, match="kind"):
+        SLO("x", "latency_p50", 0.99, threshold_ms=10.0)
+    with pytest.raises(ValueError, match="objective"):
+        SLO("x", "availability", 1.0)
+    with pytest.raises(ValueError, match="threshold_ms"):
+        SLO("x", "latency_p99", 0.99)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOMonitor([SLO("a", "availability", 0.999),
+                    SLO("a", "availability", 0.99)], "e",
+                   registry=obm.Registry())
+
+
+# ------------------------------------------------------------- burn math
+
+def _stats_and_monitor(slos, clock=None, window_s=300.0):
+    reg = obm.Registry()
+    st = ServingStats(registry=reg, engine_label="eng-a")
+    mon = SLOMonitor(slos, "eng-a", registry=reg, window_s=window_s,
+                     clock=clock or (lambda: 0.0))
+    return reg, st, mon
+
+
+def _complete(st, n, total_s=0.001):
+    st.record_batch(n, 8, [0.0] * n, total_s, [total_s] * n)
+
+
+def test_availability_burn_is_windowed_error_rate_over_budget():
+    slo = SLO("avail", "availability", 0.999)
+    reg, st, mon = _stats_and_monitor([slo])
+    assert mon.burn_rate(slo) == 0.0  # no traffic: no alert on silence
+    _complete(st, 90)
+    st.record_batch_failed(10)
+    # 10% bad over a 0.1% budget -> burning 100x
+    assert mon.burn_rate(slo) == pytest.approx(100.0)
+    # cancelled is a client verdict, not a serving failure
+    st.record_cancelled(50)
+    assert mon.burn_rate(slo) == pytest.approx(100.0)
+    # another engine's failures on the SAME registry do not count
+    other = ServingStats(registry=reg, engine_label="eng-b")
+    other.record_batch_failed(500)
+    assert mon.burn_rate(slo) == pytest.approx(100.0)
+
+
+def test_availability_counts_sheds_and_rejections_as_bad():
+    slo = SLO("avail", "availability", 0.99)
+    _, st, mon = _stats_and_monitor([slo])
+    _complete(st, 96)
+    st.record_shed_deadline(2)
+    st.record_rejected("overload")
+    st.record_rejected("breaker")
+    # 4 bad / 100 total over a 1% budget -> 4x
+    assert mon.burn_rate(slo) == pytest.approx(4.0)
+
+
+def test_latency_burn_from_histogram_tail():
+    fast = SLO("lat", "latency_p99", 0.99, threshold_ms=60_000.0)
+    _, st, mon = _stats_and_monitor([fast])
+    _complete(st, 50, total_s=0.05)
+    assert mon.burn_rate(fast) == 0.0  # nothing near a 60 s threshold
+
+    slow = SLO("lat", "latency_p99", 0.99, threshold_ms=0.1)
+    _, st, mon = _stats_and_monitor([slow])
+    _complete(st, 50, total_s=0.05)  # every request far over 0.1 ms
+    # ~100% over-threshold against a 1% allowance -> ~100x burn
+    assert mon.burn_rate(slow) == pytest.approx(100.0, rel=0.05)
+
+
+def test_recall_floor_burn_tracks_worst_window():
+    slo = SLO("recall", "recall_floor", 0.95)
+    reg, _, mon = _stats_and_monitor([slo])
+    assert mon.burn_rate(slo) == 0.0  # no shadow samples yet: silence
+    est = OnlineRecallEstimator(registry=reg)
+    est.observe("ivf_flat", K, 8, 1.0)
+    assert mon.burn_rate(slo) == 0.0
+    est.observe("ivf_pq", K, 8, 0.8)  # the worst window drives the burn
+    assert mon.burn_rate(slo) == pytest.approx((1 - 0.8) / 0.05)
+
+
+def test_window_roll_rebaselines_counters():
+    t = [0.0]
+    slo = SLO("avail", "availability", 0.999)
+    _, st, mon = _stats_and_monitor([slo], clock=lambda: t[0],
+                                    window_s=300.0)
+    _complete(st, 90)
+    st.record_batch_failed(10)
+    assert mon.burn_rate(slo) == pytest.approx(100.0)
+    t[0] = 301.0  # window expires: the old failures age out
+    assert mon.burn_rate(slo) == 0.0
+    st.record_batch_failed(1)  # fresh window, fresh budget
+    _complete(st, 99)
+    assert mon.burn_rate(slo) == pytest.approx(10.0)
+
+
+def test_fast_burn_fires_once_per_excursion():
+    t = [0.0]
+    fired = []
+    slo = SLO("avail", "availability", 0.999, fast_burn=14.0)
+    reg = obm.Registry()
+    st = ServingStats(registry=reg, engine_label="eng-a")
+    mon = SLOMonitor([slo], "eng-a", registry=reg, window_s=300.0,
+                     clock=lambda: t[0],
+                     on_fast_burn=lambda name, burn: fired.append(
+                         (name, burn)))
+    _complete(st, 90)
+    st.record_batch_failed(10)
+    for _ in range(5):  # scrapes repeat; the dump must not
+        mon.burn_rate(slo)
+    assert len(fired) == 1
+    assert fired[0][0] == "avail" and fired[0][1] >= 14.0
+    t[0] = 301.0
+    assert mon.burn_rate(slo) == 0.0  # burn drops: excursion re-arms
+    st.record_batch_failed(10)
+    _complete(st, 90)
+    mon.burn_rate(slo)
+    assert len(fired) == 2
+
+
+def test_burn_gauges_export_on_the_registry():
+    slo = SLO("avail", "availability", 0.999)
+    reg, st, mon = _stats_and_monitor([slo])
+    _complete(st, 99)
+    st.record_batch_failed(1)
+    burn = {k: c.value
+            for k, c in reg.get("raft_tpu_slo_burn_rate").collect()}
+    budget = {k: c.value
+              for k, c in reg.get("raft_tpu_slo_budget_remaining").collect()}
+    assert burn[("eng-a", "avail")] == pytest.approx(10.0)
+    assert budget[("eng-a", "avail")] == 0.0
+
+
+# ------------------------------------------------- engine + /slo endpoint
+
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+
+
+@pytest.fixture()
+def searcher(flat_index):
+    return serving.ivf_flat_searcher(flat_index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def test_slo_endpoint_404_without_monitor(searcher):
+    cfg = serving.EngineConfig(max_batch=8, warm_ks=(K,), metrics_port=0)
+    with serving.Engine(searcher, cfg) as eng:
+        assert _get(eng.metrics_server.url + "/slo")[0] == 404
+
+
+def test_slo_endpoint_and_fast_burn_auto_dump(searcher):
+    rng = np.random.default_rng(4)
+    # an oracle that never agrees with the served answer: recall 0.0,
+    # so the recall_floor SLO burns at (1-0)/(1-0.95) = 20x >= 14
+    def hostile_oracle(qs, k):
+        n = np.asarray(qs).shape[0]
+        return np.zeros((n, k)), np.full((n, k), 1499, np.int64)
+
+    cfg = serving.EngineConfig(
+        max_batch=8, max_wait_us=5000, warm_ks=(K,), metrics_port=0,
+        hang_timeout_s=None,
+        registry=obm.Registry(),  # isolate the recall gauge family
+        shadow_oracle=hostile_oracle, shadow_sample_rate=1.0,
+        shadow_deadline_ms=30_000.0,
+        slos=(SLO("recall", "recall_floor", 0.95),
+              SLO("avail", "availability", 0.999)))
+    with serving.Engine(searcher, cfg) as eng:
+        for _ in range(8):
+            eng.search(_q(rng), K)
+        eng.drain(60)
+        # wait for the shadow worker to grade at least one sample
+        eng.shadow.close()
+        url = eng.metrics_server.url
+        code, body = _get(url + "/slo")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["engine"] == eng.stats.engine_label
+        rows = {r["name"]: r for r in doc["slos"]}
+        assert rows["avail"]["burn_rate"] == 0.0
+        assert rows["avail"]["budget_remaining"] == 1.0
+        recall = rows["recall"]
+        assert recall["worst_recall"] == 0.0
+        assert recall["burn_rate"] == pytest.approx(20.0)
+        assert recall["fast_burn"] is True
+        # the crossing froze a flight-recorder bundle, exactly once
+        assert eng.last_diagnostics is not None
+        assert eng.last_diagnostics["reason"] == "slo_fast_burn"
+        n_dumps = eng.stats.registry.get(
+            "raft_tpu_serving_diagnostics_dumps_total")
+        dumps = {k: c.value for k, c in n_dumps.collect()}
+        assert dumps[(eng.stats.engine_label, "slo_fast_burn")] == 1.0
+        _get(url + "/slo")  # still burning: no second dump (one excursion)
+        dumps = {k: c.value for k, c in n_dumps.collect()}
+        assert dumps[(eng.stats.engine_label, "slo_fast_burn")] == 1.0
+        # the burn gauges ride the normal scrape too
+        code, text = _get(url + "/metrics")
+        assert code == 200
+        e = eng.stats.engine_label
+        assert f'raft_tpu_slo_burn_rate{{engine="{e}",slo="recall"}}' \
+            in text
+
+
+def test_recall_floor_burn_is_nan_safe(searcher):
+    # an engine with a recall SLO but shadow sampling OFF must report
+    # burn 0 (never alert on silence), not NaN-poison the scrape
+    cfg = serving.EngineConfig(
+        max_batch=8, warm_ks=(K,), metrics_port=0,
+        registry=obm.Registry(),  # other tests' recall windows must not
+        slos=(SLO("recall", "recall_floor", 0.95),))  # bleed in here
+    with serving.Engine(searcher, cfg) as eng:
+        code, body = _get(eng.metrics_server.url + "/slo")
+        assert code == 200
+        (row,) = json.loads(body)["slos"]
+        assert row["burn_rate"] == 0.0 and "worst_recall" not in row
+        assert not math.isnan(row["burn_rate"])

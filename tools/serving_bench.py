@@ -29,9 +29,13 @@ trace id, phase decomposition, and typed outcome; ``--spans ''``
 disables). After each family the span file is read back and reconciled
 against the engines' counters — ok spans must equal completed requests.
 For the first family the bench also measures the cost of that
-instrumentation: best-of-N closed-loop QPS with the span sink on vs off,
-asserted < 2% apart (``--no-overhead-check`` skips the gate,
-``--overhead-tolerance`` moves it).
+instrumentation: best-of-N closed-loop QPS with the full telemetry stack
+on (span sink + shadow sampling) vs off, asserted < 2% apart
+(``--no-overhead-check`` skips the gate, ``--overhead-tolerance`` moves
+it). A ``--shadow-sample`` arm (default 5%) re-runs the closed loop with
+online recall estimation against a brute-force oracle and gates the
+online estimate within ``--shadow-tolerance`` (default ±0.02) of the
+offline ground-truth recall for ivf_flat and ivf_pq.
 
 Artifact: SERVING_cpu.json / SERVING_tpu.json (name follows the measured
 platform unless --out is given).
@@ -258,17 +262,23 @@ class _TaggedSink:
 
 
 def bench_telemetry_overhead(searcher, cfg_kwargs, queries, k, submitters,
-                             reps, tmpdir):
-    """Best-of-``reps`` closed-loop QPS with the span sink writing JSONL
-    vs telemetry-silent, arms alternated per rep so thermal/load drift
-    hits both equally. The registry counters stay on in both arms (they
-    are not optional); the measured delta is the span-emission path."""
+                             reps, tmpdir, shadow_oracle=None,
+                             shadow_rate=0.0):
+    """Best-of-``reps`` closed-loop QPS with the full telemetry stack on
+    (span sink writing JSONL + shadow sampling at ``shadow_rate``) vs
+    telemetry-silent, arms alternated per rep so thermal/load drift hits
+    both equally. The registry counters and the per-search explain
+    attribution stay on in both arms (they are not optional); the
+    measured delta is the span-emission + shadow-sampling hot-path
+    cost — the oracle itself runs on the shadow worker thread, and what
+    this gate bounds is what that background work steals from serving."""
     from raft_tpu import serving
     from raft_tpu.obs import spans as obs_spans
 
-    def one_run(sink):
+    def one_run(sink, rate):
         eng = serving.Engine(searcher, serving.EngineConfig(
-            span_sink=sink, **cfg_kwargs))
+            span_sink=sink, shadow_oracle=shadow_oracle if rate else None,
+            shadow_sample_rate=rate, **cfg_kwargs))
         eng.start()
         try:
             summary, _, _, _ = bench_closed_loop(eng, queries, k,
@@ -277,18 +287,88 @@ def bench_telemetry_overhead(searcher, cfg_kwargs, queries, k, submitters,
             eng.stop()
         return summary["qps"]
 
+    rate = shadow_rate if shadow_oracle is not None else 0.0
     qps = {"plain": 0.0, "telemetry": 0.0}
     for rep in range(reps):
-        qps["plain"] = max(qps["plain"], one_run(None))
+        qps["plain"] = max(qps["plain"], one_run(None, 0.0))
         path = os.path.join(tmpdir, f"overhead_{rep}.jsonl")
         with obs_spans.JsonlSink(path) as sink:
-            qps["telemetry"] = max(qps["telemetry"], one_run(sink))
+            qps["telemetry"] = max(qps["telemetry"], one_run(sink, rate))
     overhead = 1.0 - qps["telemetry"] / qps["plain"]
     return {
         "reps": reps,
+        "shadow_rate": rate,
         "qps_plain": qps["plain"],
         "qps_telemetry": qps["telemetry"],
         "overhead": round(overhead, 4),
+    }
+
+
+def make_exact_oracle(db):
+    """Exact sqeuclidean top-k oracle for the shadow worker — pure
+    numpy on purpose. A jitted oracle (e.g. ``brute_force.knn``) would
+    recompile per distinct batch shape on the worker thread and compete
+    with serving for the same dispatch path, so the overhead gate would
+    measure XLA compile storms instead of the telemetry plumbing it
+    claims to bound. Production oracles that do run on-device should pad
+    to a fixed query shape for the same reason (docs/observability.md)."""
+    db = np.asarray(db, np.float32)
+    db_sq = (db * db).sum(axis=1)
+
+    def oracle(qs, k):
+        qs = np.asarray(qs, np.float32)
+        # |q|^2 is constant per row: rank-equivalent, skip it
+        d = db_sq[None, :] - 2.0 * (qs @ db.T)
+        idx = np.argpartition(d, kth=k - 1, axis=1)[:, :k]
+        top = np.take_along_axis(d, idx, axis=1)
+        order = np.argsort(top, axis=1, kind="stable")
+        return (np.take_along_axis(top, order, axis=1),
+                np.take_along_axis(idx, order, axis=1))
+
+    return oracle
+
+
+def bench_shadow_recall(searcher, cfg_kwargs, queries, k, submitters,
+                        rate, oracle, gt, passes=3):
+    """Closed loop with shadow sampling on: the engine grades ``rate``
+    of its completed batches against the exact ``oracle`` on the shadow
+    worker, and this returns the online estimate next to the offline
+    ground-truth recall of everything actually served. ``passes``
+    repeats the query set so a 5% sample still lands enough batches for
+    the windowed mean to settle. The shed counters ride along: a shed-
+    heavy row means the estimate is biased toward calm periods (see
+    docs/observability.md) and the deadline/queue knobs need air."""
+    from raft_tpu import serving
+    from raft_tpu.stats import neighborhood_recall
+
+    eng = serving.Engine(searcher, serving.EngineConfig(
+        shadow_oracle=oracle, shadow_sample_rate=rate,
+        # bench grading is offline-quality analysis, not SLO freshness:
+        # give the oracle air so sheds reflect pressure, not the gap
+        # between serving QPS and a CPU oracle
+        shadow_deadline_ms=30_000.0, shadow_queue_limit=256,
+        **cfg_kwargs))
+    eng.start()
+    try:
+        tiled = np.concatenate([queries] * passes)
+        closed, idx, _, _ = bench_closed_loop(eng, tiled, k, submitters)
+    finally:
+        eng.stop()  # closes the sampler: queued samples drain first
+    est = eng.shadow.estimator.snapshot()
+    n_total = sum(n for n, _ in est.values())
+    online = (sum(n * mean for n, mean in est.values()) / n_total
+              if n_total else None)
+    offline = float(neighborhood_recall(idx, np.concatenate([gt] * passes)))
+    return {
+        "rate": rate,
+        "passes": passes,
+        "qps": closed["qps"],
+        "samples": n_total,
+        "online_recall": round(online, 4) if online is not None else None,
+        "offline_recall": round(offline, 4),
+        "delta": (round(abs(online - offline), 4)
+                  if online is not None else None),
+        "shadow": eng.stats.shadow_counts,
     }
 
 
@@ -332,6 +412,15 @@ def main():
     ap.add_argument("--no-overhead-check", action="store_true",
                     help="skip the telemetry overhead measurement + gate "
                          "(noisy shared machines)")
+    ap.add_argument("--shadow-sample", type=float, default=0.05,
+                    help="shadow sampling rate for the online-recall arm "
+                         "(0 disables the arm)")
+    ap.add_argument("--shadow-passes", type=int, default=3,
+                    help="closed-loop passes over the query set in the "
+                         "shadow arm (more passes -> more graded samples)")
+    ap.add_argument("--shadow-tolerance", type=float, default=0.02,
+                    help="max |online - offline| recall gap gated for "
+                         "ivf_flat / ivf_pq")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -495,13 +584,36 @@ def main():
             print(f"  spans: {len(reqs)} request records reconciled, "
                   f"outcomes={outcomes}", flush=True)
 
+        if args.shadow_sample > 0:
+            oracle = make_exact_oracle(db)
+            sh = bench_shadow_recall(
+                searcher, cfg_kwargs, queries, args.k, args.submitters,
+                args.shadow_sample, oracle, gt,
+                passes=args.shadow_passes)
+            row["shadow_recall"] = sh
+            print(f"  shadow arm @{sh['rate']}: online recall "
+                  f"{sh['online_recall']} vs offline "
+                  f"{sh['offline_recall']} (delta {sh['delta']}, "
+                  f"{sh['samples']} samples, shed="
+                  f"{sh['shadow']['shed_queue'] + sh['shadow']['shed_deadline']})",
+                  flush=True)
+            if family in ("ivf_flat", "ivf_pq") and sh["delta"] is not None:
+                assert sh["delta"] <= args.shadow_tolerance, (
+                    f"online recall estimate off by {sh['delta']} "
+                    f"(> {args.shadow_tolerance}) for {family}: the "
+                    "shadow estimator disagrees with the offline oracle")
+
         if fi == 0 and not args.no_overhead_check:
             import tempfile
 
+            oracle = make_exact_oracle(db)
             with tempfile.TemporaryDirectory() as td:
                 oh = bench_telemetry_overhead(
                     searcher, cfg_kwargs, queries, args.k,
-                    args.submitters, args.overhead_reps, td)
+                    args.submitters, args.overhead_reps, td,
+                    shadow_oracle=(oracle if args.shadow_sample > 0
+                                   else None),
+                    shadow_rate=args.shadow_sample)
             row["telemetry_overhead"] = oh
             print(f"  telemetry overhead: {oh['overhead'] * 100:.2f}% "
                   f"(plain {oh['qps_plain']} qps vs spans-on "

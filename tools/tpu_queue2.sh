@@ -95,6 +95,14 @@ run_step pallasgate /tmp/q5_pallasgate.done timeout 600 \
   python tools/bench_gate.py --allow-missing \
   --json /tmp/q_pallasgate_verdicts.json \
   /tmp/q_pallas_baseline.json PALLAS_PROBE_tpu.json
+# dispatch attribution histogram on the real chip, right after the
+# fused-verdict gate: one explained query per family, recording which
+# engine each auto dispatch resolved to and WHY (the reason vocabulary,
+# docs/observability.md). A `no_fused_wins_verdict` row here means the
+# pallas2 step above didn't land its verdicts — the warn-once log and
+# this artifact are how that silent-XLA regression gets caught on TPU.
+run_step explainhist /tmp/q5_explainhist.done timeout 1200 \
+  python tools/explain.py --family all --n 100000 --out EXPLAIN_tpu.json
 run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
 # micro-batching serving engine: closed-loop QPS vs the sequential-b1
