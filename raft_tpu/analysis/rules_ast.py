@@ -430,15 +430,17 @@ def rule_untraced_entry_point(mod: ModuleInfo) -> list:
 DISPATCH_CALLS = frozenset({
     "raft_tpu.ops.pallas_kernels.fused_dispatch",
     "raft_tpu.ops.pallas_kernels.fused_dispatch_explained",
+    "raft_tpu.parallel.sharded.plan_sharded_search",
 })
 #: attribution emitters that satisfy R007 — each produces a reason-coded
 #: ExplainRecord / dispatch-counter increment (or the select_k note)
 ATTRIBUTION_CALLS = frozenset({
     "raft_tpu.obs.explain.record_dispatch",
     "raft_tpu.obs.explain.note_select_k",
+    "raft_tpu.parallel.sharded._record_plan",
 })
 #: packages whose dispatch sites must be attributed
-R007_SCOPES = ("raft_tpu.neighbors.", "raft_tpu.ops.")
+R007_SCOPES = ("raft_tpu.neighbors.", "raft_tpu.ops.", "raft_tpu.parallel.")
 #: the module that DEFINES the dispatch helpers is not a dispatch site
 R007_EXEMPT = frozenset({"raft_tpu.ops.pallas_kernels"})
 
@@ -446,8 +448,10 @@ R007_EXEMPT = frozenset({"raft_tpu.ops.pallas_kernels"})
 def rule_unattributed_dispatch(mod: ModuleInfo) -> list:
     """R007: dispatch decision without execution-plan attribution.
 
-    A function in ``raft_tpu.neighbors``/``raft_tpu.ops`` that consults
-    ``fused_dispatch``/``fused_dispatch_explained`` is choosing between
+    A function in ``raft_tpu.neighbors``/``raft_tpu.ops``/
+    ``raft_tpu.parallel`` that consults ``fused_dispatch``/
+    ``fused_dispatch_explained`` (or ``plan_sharded_search`` for the
+    cross-chip merge schedule) is choosing between
     engines — and historically the losing branch fell back *silently*
     (the scan_mode="auto" XLA fallback that motivated the explain layer,
     docs/observability.md). Such a function must also call
@@ -470,6 +474,10 @@ def rule_unattributed_dispatch(mod: ModuleInfo) -> list:
             if not isinstance(node, ast.Call):
                 continue
             dotted = mod.resolve(node.func)
+            if dotted and "." not in dotted:
+                # bare call to a module-local helper (plan_sharded_search
+                # and _record_plan live beside their call sites)
+                dotted = f"{mod.modname}.{dotted}"
             if dotted in DISPATCH_CALLS:
                 dispatch_nodes.append(node)
             elif dotted in ATTRIBUTION_CALLS:

@@ -255,6 +255,43 @@ def solve_vmem_tiles(
     return outer_multiple, inner_multiple
 
 
+def solve_merge_bytes(size: int, nq: int, kk: int, k_out: int,
+                      val_bytes: int = 4, idx_bytes: int = 4,
+                      pos_bytes: int = 4) -> dict:
+    """Predicted per-device cross-chip RECEIVE bytes for each sharded
+    top-k merge engine (parallel/sharded.py merge_mode) — the planner side
+    of the roofline calibration obs/costs.py checks against the compiled
+    HLO's collective shapes.
+
+    - ``allgather``: every device materializes the full [nq, size·kk]
+      value+id slab; (size-1)/size of it arrives over ICI.
+    - ``tree``: log₂(size) hypercube rounds; round r receives a
+      min(k_out, kk·2^r)-wide (value, pos, id) carry from the partner.
+    - ``ring``: size-1 neighbor hops of the fixed [nq, kk] (value, pos,
+      id) block — more total bytes than the tree, but a constant-shape
+      transfer the RDMA kernel overlaps with local compute.
+    """
+    size, nq, kk, k_out = int(size), int(nq), int(kk), int(k_out)
+    pair = val_bytes + idx_bytes
+    triple = pair + pos_bytes
+    out = {
+        "allgather": (size - 1) * nq * kk * pair,
+        "ring": (size - 1) * nq * kk * triple,
+    }
+    tree = 0
+    width, step = kk, 1
+    while step < size:
+        tree += nq * width * triple
+        width = min(k_out, 2 * width)
+        step *= 2
+    # non-power-of-two meshes never take the tree path (dispatch falls
+    # back to allgather); report the allgather cost so the prediction
+    # matches what would compile
+    out["tree"] = tree if size >= 2 and (size & (size - 1)) == 0 \
+        else out["allgather"]
+    return out
+
+
 _default_resources: Optional[Resources] = None
 _default_lock = threading.Lock()
 

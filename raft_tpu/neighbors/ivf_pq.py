@@ -593,9 +593,15 @@ def build(
     dataset,
     params: Optional[IndexParams] = None,
     res: Optional[Resources] = None,
+    coarse_centers=None,
 ) -> Index:
     """Build the index (reference: ivf_pq::build, ivf_pq-inl.cuh:273 →
-    detail/ivf_pq_build.cuh:1732)."""
+    detail/ivf_pq_build.cuh:1732).
+
+    ``coarse_centers`` skips the coarse k-means and trains rotation +
+    codebooks against the given ``[n_lists, dim]`` centers — the pod-scale
+    build path (parallel/sharded.build_ivf_pq_from_file_pod) trains ONE
+    mesh-wide quantizer and injects it into every shard's build."""
     params = params or IndexParams()
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
@@ -621,8 +627,15 @@ def build(
     # coarse quantizer
     km = KMeansBalancedParams(n_iters=params.kmeans_n_iters,
                               metric=params.metric)
-    centers = kmeans_balanced.fit(res.next_key(), trainset, params.n_lists,
-                                  km, res=res)
+    if coarse_centers is not None:
+        centers = jnp.asarray(coarse_centers, jnp.float32)
+        if centers.shape != (params.n_lists, dim):
+            raise ValueError(
+                f"coarse_centers shape {tuple(centers.shape)} != "
+                f"(n_lists={params.n_lists}, dim={dim})")
+    else:
+        centers = kmeans_balanced.fit(res.next_key(), trainset,
+                                      params.n_lists, km, res=res)
 
     rotation = make_rotation_matrix(res.next_key(), rot_dim, dim,
                                     params.force_random_rotation)

@@ -178,13 +178,19 @@ def build_ivf_pq_from_file(path: str, params=None,
                            res: Optional[Resources] = None,
                            batch_rows: int = 1 << 18, dtype=None,
                            max_train_rows: Optional[int] = None,
-                           row_range=None):
+                           row_range=None, trained_index=None):
     """Streamed IVF-PQ build from an fbin file → ivf_pq.Index.
 
     Training (coarse centers, rotation, codebooks) runs on a row sample via
     the in-memory ``ivf_pq.build``; the full dataset is then encoded batch
     by batch into the final packed-code storage (the streaming analog of
     process_and_fill_codes, detail/ivf_pq_build.cuh:1185-1351).
+
+    ``trained_index`` (a dataless ``ivf_pq.Index`` holding centers,
+    rotation, codebooks) skips training entirely and only runs the encode
+    passes — the sharded-PQ-encode leg of the pod-scale build, where one
+    mesh-wide quantizer is shared by every shard (so ``n_lists`` may
+    exceed this span's rows; unused lists stay empty).
     """
     from raft_tpu.neighbors import ivf_pq
 
@@ -194,20 +200,28 @@ def build_ivf_pq_from_file(path: str, params=None,
     lo, hi = (0, total) if row_range is None else row_range
     lo, hi = int(lo), int(min(hi, total))
     n = hi - lo
-    if params.n_lists > n:
-        raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
-
-    n_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
-    if max_train_rows is not None:
-        n_train = min(n_train, int(max_train_rows))
-    trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
-                                     batch_rows=batch_rows,
-                                     row_range=(lo, hi))
-    train_params = dataclasses.replace(params, kmeans_trainset_fraction=1.0,
-                                       add_data_on_build=False)
-    index = ivf_pq.build(np.asarray(trainset, np.float32), train_params,
-                         res=res)
-    del trainset
+    if trained_index is not None:
+        if trained_index.n_lists != params.n_lists:
+            raise ValueError(
+                f"trained_index has n_lists={trained_index.n_lists}, "
+                f"params ask for {params.n_lists}")
+        index = trained_index
+    else:
+        if params.n_lists > n:
+            raise ValueError(f"n_lists={params.n_lists} > n_rows={n}")
+        n_train = max(int(n * params.kmeans_trainset_fraction),
+                      params.n_lists)
+        if max_train_rows is not None:
+            n_train = min(n_train, int(max_train_rows))
+        trainset = sample_rows_from_file(path, n_train, seed=0, dtype=dtype,
+                                         batch_rows=batch_rows,
+                                         row_range=(lo, hi))
+        train_params = dataclasses.replace(params,
+                                           kmeans_trainset_fraction=1.0,
+                                           add_data_on_build=False)
+        index = ivf_pq.build(np.asarray(trainset, np.float32), train_params,
+                             res=res)
+        del trainset
 
     labels = _labels_pass(path, index.centers, params.metric, batch_rows,
                           dtype, res, row_range=(lo, hi))

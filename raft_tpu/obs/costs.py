@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import time
 from typing import Optional
 
@@ -95,6 +96,11 @@ class EntryCost:
     planner: Optional[str] = None
     predicted_bytes: Optional[int] = None
     tiles: dict = dataclasses.field(default_factory=dict)
+    # cross-chip accounting (sharded merge entries): per-device RECEIVE
+    # bytes parsed from the compiled HLO's collective result shapes vs
+    # the planner prediction (core.resources.solve_merge_bytes)
+    collective_bytes: Optional[int] = None
+    predicted_collective_bytes: Optional[int] = None
     # roofline placement (None off-TPU / when cost analysis is partial)
     arithmetic_intensity: Optional[float] = None
     bound: Optional[str] = None  # "memory" | "compute"
@@ -109,9 +115,19 @@ class EntryCost:
             return None
         return self.predicted_bytes / self.temp_bytes
 
+    @property
+    def collective_drift_ratio(self) -> Optional[float]:
+        """predicted / compiled per-device collective receive bytes —
+        the C001 calibration check applied to the cross-chip merge."""
+        if self.predicted_collective_bytes is None or \
+                not self.collective_bytes:
+            return None
+        return self.predicted_collective_bytes / self.collective_bytes
+
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["drift_ratio"] = self.drift_ratio
+        d["collective_drift_ratio"] = self.collective_drift_ratio
         return d
 
 
@@ -149,6 +165,12 @@ def compile_entry(name: str, make_core, backend: Optional[str] = None
     argb = getattr(mem, "argument_size_in_bytes", None)
     outb = getattr(mem, "output_size_in_bytes", None)
 
+    coll = None
+    if meta.get("collective"):
+        txt = _quiet(lambda: compiled.as_text())
+        if txt:
+            coll = collective_bytes_from_hlo(txt, jax.device_count())
+
     return EntryCost(
         name=name, family=meta.get("family", "unknown"),
         flops=float(flops) if flops is not None else None,
@@ -159,7 +181,9 @@ def compile_entry(name: str, make_core, backend: Optional[str] = None
         compile_s=compile_s,
         planner=meta.get("planner"),
         predicted_bytes=meta.get("predicted_bytes"),
-        tiles=dict(meta.get("tiles", {})))
+        tiles=dict(meta.get("tiles", {})),
+        collective_bytes=coll,
+        predicted_collective_bytes=meta.get("predicted_collective_bytes"))
 
 
 def _quiet(fn):
@@ -167,6 +191,104 @@ def _quiet(fn):
         return fn()
     except Exception:
         return None
+
+
+# bytes-per-element for HLO shape strings (pred is byte-packed in HLO)
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+#: definition lines of cross-chip data movers: `%x = <shape> <op>(...)`.
+#: -start/-done async splits are matched on the start half only (the done
+#: half's result aliases the start's buffer).
+_COLLECTIVE_DEF = re.compile(
+    r"=\s*(?:\(\s*)?(\w+)\[([\d,]*)\][^ ]*\s+"
+    r"(all-gather|collective-permute|all-to-all)(?:-start)?\(")
+
+
+def collective_bytes_from_hlo(hlo_text: str, n_devices: int) -> int:
+    """Per-device cross-chip RECEIVE bytes of a compiled module, from the
+    result shapes of its collective ops — the compiled side of the
+    ``solve_merge_bytes`` calibration.
+
+    - ``all-gather``: the [.., S·w] result is (S-1)/S remote — every
+      device contributes its own slice locally.
+    - ``collective-permute`` / ``all-to-all``: the whole result arrives
+      from peers (a permute's payload never stays put in the merge
+      schedules this repo compiles).
+    """
+    total = 0.0
+    for m in _COLLECTIVE_DEF.finditer(hlo_text):
+        dt, dims, op = m.group(1), m.group(2), m.group(3)
+        if dt not in _HLO_DTYPE_BYTES:
+            continue
+        size = _HLO_DTYPE_BYTES[dt]
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        if op == "all-gather":
+            size *= (n_devices - 1) / max(n_devices, 1)
+        total += size
+    return int(total)
+
+
+def make_sharded_merge_core(mode: str, nq: int = 1024, kk: int = 100,
+                            k: int = 100):
+    """``(core, args, meta)`` factory compiling ONE cross-chip merge
+    engine (parallel/sharded.py merge_mode) under shard_map on the
+    current mesh — sift-1M candidate shapes by default. The planner side
+    is ``solve_merge_bytes``; the compiled side is
+    :func:`collective_bytes_from_hlo` over the lowered module."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from raft_tpu.core.resources import solve_merge_bytes
+    from raft_tpu.ops.select_k import select_k
+    from raft_tpu.parallel.comms import init_comms
+
+    comms = init_comms(jax.devices(), axis="data")
+    size = comms.size
+    k_out = min(k, size * kk)
+
+    def body(v, i):
+        if mode == "allgather":
+            va = comms.allgather(v, axis=1)
+            ia = comms.allgather(i, axis=1)
+            vm, sel = select_k(va, k_out, select_min=True)
+            import jax.numpy as jnp
+            return vm, jnp.take_along_axis(ia, sel, axis=1)
+        if mode == "tree":
+            return comms.tree_topk_merge(v, i, k_out)
+        return comms.ring_topk_merge(v, i, k_out)
+
+    core = comms.run(body, (P("data", None), P("data", None)),
+                     (P(None, None), P(None, None)))
+    args = (jax.ShapeDtypeStruct((size * nq, kk), np.float32),
+            jax.ShapeDtypeStruct((size * nq, kk), np.int32))
+    meta = {
+        "family": "sharded_merge",
+        "planner": "solve_merge_bytes",
+        "collective": True,
+        "predicted_collective_bytes":
+            solve_merge_bytes(size, nq, kk, k_out)[mode],
+        "tiles": {"size": size, "nq": nq, "kk": kk, "k_out": k_out},
+    }
+    return core, args, meta
+
+
+def sharded_merge_entries(nq: int = 1024, kk: int = 100, k: int = 100
+                          ) -> list:
+    """``(name, make_core)`` pairs for the three merge engines at sift-1M
+    shapes — appended to the report on hosts with a multi-device mesh."""
+    import functools
+
+    return [(f"sharded_merge_{mode}@s8",
+             functools.partial(make_sharded_merge_core, mode, nq, kk, k))
+            for mode in ("allgather", "tree", "ring")]
 
 
 def apply_roofline(entry: EntryCost, peaks: Optional[ChipPeaks]) -> None:
@@ -193,13 +315,21 @@ def apply_roofline(entry: EntryCost, peaks: Optional[ChipPeaks]) -> None:
 def default_cost_entries(budget_bytes: Optional[int] = None) -> list:
     """``(name, make_core)`` pairs for the cost report: the seven audit
     cores (identical shapes to graftcheck --jaxpr-audit) plus cagra, so
-    the report covers all four ANN families."""
+    the report covers all four ANN families — and, on a multi-device
+    host with a power-of-two mesh (TPU pod slice or the CI-forced
+    8-device CPU mesh), the three sharded cross-chip merge engines."""
+    import jax
+
     from raft_tpu.analysis import jaxpr_audit as ja
 
     b = budget_bytes if budget_bytes is not None else ja.DEFAULT_BUDGET_BYTES
-    return ja.canonical_cores(b) + [
+    out = ja.canonical_cores(b) + [
         ("cagra.search@1m", lambda: ja.make_cagra_core(b)),
     ]
+    nd = jax.device_count()
+    if nd >= 2 and (nd & (nd - 1)) == 0:
+        out += sharded_merge_entries()
+    return out
 
 
 @dataclasses.dataclass
@@ -222,17 +352,24 @@ class CostReport:
         tol = self.drift_tolerance
         for e in self.entries:
             r = e.drift_ratio
-            if r is None or e.planner is None:
-                continue
-            if 1.0 / tol <= r <= tol:
-                continue
-            side = "over" if r > 1 else "under"
-            out.append(Finding(
-                COST_RULE, COST_FILE, e.name, 0,
-                f"planner {e.planner} {side}-predicts workspace: "
-                f"predicted {e.predicted_bytes / 2**20:.0f} MiB vs "
-                f"compiled temp {e.temp_bytes / 2**20:.0f} MiB "
-                f"(ratio {r:.2f}, tolerance {tol:g}x)"))
+            if r is not None and e.planner is not None and \
+                    not (1.0 / tol <= r <= tol):
+                side = "over" if r > 1 else "under"
+                out.append(Finding(
+                    COST_RULE, COST_FILE, e.name, 0,
+                    f"planner {e.planner} {side}-predicts workspace: "
+                    f"predicted {e.predicted_bytes / 2**20:.0f} MiB vs "
+                    f"compiled temp {e.temp_bytes / 2**20:.0f} MiB "
+                    f"(ratio {r:.2f}, tolerance {tol:g}x)"))
+            c = e.collective_drift_ratio
+            if c is not None and not (1.0 / tol <= c <= tol):
+                side = "over" if c > 1 else "under"
+                out.append(Finding(
+                    COST_RULE, COST_FILE, f"{e.name}.collective", 0,
+                    f"merge planner {e.planner} {side}-predicts cross-chip "
+                    f"bytes: predicted {e.predicted_collective_bytes} B vs "
+                    f"compiled {e.collective_bytes} B "
+                    f"(ratio {c:.2f}, tolerance {tol:g}x)"))
         return out
 
     def to_dict(self) -> dict:
@@ -266,6 +403,11 @@ class CostReport:
             r = e.drift_ratio
             if r is not None:
                 line += f", planner drift {r:.2f}x"
+            if e.collective_bytes is not None:
+                line += f", x-chip {e.collective_bytes / 2**10:.0f} KiB"
+                c = e.collective_drift_ratio
+                if c is not None:
+                    line += f" (drift {c:.2f}x)"
             lines.append(line)
         return "\n".join(lines)
 
@@ -314,6 +456,11 @@ def export_gauges(report: CostReport, registry=None) -> None:
         "raft_tpu_planner_drift_ratio",
         "planner-predicted / compiled workspace bytes per entrypoint",
         labelnames=("entry", "planner"))
+    coll = reg.gauge(
+        "raft_tpu_cost_collective_bytes",
+        "per-device cross-chip receive bytes parsed from the compiled "
+        "HLO (sharded merge entries)",
+        labelnames=("entry",))
     for e in report.entries:
         if e.flops is not None:
             flops.labels(e.name).set(e.flops)
@@ -321,6 +468,8 @@ def export_gauges(report: CostReport, registry=None) -> None:
             hbm.labels(e.name).set(e.hbm_bytes)
         if e.temp_bytes is not None:
             temp.labels(e.name).set(e.temp_bytes)
+        if e.collective_bytes is not None:
+            coll.labels(e.name).set(e.collective_bytes)
         r = e.drift_ratio
         if r is not None and e.planner:
             drift.labels(e.name, e.planner).set(r)

@@ -72,6 +72,12 @@ REASONS = frozenset({
     "k_gt_1024",               # k above the VMEM top-k carry bound
     "non_float_dtype",         # integer dataset (no float carry)
     "lut_params_unsupported",  # fused-LUT regime needs pq_bits=8 etc.
+    # sharded cross-chip merge dispatch (parallel/sharded.py merge_mode;
+    # "forced"/"fused_loses" above are shared with the merge ladder)
+    "merge_tree",              # auto: log₂S ppermute tree merge (default)
+    "merge_ring",              # auto on TPU: measured merge_ring win
+    "merge_allgather",         # auto: non-power-of-two mesh fallback
+    "no_ring_verdict",         # auto on TPU, probe has no merge_ring row
     # schema escape hatch for readers; never emitted by this repo
     "unknown",
 })

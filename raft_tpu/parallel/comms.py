@@ -25,6 +25,7 @@ mirrors that so algorithms take one ``res`` and find the communicator.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -45,6 +46,18 @@ class ReduceOp:
     PROD = "prod"
     MIN = "min"
     MAX = "max"
+
+
+def _lex_topk(v, pos, i, k: int, select_min: bool):
+    """The ``k`` lexicographically-smallest (value, pos) candidates per row,
+    sorted — the tie rule ``select_k``'s stable engines implement, made
+    explicit so partial merges compose in any order. ``pos`` is each
+    candidate's position in the virtual rank-order concatenation (unique,
+    so the sort key is a total order and stability is moot)."""
+    key = v if select_min else -v
+    sv, sp, si = jax.lax.sort((key, pos, i), dimension=1, num_keys=2)
+    sv, sp, si = sv[:, :k], sp[:, :k], si[:, :k]
+    return (sv if select_min else -sv), sp, si
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +197,95 @@ class Comms:
         ranks (used by all-to-all sequence/context parallelism)."""
         return jax.lax.all_to_all(x, self.axis, split_axis=0, concat_axis=0,
                                   tiled=False)
+
+    # ---- streaming cross-chip top-k merge (traceable; inside shard_map) ----
+    #
+    # The MNMG merge (knn_merge_parts across ranks) without the all_gather
+    # slab: candidates are tagged with their position in the virtual
+    # rank-order concatenation and merged by lexicographic (value, pos)
+    # selection. Stable-by-position selection is associative AND
+    # commutative over candidate sets, so any merge order — hypercube
+    # tree, neighbor ring — produces the identical replicated output, and
+    # that output is bit-identical to ``select_k(allgather(v), k)`` + id
+    # gather (select_k's engines are all position-stable on ties: DIRECT
+    # is lax.top_k, TWO_PHASE merges tile-ordered survivors, SCREEN sorts
+    # (value, pos) stably). Peak cross-chip bytes drop from S·nq·kk to
+    # nq·k·log₂S (tree) / nq·kk per step (ring).
+
+    def tree_topk_merge(self, v, i, k: int, select_min: bool = True):
+        """Hypercube top-k merge in log₂(size) ``ppermute`` rounds.
+
+        ``v``/``i`` are this shard's [nq, kk] candidates (ids global;
+        invalid candidates must already carry ±inf values). Each round
+        exchanges carries with the rank's XOR partner and re-selects down
+        to ``min(k, candidates_so_far)`` — live candidate sets halve each
+        round while per-device carry bytes stay O(nq·k). Requires a
+        power-of-two ``size`` (the dispatch layer falls back to
+        all_gather otherwise). Returns replicated (values, ids) of width
+        ``min(k, size·kk)``, bit-identical to the all_gather merge."""
+        size = self.size
+        if size & (size - 1):
+            raise ValueError(f"tree merge needs a power-of-two mesh axis, "
+                             f"got size={size}")
+        nq, kk = v.shape
+        k_out = min(int(k), size * kk)
+        pos0 = self.rank() * kk + jnp.arange(kk, dtype=jnp.int32)
+        cv, cp, ci = v, jnp.broadcast_to(pos0[None, :], (nq, kk)), i
+        width = kk
+        step = 1
+        while step < size:
+            perm = [(r, r ^ step) for r in range(size)]
+            pv = self.ppermute(cv, perm)
+            pp = self.ppermute(cp, perm)
+            pi = self.ppermute(ci, perm)
+            width = min(k_out, 2 * width)
+            cv, cp, ci = _lex_topk(
+                jnp.concatenate([cv, pv], axis=1),
+                jnp.concatenate([cp, pp], axis=1),
+                jnp.concatenate([ci, pi], axis=1), width, select_min)
+            step *= 2
+        if size == 1:  # no rounds ran: still honor the sort+truncate contract
+            cv, cp, ci = _lex_topk(cv, cp, ci, k_out, select_min)
+        return cv, ci
+
+    def ring_topk_merge(self, v, i, k: int, select_min: bool = True,
+                        shift=None):
+        """Neighbor-ring top-k merge: size-1 steps, each rotating the
+        ORIGINAL [nq, kk] candidate block one hop while folding the block
+        received last step into the local carry — the streaming schedule
+        whose per-step traffic (one fixed-shape block to one neighbor) a
+        ``make_async_remote_copy`` kernel can overlap with the local
+        probe-tile scan. ``shift`` maps one packed [3, nq, kk] f32 buffer
+        to its +1 ring rotation (default: XLA ``ppermute``; the Pallas
+        RDMA kernel slots in here). Works for any ``size``. Returns
+        replicated (values, ids) of width ``min(k, size·kk)``,
+        bit-identical to the all_gather merge (the lex merge is
+        commutative, so per-device rotation order doesn't matter)."""
+        size = self.size
+        nq, kk = v.shape
+        k_out = min(int(k), size * kk)
+        if shift is None:
+            shift = functools.partial(self.shift, offset=1)
+        if v.dtype != jnp.float32:
+            raise ValueError(f"ring merge packs candidates as float32 "
+                             f"words, got values dtype {v.dtype}")
+        pos0 = self.rank() * kk + jnp.arange(kk, dtype=jnp.int32)
+        pos = jnp.broadcast_to(pos0[None, :], (nq, kk))
+        block = jnp.stack([
+            v, jax.lax.bitcast_convert_type(pos, jnp.float32),
+            jax.lax.bitcast_convert_type(i.astype(jnp.int32), jnp.float32)])
+        cv, cp, ci = _lex_topk(v, pos, i, min(k_out, kk), select_min)
+        for s in range(size - 1):
+            block = shift(block)
+            bv = block[0]
+            bp = jax.lax.bitcast_convert_type(block[1], jnp.int32)
+            bi = jax.lax.bitcast_convert_type(block[2], jnp.int32)
+            cv, cp, ci = _lex_topk(
+                jnp.concatenate([cv, bv], axis=1),
+                jnp.concatenate([cp, bp], axis=1),
+                jnp.concatenate([ci, bi], axis=1),
+                min(k_out, (s + 2) * kk), select_min)
+        return cv, ci
 
     # ---- split ------------------------------------------------------------
     def comm_split(self, color_axis: str) -> "Comms":

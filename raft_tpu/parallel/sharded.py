@@ -17,6 +17,7 @@ shards never move. Build shards rows round-robin; ids stay global.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 import threading
@@ -31,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.obs import explain as obs_explain
 from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.obs import spans as obs_spans
 from raft_tpu.ops.distance import DistanceType, resolve_metric, pairwise_core
@@ -328,6 +330,208 @@ def _stack_sharded(comms: Comms, parts: dict, fill=0):
     return jax.make_array_from_callback(global_shape, sharding, cb)
 
 
+# ------------------------------------------------------ placement planning
+#
+# Every sharded entrypoint used to re-derive the same facts inline — row
+# bounds, per-shard candidate width, workspace tiles, and (implicitly) the
+# one hardcoded all_gather merge. A PlacementPlan solves them once per
+# (index, shape) and carries the resolved cross-chip merge engine, so the
+# search bodies just execute the plan and ROADMAP item 2's router has one
+# object to consume.
+
+MERGE_MODES = ("auto", "allgather", "tree", "ring")
+
+
+def shard_bounds(size: int, n: int) -> np.ndarray:
+    """[S+1] balanced row offsets — THE row partition every sharded build
+    uses (np.linspace keeps shard sizes within one row of each other and
+    the last shard ragged when S ∤ n)."""
+    return np.linspace(0, n, size + 1).astype(np.int64)
+
+
+def _check_n_lists(bounds: np.ndarray, n_lists: int, n: int,
+                   size: int) -> None:
+    min_shard = int(np.diff(bounds).min())
+    if n_lists > min_shard:
+        raise ValueError(
+            f"n_lists={n_lists} exceeds the smallest shard's "
+            f"{min_shard} rows ({n} rows over {size} devices); every shard "
+            f"builds its own index, so n_lists must be ≤ rows-per-shard")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """One sharded search, solved: where the rows live (mesh axis, size,
+    bounds), what scans them (family + engine + tiles), and how the
+    per-shard candidates merge across chips (mode + reason + predicted
+    bytes). Frozen and cached per (index, shape) in ``_PLAN_CACHE`` —
+    entrypoints execute plans, they don't re-derive them."""
+
+    axis: str
+    size: int
+    n_rows: int
+    bounds: Tuple[int, ...]   # [S+1] global row offsets ((∅) if unknown)
+    family: str               # "brute_force" | "cagra" | "ivf_flat" | "ivf_pq"
+    engine: str               # local scan engine ("xla", "cache", "lut", ...)
+    nq: int
+    k: int
+    kk: int                   # per-shard candidate width entering the merge
+    k_out: int                # merged output width = min(k, size*kk)
+    merge_mode: str           # resolved: "allgather" | "tree" | "ring"
+    merge_reason: str         # obs.explain REASONS member
+    ring_shift: str           # "pallas" | "pallas_interpret" | "xla" | ""
+    mask_invalid: bool        # mask id<0 candidates to ±inf before merging
+    tiles: Tuple[Tuple[str, int], ...] = ()   # planner tile choices
+    merge_bytes: Tuple[Tuple[str, int], ...] = ()  # predicted bytes by mode
+
+    def explain_plan(self) -> dict:
+        """The flat JSON-safe dict an ExplainRecord carries."""
+        out = {"size": self.size, "kk": self.kk, "k_out": self.k_out,
+               "merge_mode": self.merge_mode, "ring_shift": self.ring_shift}
+        out.update({f"tile_{k}": v for k, v in self.tiles})
+        out.update({f"merge_bytes_{k}": v for k, v in self.merge_bytes})
+        return out
+
+
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_CAP = 256
+_PLAN_LOCK = threading.Lock()
+_PLAN_SOLVES = obs_metrics.REGISTRY.counter(
+    "raft_tpu_placement_plan_solves_total",
+    "PlacementPlan cache misses (fresh solves) by family.", ("family",))
+
+
+def plan_cache_clear() -> None:
+    """Test hook: drop every cached PlacementPlan."""
+    with _PLAN_LOCK:
+        _PLAN_CACHE.clear()
+
+
+def merge_dispatch_explained(merge_mode: str, size: int):
+    """Resolve the cross-chip merge engine: ``(engine, reason,
+    ring_shift)`` with reason from ``obs.explain.REASONS`` — the merge
+    analog of ``ops.pallas_kernels.fused_dispatch_explained``, sharing its
+    verdict discipline: ``auto`` only routes the RDMA ring kernel on TPU
+    when the PALLAS_PROBE artifact records a ``merge_ring`` win; with no
+    verdict it stays on the pure-XLA tree merge (safe everywhere) and
+    says so. Non-power-of-two meshes fall back to all_gather (the tree
+    pairs ranks by XOR)."""
+    from raft_tpu.ops import pallas_kernels
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    interp = os.environ.get("RAFT_TPU_PALLAS_INTERPRET") == "1"
+    pow2 = size >= 2 and (size & (size - 1)) == 0
+    if merge_mode == "allgather":
+        return "allgather", "forced", ""
+    if merge_mode == "tree":
+        if not pow2:
+            raise ValueError(
+                f"merge_mode='tree' needs a power-of-two mesh axis "
+                f"(size={size}); use 'allgather' or 'auto'")
+        return "tree", "forced", ""
+    if merge_mode == "ring":
+        if size < 2:
+            raise ValueError("merge_mode='ring' needs a mesh axis of at "
+                             "least 2 devices")
+        # explicit request is the opt-in (cf. scan_mode="pallas"):
+        # hardware RDMA on TPU, Mosaic interpreter under the parity hook,
+        # the same ring schedule over XLA ppermute elsewhere
+        shift = ("pallas" if on_tpu
+                 else "pallas_interpret" if interp else "xla")
+        return "ring", "forced", shift
+    if merge_mode != "auto":
+        raise ValueError(f"unknown merge_mode: {merge_mode!r} "
+                         f"(one of {MERGE_MODES})")
+    if not pow2:
+        return "allgather", "merge_allgather", ""
+    if on_tpu:
+        verdict = pallas_kernels.ring_merge_verdict()
+        if verdict:
+            return "ring", "merge_ring", "pallas"
+        if verdict is None:
+            return "tree", "no_ring_verdict", ""
+        return "tree", "fused_loses", ""
+    return "tree", "merge_tree", ""
+
+
+def plan_sharded_search(comms: Comms, family: str, n_rows: int, bounds,
+                        nq: int, k: int, kk: int, engine: str,
+                        merge_mode: str = "auto", mask_invalid: bool = False,
+                        tiles: Optional[dict] = None) -> PlacementPlan:
+    """Solve (or fetch) the PlacementPlan for one sharded search shape.
+
+    Cached on the full solving key — including backend and merge_mode, so
+    a probe artifact landing mid-process or an env flip retraces rather
+    than reusing a stale resolution (the select_k AUTO-table rule)."""
+    from raft_tpu.core.resources import solve_merge_bytes
+
+    bounds_t = tuple(int(b) for b in bounds) if bounds is not None else ()
+    tiles_t = tuple(sorted((tiles or {}).items()))
+    key = (family, comms.axis, comms.size, int(n_rows), bounds_t, int(nq),
+           int(k), int(kk), engine, merge_mode, bool(mask_invalid), tiles_t,
+           jax.default_backend(),
+           os.environ.get("RAFT_TPU_PALLAS_INTERPRET"))
+    with _PLAN_LOCK:
+        plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        return plan
+    mode, reason, ring_shift = merge_dispatch_explained(merge_mode,
+                                                        comms.size)
+    k_out = min(int(k), comms.size * int(kk))
+    mb = solve_merge_bytes(comms.size, int(nq), int(kk), k_out)
+    plan = PlacementPlan(
+        axis=comms.axis, size=comms.size, n_rows=int(n_rows),
+        bounds=bounds_t, family=family, engine=engine, nq=int(nq),
+        k=int(k), kk=int(kk), k_out=k_out, merge_mode=mode,
+        merge_reason=reason, ring_shift=ring_shift,
+        mask_invalid=bool(mask_invalid), tiles=tiles_t,
+        merge_bytes=tuple(sorted(mb.items())))
+    _PLAN_SOLVES.labels(family).inc()
+    with _PLAN_LOCK:
+        if len(_PLAN_CACHE) >= _PLAN_CACHE_CAP:
+            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        _PLAN_CACHE[key] = plan
+    return plan
+
+
+def _plan_merge(comms: Comms, plan: PlacementPlan, v, i, minimize: bool):
+    """Execute the plan's cross-chip merge (traceable, inside shard_map).
+    All three engines are bit-identical by construction: allgather is the
+    reference rank-order concat + stable select_k; tree and ring select
+    by explicit (value, concat-pos) lexicographic order, which equals the
+    stable selection for any merge schedule (comms.py)."""
+    if plan.mask_invalid:
+        v = jnp.where(i < 0, jnp.inf if minimize else -jnp.inf, v)
+    if plan.merge_mode == "allgather":
+        v_all = comms.allgather(v, axis=1)
+        i_all = comms.allgather(i, axis=1)
+        vm, sel = select_k(v_all, plan.k_out, select_min=minimize)
+        return vm, jnp.take_along_axis(i_all, sel, axis=1)
+    if plan.merge_mode == "tree":
+        return comms.tree_topk_merge(v, i, plan.k_out, select_min=minimize)
+    shift = None
+    if plan.ring_shift.startswith("pallas"):
+        from raft_tpu.ops.pallas_kernels import pallas_ring_shift
+
+        interp = plan.ring_shift == "pallas_interpret"
+        shift = functools.partial(pallas_ring_shift, axis=comms.axis,
+                                  size=comms.size, interpret=interp)
+    return comms.ring_topk_merge(v, i, plan.k_out, select_min=minimize,
+                                 shift=shift)
+
+
+def _record_plan(plan: PlacementPlan, requested: str,
+                 params: Optional[dict] = None) -> None:
+    """Emit the merge-dispatch ExplainRecord for one sharded search call
+    (the parallel/ analog of the single-chip families' attribution —
+    graftcheck R007 covers these sites)."""
+    p = {"nq": plan.nq, "k": plan.k, "engine": plan.engine}
+    p.update(params or {})
+    obs_explain.record_dispatch(
+        f"sharded_{plan.family}", requested, plan.merge_mode,
+        plan.merge_reason, params=p, plan=plan.explain_plan())
+
+
 # ----------------------------------------------------------- sharded knn
 
 
@@ -339,13 +543,16 @@ def knn(
     k: int,
     metric="sqeuclidean",
     res: Optional[Resources] = None,
+    merge_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN over a row-sharded dataset: local brute force per shard +
     ICI merge (the SPMD analog of MNMG brute_force over raft::comms).
 
     ``dataset`` may already be sharded over ``comms.axis``; otherwise it is
-    placed with row sharding here. Returns replicated (distances, indices)
-    with global row ids.
+    placed with row sharding here. ``merge_mode`` picks the cross-chip
+    top-k merge (docs/sharding.md): "auto" routes the streaming tree/ring
+    ladder, "allgather" the legacy full-slab merge — all bit-identical.
+    Returns replicated (distances, indices) with global row ids.
     """
     _SHARDED_SEARCHES.labels("brute_force").inc()
     ensure_resources(res)
@@ -383,14 +590,14 @@ def knn(
             comms, local_scan, in_specs, (q, x), "brute_force",
             queries.shape[0], min(k, size * kk), minimize, sink)
 
+    plan = plan_sharded_search(
+        comms, "brute_force", n, tuple(range(0, n_pad + 1, shard)),
+        queries.shape[0], k, kk, "xla", merge_mode=merge_mode)
+    _record_plan(plan, merge_mode, {"metric": m.name})
+
     def local(q_rep, x_loc):
         v, gids = local_scan(q_rep, x_loc)
-        # merge across ranks: gather all shards' candidates, re-select
-        v_all = comms.allgather(v, axis=1)  # [nq, size*kk]
-        g_all = comms.allgather(gids, axis=1)
-        vm, sel = select_k(v_all, min(k, v_all.shape[1]), select_min=minimize)
-        im = jnp.take_along_axis(g_all, sel, axis=1)
-        return vm, im
+        return _plan_merge(comms, plan, v, gids, minimize)
 
     fn = comms.run(local, in_specs, (P(None, None), P(None, None)))
     return jax.jit(fn)(q, x)
@@ -472,10 +679,23 @@ def kmeans_fit(
     n_iters: int = 20,
     key=None,
     res: Optional[Resources] = None,
+    balance_threshold: Optional[float] = None,
+    donor_pool: int = 256,
 ) -> Tuple[jax.Array, jax.Array]:
     """Data-parallel Lloyd k-means over a row-sharded dataset (the MNMG
     k-means pattern: local assignment, psum of per-cluster sums/counts —
-    what cuML does over raft::comms allreduce). Returns (centers, labels)."""
+    what cuML does over raft::comms allreduce). Returns (centers, labels).
+
+    ``balance_threshold`` turns on the multi-host analog of
+    ``cluster.kmeans_balanced``'s adjust_centers: each iteration, clusters
+    whose GLOBAL (psum'd) size falls at or below ``threshold · n/K`` are
+    re-seeded toward a donor row from a big (size ≥ average) cluster —
+    new_center = (wc·center[donor's cluster] + donor)/(wc+1), wc =
+    min(size, 7), exactly the reference rescue but fed by the mesh-wide
+    counts. The donor pool is sampled once host-side and replicated, so
+    the rescue is pure replicated math and every device stays consistent
+    (the rotation of pool slots per iteration stands in for the
+    single-chip trainer's per-iteration resampling)."""
     res = ensure_resources(res)
     if key is None:
         key = res.next_key()
@@ -487,15 +707,40 @@ def kmeans_fit(
     if n_pad != n:
         x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
     xs = comms.shard(x, P(comms.axis, None))
+    # init must consume `key` exactly as the pre-balanced trainer did so a
+    # fixed seed reproduces the same clustering when balancing is off
     init = jax.random.choice(key, n, (n_clusters,), replace=False)
     centers0 = comms.shard(jnp.asarray(x)[jnp.sort(init)], P(None, None))
+    balanced = balance_threshold is not None
+    if balanced:
+        dkey = jax.random.fold_in(key, 1)
+        pick = jax.random.randint(dkey, (int(donor_pool),), 0, n)
+        donors0 = comms.shard(jnp.asarray(x)[pick], P(None, None))
 
-    def local(x_loc, c0):
+    def _rescue(it, new_c, counts, donors):
+        avg = jnp.float32(n) / n_clusters
+        starving = counts <= avg * jnp.float32(balance_threshold)
+        big = counts >= avg
+        # donor labels vs the freshly updated centers (tiny pool matmul)
+        cn = jnp.sum(new_c * new_c, -1)
+        dd = cn[None, :] - 2.0 * donors @ new_c.T
+        dlab = jnp.argmin(dd, axis=1)
+        pool_ok = big[dlab]
+        order = jnp.argsort(~pool_ok)  # good donors first (stable)
+        drows, dlab = donors[order], dlab[order]
+        n_good = jnp.sum(pool_ok.astype(jnp.int32))
+        slot = (jnp.arange(n_clusters) + it * 131) % jnp.maximum(n_good, 1)
+        have = (n_good > 0) & starving
+        wc = jnp.minimum(counts, 7.0)[:, None]
+        resc = (wc * new_c[dlab[slot]] + drows[slot]) / (wc + 1.0)
+        return jnp.where(have[:, None], resc, new_c)
+
+    def local(x_loc, c0, donors):
         rank = comms.rank()
         base = rank * shard
         valid = (jnp.arange(shard) + base) < n
 
-        def step(c, _):
+        def step(c, it):
             cn = jnp.sum(c * c, -1)
             d = cn[None, :] - 2.0 * jax.lax.dot_general(
                 x_loc, c, (((1,), (1,)), ((), ())),
@@ -510,17 +755,25 @@ def kmeans_fit(
             counts = comms.allreduce(counts)
             new_c = jnp.where(counts[:, None] > 0,
                               sums / jnp.maximum(counts, 1.0)[:, None], c)
+            if balanced:
+                new_c = _rescue(it, new_c, counts, donors)
             return new_c, None
 
-        c_final, _ = jax.lax.scan(step, c0, None, length=n_iters)
+        c_final, _ = jax.lax.scan(step, c0, jnp.arange(n_iters))
         cn = jnp.sum(c_final * c_final, -1)
         d = cn[None, :] - 2.0 * x_loc @ c_final.T
         labels = jnp.argmin(d, axis=1).astype(jnp.int32)
         return c_final, labels
 
-    fn = comms.run(local, (P(comms.axis, None), P(None, None)),
-                   (P(None, None), P(comms.axis)))
-    centers, labels = jax.jit(fn)(xs, centers0)
+    out_specs = (P(None, None), P(comms.axis))
+    if balanced:
+        fn = comms.run(local, (P(comms.axis, None), P(None, None),
+                               P(None, None)), out_specs)
+        centers, labels = jax.jit(fn)(xs, centers0, donors0)
+    else:
+        fn = comms.run(lambda xl, c0: local(xl, c0, None),
+                       (P(comms.axis, None), P(None, None)), out_specs)
+        centers, labels = jax.jit(fn)(xs, centers0)
     return centers, labels[:n]
 
 
@@ -567,7 +820,7 @@ def build_cagra(
     params = params or cagra.IndexParams()
     dataset = np.asarray(dataset)
     n, dim = dataset.shape
-    bounds = np.linspace(0, n, comms.size + 1).astype(np.int64)
+    bounds = shard_bounds(comms.size, n)
 
     def one(r, shard_res):
         lo, hi = bounds[r], bounds[r + 1]
@@ -591,10 +844,11 @@ def search_cagra(
     k: int,
     params=None,
     res: Optional[Resources] = None,
+    merge_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD CAGRA search: per-device beam search over its shard's graph,
-    local ids mapped to global row ids, then one all_gather + top-k merge
-    over ICI."""
+    local ids mapped to global row ids, then the planned cross-chip top-k
+    merge over ICI (``merge_mode``, docs/sharding.md)."""
     from raft_tpu.neighbors import cagra
 
     _SHARDED_SEARCHES.labels("cagra").inc()
@@ -645,13 +899,6 @@ def search_cagra(
         v = jnp.where(pad_hit, jnp.inf if minimize else -jnp.inf, v)
         return v, gid
 
-    def local(q_rep, ds, sds, gr, n_valid, b):
-        v, gid = local_scan(q_rep, ds, sds, gr, n_valid, b)
-        v_all = comms.allgather(v, axis=1)
-        g_all = comms.allgather(gid, axis=1)
-        vm, sel = select_k(v_all, int(k), select_min=minimize)
-        return vm, jnp.take_along_axis(g_all, sel, axis=1)
-
     ax = comms.axis
     in_specs = (P(None, None), P(ax, None, None), P(ax, None, None),
                 P(ax, None, None), P(ax), P(ax))
@@ -664,6 +911,17 @@ def search_cagra(
     if sink is not None:
         return _instrumented_search(comms, local_scan, in_specs, args,
                                     "cagra", nq, int(k), minimize, sink)
+
+    plan = plan_sharded_search(
+        comms, "cagra", index.n_rows, index.bounds, nq, int(k), int(k),
+        "xla", merge_mode=merge_mode)
+    _record_plan(plan, merge_mode,
+                 {"itopk": itopk, "search_width": width})
+
+    def local(q_rep, ds, sds, gr, n_valid, b):
+        v, gid = local_scan(q_rep, ds, sds, gr, n_valid, b)
+        return _plan_merge(comms, plan, v, gid, minimize)
+
     fn = comms.run(local, in_specs, (P(None, None), P(None, None)))
     return jax.jit(fn)(*args)
 
@@ -717,13 +975,9 @@ def build_ivf_flat(
     dataset = np.asarray(dataset)
     n = len(dataset)
     size = comms.size
-    bounds = np.linspace(0, n, size + 1).astype(np.int64)
-    min_shard = int(np.diff(bounds).min())
-    if params.n_lists > min_shard:
-        raise ValueError(
-            f"n_lists={params.n_lists} exceeds the smallest shard's "
-            f"{min_shard} rows ({n} rows over {size} devices); every shard "
-            f"builds its own index, so n_lists must be ≤ rows-per-shard")
+    bounds = shard_bounds(size, n)
+    _check_n_lists(bounds, params.n_lists, n, size)
+
     def one(r, shard_res):
         lo, hi = bounds[r], bounds[r + 1]
         idx = ivf_flat.build(dataset[lo:hi], params, res=shard_res)
@@ -733,7 +987,9 @@ def build_ivf_flat(
         return idx, gl_idx, _globalize_overflow_ids(idx, lo)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
-    return _assemble_sharded_ivf_flat(comms, subs, params, n)
+    out = _assemble_sharded_ivf_flat(comms, subs, params, n)
+    out.bounds = bounds
+    return out
 
 
 def _globalize_overflow_ids(idx, lo: int) -> np.ndarray:
@@ -771,13 +1027,9 @@ def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
     res = ensure_resources(res)
     n, _ = native.read_bin_header(path)
     size = comms.size
-    bounds = np.linspace(0, n, size + 1).astype(np.int64)
-    min_shard = int(np.diff(bounds).min())
-    if params.n_lists > min_shard:
-        raise ValueError(
-            f"n_lists={params.n_lists} exceeds the smallest shard's "
-            f"{min_shard} rows ({n} rows over {size} devices); every shard "
-            f"builds its own index, so n_lists must be ≤ rows-per-shard")
+    bounds = shard_bounds(size, n)
+    _check_n_lists(bounds, params.n_lists, n, size)
+
     def one(r, shard_res):
         lo, hi = int(bounds[r]), int(bounds[r + 1])
         idx = ooc_builder(
@@ -788,7 +1040,9 @@ def _build_sharded_from_file(comms, path, params, ooc_builder, assembler,
             idx.overflow_indices)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
-    return assembler(comms, subs, params, n)
+    out = assembler(comms, subs, params, n)
+    out.bounds = bounds
+    return out
 
 
 def _assemble_sharded_ivf_flat(comms: Comms, subs, params, n: int
@@ -896,12 +1150,8 @@ def build_ivf_pq(
     dataset = np.asarray(dataset)
     n = len(dataset)
     size = comms.size
-    bounds = np.linspace(0, n, size + 1).astype(np.int64)
-    min_shard = int(np.diff(bounds).min())
-    if params.n_lists > min_shard:
-        raise ValueError(
-            f"n_lists={params.n_lists} exceeds the smallest shard's "
-            f"{min_shard} rows ({n} rows over {size} devices)")
+    bounds = shard_bounds(size, n)
+    _check_n_lists(bounds, params.n_lists, n, size)
 
     def one(r, shard_res):
         lo, hi = bounds[r], bounds[r + 1]
@@ -911,9 +1161,11 @@ def build_ivf_pq(
         return idx, gl_idx, _globalize_overflow_ids(idx, lo)
 
     subs = _map_shards(comms, one, res, spans=np.diff(bounds))
-    return _assemble_sharded_ivf_pq(comms, subs, params, n,
-                                    scan_mode=scan_mode,
-                                    scan_cache_dtype=scan_cache_dtype)
+    out = _assemble_sharded_ivf_pq(comms, subs, params, n,
+                                   scan_mode=scan_mode,
+                                   scan_cache_dtype=scan_cache_dtype)
+    out.bounds = bounds
+    return out
 
 
 @tracing.range("sharded.build_ivf_pq_from_file")
@@ -942,6 +1194,80 @@ def build_ivf_pq_from_file(
         functools.partial(_assemble_sharded_ivf_pq, scan_mode=scan_mode,
                           scan_cache_dtype=scan_cache_dtype),
         res, batch_rows, dtype, max_train_rows)
+
+
+@tracing.range("sharded.build_ivf_pq_from_file_pod")
+def build_ivf_pq_from_file_pod(
+    comms: Comms,
+    path: str,
+    params=None,
+    res: Optional[Resources] = None,
+    batch_rows: int = 1 << 18,
+    dtype=None,
+    max_train_rows: Optional[int] = None,
+    scan_mode: str = "lut",
+    scan_cache_dtype=jnp.bfloat16,
+    balance_threshold: Optional[float] = 0.25,
+) -> ShardedIvfPq:
+    """Pod-scale streamed IVF-PQ build (the DEEP-100M path): ONE mesh-wide
+    balanced k-means trains the shared coarse centers (``kmeans_fit``'s
+    psum pattern scaled past one chip), PQ rotation + codebooks train once
+    on the pooled sample, then every shard streams its row span through
+    the shared quantizer — the sharded PQ encode.
+
+    Unlike :func:`build_ivf_pq_from_file` (each shard trains its OWN
+    quantizer over its span), all shards agree on the coarse partition, so
+    ``n_lists`` is bounded by the trainset size, not rows-per-shard, and
+    probe routing is consistent across the mesh — the shape the chunked
+    ground-truth oracle in tools/deep100m_dryrun.py verifies recall
+    against. Training memory is one pooled sample (≤ ``max_train_rows``
+    rows); encode memory is one shard's packed codes + a batch."""
+    from raft_tpu import native
+    from raft_tpu.neighbors import ivf_pq, ooc
+
+    res = ensure_resources(res)
+    params = params or ivf_pq.IndexParams()
+    n, _ = native.read_bin_header(path)
+    size = comms.size
+    bounds = shard_bounds(size, n)
+    n_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
+    if max_train_rows is not None:
+        n_train = min(n_train, int(max_train_rows))
+    if params.n_lists > n_train:
+        raise ValueError(f"n_lists={params.n_lists} > trainset rows "
+                         f"{n_train}; raise max_train_rows or "
+                         f"kmeans_trainset_fraction")
+    # per-shard strided samples pooled into one mesh-wide trainset
+    per = cdiv(n_train, size)
+    trainset = np.concatenate([
+        ooc.sample_rows_from_file(
+            path, per, seed=r, dtype=dtype, batch_rows=batch_rows,
+            row_range=(int(bounds[r]), int(bounds[r + 1])))
+        for r in range(size)], axis=0).astype(np.float32)
+    centers, _ = kmeans_fit(comms, trainset, params.n_lists,
+                            n_iters=params.kmeans_n_iters, res=res,
+                            balance_threshold=balance_threshold)
+    train_params = dataclasses.replace(params, kmeans_trainset_fraction=1.0,
+                                       add_data_on_build=False)
+    trained = ivf_pq.build(trainset, train_params, res=res,
+                           coarse_centers=np.asarray(centers))
+    del trainset
+
+    def one(r, shard_res):
+        lo, hi = int(bounds[r]), int(bounds[r + 1])
+        idx = ooc.build_ivf_pq_from_file(
+            path, params, res=shard_res, batch_rows=batch_rows, dtype=dtype,
+            row_range=(lo, hi), trained_index=trained)
+        # ids are file-absolute already, overflow ids included
+        return idx, np.asarray(idx.list_indices), np.asarray(
+            idx.overflow_indices)
+
+    subs = _map_shards(comms, one, res, spans=np.diff(bounds))
+    out = _assemble_sharded_ivf_pq(comms, subs, params, n,
+                                   scan_mode=scan_mode,
+                                   scan_cache_dtype=scan_cache_dtype)
+    out.bounds = bounds
+    return out
 
 
 def _assemble_sharded_ivf_pq(comms: Comms, subs, params, n: int,
@@ -1061,11 +1387,12 @@ def search_ivf_pq(
     k: int,
     params=None,
     res: Optional[Resources] = None,
+    merge_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD IVF-PQ search: per-device ADC scan of its shard's probed lists
     (cache or LUT engine, per ``params.scan_mode`` — "auto" follows the
-    engine the index was built with), then one all_gather + top-k merge
-    over ICI (knn_merge_parts across ranks)."""
+    engine the index was built with), then the planned cross-chip top-k
+    merge over ICI (``merge_mode``, docs/sharding.md)."""
     from raft_tpu.neighbors import ivf_pq
 
     _SHARDED_SEARCHES.labels("ivf_pq").inc()
@@ -1081,13 +1408,6 @@ def search_ivf_pq(
                                  index.list_codes)
     empty_filter = jnp.zeros((0,), jnp.uint32)
     ax = comms.axis
-
-    def merge(v, i):
-        v_all = comms.allgather(v, axis=1)
-        i_all = comms.allgather(i, axis=1)
-        v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)
-        vm, sel = select_k(v_all, int(k), select_min=minimize)
-        return vm, jnp.take_along_axis(i_all, sel, axis=1)
 
     has_overflow = index.overflow_decoded is not None
     over_ops = ((index.overflow_decoded, index.overflow_norms,
@@ -1151,7 +1471,18 @@ def search_ivf_pq(
         return _instrumented_search(comms, local_scan, in_specs, args,
                                     "ivf_pq", queries.shape[0], int(k),
                                     minimize, sink)
-    fn = comms.run(lambda *a: merge(*local_scan(*a)),
+
+    tiles = {"q_tile": int(q_tile)}
+    if mode == "lut":
+        tiles["probe_tile"] = int(probe_tile)
+    plan = plan_sharded_search(
+        comms, "ivf_pq", index.n_rows,
+        getattr(index, "bounds", None), queries.shape[0], int(k), int(k),
+        mode, merge_mode=merge_mode, mask_invalid=True, tiles=tiles)
+    _record_plan(plan, merge_mode, {"n_probes": n_probes})
+
+    fn = comms.run(lambda *a: _plan_merge(comms, plan, *local_scan(*a),
+                                          minimize),
                    in_specs, (P(None, None), P(None, None)))
     return jax.jit(fn)(*args)
 
@@ -1163,10 +1494,12 @@ def search_ivf_flat(
     k: int,
     params=None,
     res: Optional[Resources] = None,
+    merge_mode: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """SPMD search: every device scans its local shard's probed lists
-    (reusing the single-chip search core inside shard_map), then one
-    all_gather + top-k merges the per-shard candidates over ICI."""
+    (reusing the single-chip search core inside shard_map), then the
+    planned cross-chip top-k merge over ICI (``merge_mode``,
+    docs/sharding.md)."""
     from raft_tpu.neighbors import ivf_flat
 
     _SHARDED_SEARCHES.labels("ivf_flat").inc()
@@ -1196,14 +1529,6 @@ def search_ivf_flat(
             raise ValueError("scan_dtype requires fp32 list data")
 
     has_overflow = index.overflow_data is not None
-
-    def merge(v, i):
-        v_all = comms.allgather(v, axis=1)
-        i_all = comms.allgather(i, axis=1)
-        v_all = jnp.where(i_all < 0, jnp.inf if minimize else -jnp.inf, v_all)
-        vm, sel = select_k(v_all, int(k), select_min=minimize)
-        return vm, jnp.take_along_axis(i_all, sel, axis=1)
-
     ax = comms.axis
     q = comms.shard(queries, P(None, None))
     if has_overflow:
@@ -1239,7 +1564,16 @@ def search_ivf_flat(
         return _instrumented_search(comms, local_scan, in_specs, args,
                                     "ivf_flat", queries.shape[0], int(k),
                                     minimize, sink)
-    fn = comms.run(lambda *a: merge(*local_scan(*a)),
+
+    plan = plan_sharded_search(
+        comms, "ivf_flat", index.n_rows,
+        getattr(index, "bounds", None), queries.shape[0], int(k), int(k),
+        "xla", merge_mode=merge_mode, mask_invalid=True,
+        tiles={"q_tile": int(q_tile)})
+    _record_plan(plan, merge_mode, {"n_probes": n_probes})
+
+    fn = comms.run(lambda *a: _plan_merge(comms, plan, *local_scan(*a),
+                                          minimize),
                    in_specs, (P(None, None), P(None, None)))
     return jax.jit(fn)(*args)
 

@@ -40,6 +40,17 @@ def main():
     assert (i < 2000).all() and (i >= -1).all()
     recall = float(neighborhood_recall(i, np.asarray(gt)))
     assert recall >= 0.8, f"sharded cagra recall {recall}"
+    # merge ladder: every cross-chip merge schedule is bit-identical to
+    # the all_gather reference (docs/sharding.md)
+    sp = cagra.SearchParams(itopk_size=32)
+    d_ref, i_ref = sharded.search_cagra(idx, q, 5, sp,
+                                        merge_mode="allgather")
+    for mode in ("tree", "ring"):
+        dm, im = sharded.search_cagra(idx, q, 5, sp, merge_mode=mode)
+        np.testing.assert_array_equal(np.asarray(dm), np.asarray(d_ref),
+                                      err_msg=f"cagra {mode} dist")
+        np.testing.assert_array_equal(np.asarray(im), np.asarray(i_ref),
+                                      err_msg=f"cagra {mode} ids")
     print("SHARDED_CAGRA_OK", recall)
 
 

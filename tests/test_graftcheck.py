@@ -142,10 +142,13 @@ def test_r007_repo_dispatch_sites_are_all_attributed():
 
     import raft_tpu.neighbors as npkg
     import raft_tpu.ops as opkg
+    import raft_tpu.parallel as ppkg
     from raft_tpu.analysis.rules_ast import DISPATCH_CALLS
     findings, seen_dispatch = [], 0
+    seen_by_prefix = {}
     for pkg, prefix in ((npkg, "raft_tpu.neighbors"),
-                        (opkg, "raft_tpu.ops")):
+                        (opkg, "raft_tpu.ops"),
+                        (ppkg, "raft_tpu.parallel")):
         pkg_dir = os.path.dirname(pkg.__file__)
         for fn in sorted(os.listdir(pkg_dir)):
             if not fn.endswith(".py"):
@@ -155,12 +158,21 @@ def test_r007_repo_dispatch_sites_are_all_attributed():
                              f"{prefix}.{fn[:-3]}")
             findings.extend(rule_unattributed_dispatch(mod))
             if mod.modname not in (f"{prefix}.pallas_kernels",):
-                seen_dispatch += sum(
-                    1 for n in _ast.walk(mod.tree)
-                    if isinstance(n, _ast.Call)
-                    and mod.resolve(n.func) in DISPATCH_CALLS)
+                n = 0
+                for node in _ast.walk(mod.tree):
+                    if not isinstance(node, _ast.Call):
+                        continue
+                    dotted = mod.resolve(node.func)
+                    if dotted and "." not in dotted:
+                        dotted = f"{mod.modname}.{dotted}"
+                    n += dotted in DISPATCH_CALLS
+                seen_dispatch += n
+                seen_by_prefix[prefix] = seen_by_prefix.get(prefix, 0) + n
     assert findings == [], [f.format() for f in findings]
     assert seen_dispatch >= 3  # brute_force + ivf_flat + ivf_pq
+    # the sharded search entry points (knn / cagra / ivf_pq / ivf_flat)
+    # each plan their merge schedule through plan_sharded_search
+    assert seen_by_prefix.get("raft_tpu.parallel", 0) >= 3
 
 
 def test_layering_flags_cross_package_private_import():

@@ -1,0 +1,229 @@
+"""Merge-ladder bit-identity on the 8-device virtual CPU mesh.
+
+Every cross-chip merge schedule (all_gather reference, log2(S) ppermute
+tree, neighbor ring) must return byte-identical (distances, indices) —
+the lex-merge construction in ``parallel/comms.py`` makes any schedule
+equal to a stable ``select_k`` over the rank-ordered concat, so the
+dispatch choice is purely a bandwidth decision (docs/sharding.md).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.neighbors import brute_force
+from raft_tpu.parallel import comms as comms_mod
+from raft_tpu.parallel import sharded
+
+
+@pytest.fixture(scope="module")
+def comms():
+    assert len(jax.devices()) == 8, "conftest should provide 8 CPU devices"
+    return comms_mod.init_comms(axis="data")
+
+
+def _ladder(search, modes=("allgather", "tree", "ring")):
+    """Run ``search(merge_mode)`` for each mode; assert all byte-equal."""
+    d_ref, i_ref = (np.asarray(a) for a in search(modes[0]))
+    for mode in modes[1:]:
+        d, i = (np.asarray(a) for a in search(mode))
+        np.testing.assert_array_equal(d, d_ref, err_msg=f"{mode} dists")
+        np.testing.assert_array_equal(i, i_ref, err_msg=f"{mode} ids")
+    return d_ref, i_ref
+
+
+# ------------------------------------------------------------ brute force
+
+
+def test_knn_merge_ladder(comms):
+    rng = np.random.default_rng(0)
+    db = rng.standard_normal((1000, 16)).astype(np.float32)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    d, i = _ladder(lambda m: sharded.knn(comms, q, db, k=10, merge_mode=m))
+    d1, i1 = brute_force.knn(q, db, k=10, metric="sqeuclidean")
+    np.testing.assert_array_equal(i, np.asarray(i1))
+
+
+def test_knn_merge_ladder_ragged_last_shard(comms):
+    # 1003 rows over 8 shards: np.linspace bounds give a ragged split and
+    # the local scan pads — padding rows must never leak through any merge
+    rng = np.random.default_rng(1)
+    db = rng.standard_normal((1003, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    d, i = _ladder(lambda m: sharded.knn(comms, q, db, k=7, merge_mode=m))
+    assert (i >= 0).all() and (i < 1003).all()
+
+
+def test_knn_merge_ladder_duplicate_rows_across_shards(comms):
+    # the same 128 vectors tiled onto every shard: every query's top-k is
+    # one giant tie group, so bit-identity here proves the tie-break
+    # (value, global-concat-position) is schedule-invariant
+    rng = np.random.default_rng(2)
+    base = rng.standard_normal((128, 8)).astype(np.float32)
+    db = np.tile(base, (8, 1))
+    q = base[:8] + 0.0
+    _, i = _ladder(lambda m: sharded.knn(comms, q, db, k=10, merge_mode=m))
+    # ties resolve to the lowest global row id first (stable order)
+    assert (i[:, 0] == np.arange(8)).all()
+
+
+# -------------------------------------------------------------- ivf_flat
+
+
+@pytest.mark.slow
+def test_ivf_flat_merge_ladder(comms):
+    # slow: the sharded build + three merge variants cost ~30 s of compile
+    # on the virtual mesh; the CI mesh job runs this file unfiltered
+    from raft_tpu.neighbors import ivf_flat
+
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1024, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    idx = sharded.build_ivf_flat(comms, db, ivf_flat.IndexParams(n_lists=4))
+    sp = ivf_flat.SearchParams(n_probes=2)
+    # n_probes < n_lists leaves short lists ragged: id<0 slots must be
+    # masked to +/-inf before any merge (plan.mask_invalid)
+    _ladder(lambda m: sharded.search_ivf_flat(idx, q, 5, sp, merge_mode=m))
+
+
+# ---------------------------------------------------------------- ivf_pq
+
+
+@pytest.mark.slow
+def test_ivf_pq_merge_ladder(comms):
+    # slow for the same reason as the ivf_flat ladder above
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(4)
+    db = rng.standard_normal((1024, 16)).astype(np.float32)
+    q = rng.standard_normal((8, 16)).astype(np.float32)
+    idx = sharded.build_ivf_pq(
+        comms, db, ivf_pq.IndexParams(n_lists=4, pq_dim=8, kmeans_n_iters=3))
+    sp = ivf_pq.SearchParams(n_probes=2)
+    _ladder(lambda m: sharded.search_ivf_pq(idx, q, 5, sp, merge_mode=m))
+
+
+# -------------------------------------------- pallas interpret ring shift
+
+
+@pytest.mark.slow
+def test_ring_merge_pallas_interpret_parity(comms, monkeypatch):
+    """RAFT_TPU_PALLAS_INTERPRET=1 routes merge_mode='ring' through the
+    Mosaic-interpreted RDMA kernel — results must match the XLA ppermute
+    ring bit-for-bit (the CI parity hook for the TPU send path)."""
+    rng = np.random.default_rng(5)
+    db = rng.standard_normal((512, 8)).astype(np.float32)
+    q = rng.standard_normal((4, 8)).astype(np.float32)
+    d_x, i_x = sharded.knn(comms, q, db, k=5, merge_mode="ring")
+    monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "1")
+    sharded.plan_cache_clear()
+    try:
+        plan = sharded.plan_sharded_search(
+            comms, "brute_force", 512, (0, 512), 4, 5, 5, "xla",
+            merge_mode="ring")
+        assert plan.ring_shift == "pallas_interpret"
+        d_p, i_p = sharded.knn(comms, q, db, k=5, merge_mode="ring")
+        np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_x))
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+    finally:
+        monkeypatch.delenv("RAFT_TPU_PALLAS_INTERPRET", raising=False)
+        sharded.plan_cache_clear()
+        jax.clear_caches()  # drop interpret-mode pallas executables
+
+
+# -------------------------------------------------- plan + dispatch rules
+
+
+def test_merge_dispatch_matrix():
+    # auto on CPU: pow2 -> tree, non-pow2 -> allgather (XOR pairing)
+    assert sharded.merge_dispatch_explained("auto", 8)[:2] == \
+        ("tree", "merge_tree")
+    assert sharded.merge_dispatch_explained("auto", 6)[:2] == \
+        ("allgather", "merge_allgather")
+    assert sharded.merge_dispatch_explained("allgather", 6)[:2] == \
+        ("allgather", "forced")
+    with pytest.raises(ValueError, match="power-of-two"):
+        sharded.merge_dispatch_explained("tree", 6)
+    with pytest.raises(ValueError, match="at least 2"):
+        sharded.merge_dispatch_explained("ring", 1)
+    with pytest.raises(ValueError, match="unknown merge_mode"):
+        sharded.merge_dispatch_explained("bogus", 8)
+
+
+def test_plan_cache_round_trip(comms):
+    sharded.plan_cache_clear()
+    a = sharded.plan_sharded_search(comms, "brute_force", 1000,
+                                    (0, 500, 1000), 16, 10, 10, "xla")
+    b = sharded.plan_sharded_search(comms, "brute_force", 1000,
+                                    (0, 500, 1000), 16, 10, 10, "xla")
+    assert a is b  # cache hit returns the identical frozen plan
+    ep = a.explain_plan()
+    assert ep["merge_mode"] == "tree"
+    assert ep["merge_bytes_tree"] < ep["merge_bytes_allgather"]
+
+
+def test_sharded_search_emits_merge_dispatch_record(comms):
+    from raft_tpu.obs import explain
+
+    rng = np.random.default_rng(6)
+    db = rng.standard_normal((256, 8)).astype(np.float32)
+    q = rng.standard_normal((4, 8)).astype(np.float32)
+    with explain.capture() as cap:
+        sharded.knn(comms, q, db, k=3)
+    recs = [r for r in cap.records if r.family == "sharded_brute_force"]
+    assert recs, "sharded knn must record its merge dispatch"
+    assert recs[-1].engine == "tree"
+    assert recs[-1].reason == "merge_tree"
+    assert recs[-1].plan["merge_mode"] == "tree"
+
+
+# ------------------------------------------- compiled cross-chip bytes
+
+
+def test_tree_merge_compiled_bytes_below_allgather(comms):
+    """ISSUE 12 acceptance: the tree merge's compiled cross-chip receive
+    bytes (parsed from HLO) are strictly below all_gather's at S=8."""
+    from raft_tpu.obs import costs
+
+    got = {}
+    for name, make in costs.sharded_merge_entries(nq=64, kk=16, k=16):
+        e = costs.compile_entry(name, make)
+        assert e.collective_bytes, f"{name}: no collectives parsed"
+        assert e.collective_drift_ratio is not None
+        # the byte planner must stay calibrated (C001 discipline)
+        assert 0.5 <= e.collective_drift_ratio <= 2.0, e.to_dict()
+        got[name.split("@")[0]] = e.collective_bytes
+    assert got["sharded_merge_tree"] < got["sharded_merge_allgather"]
+
+
+# --------------------------------------------- degraded-coverage restore
+
+
+@pytest.mark.slow
+def test_coverage_below_one_restore_unaffected_by_plan_path(comms, tmp_path):
+    # slow: pays the full sharded ivf_pq build compile (~40 s on the
+    # 1-core container); the CI mesh job runs this file unfiltered
+    """The PlacementPlan refactor must not disturb the elastic path: a
+    7/8-coverage restore still searches host-side, excluding dead-shard
+    ids (regression companion to test_faults.py's chaos suite)."""
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((1024, 16)).astype(np.float32)
+    q = rng.standard_normal((4, 16)).astype(np.float32)
+    idx = sharded.build_ivf_pq(
+        comms, db, ivf_pq.IndexParams(n_lists=4, pq_dim=8, kmeans_n_iters=3))
+    prefix = str(tmp_path / "idx")
+    sharded.serialize_ivf_pq(idx, prefix)
+    dead = 5
+    os.remove(f"{prefix}.rank{dead}")
+    el = sharded.deserialize_ivf_pq_elastic(prefix, allow_partial=True)
+    assert el.coverage == 7 / 8
+    _, i = el.search(q, 5, ivf_pq.SearchParams(n_probes=4))
+    ids = np.asarray(i)
+    bounds = sharded.shard_bounds(8, 1024)
+    lo, hi = bounds[dead], bounds[dead + 1]
+    assert not np.any((ids >= lo) & (ids < hi))

@@ -1,0 +1,59 @@
+"""pallas_probe --require-verdicts: the TPU-queue guard that an
+artifact about to be committed actually routes scan_mode/merge_mode
+auto — a missing or errored fused_wins row must fail loudly (exit 2 in
+the tool), never ship as a silent always-XLA routing table."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+import pallas_probe  # noqa: E402
+
+pytestmark = pytest.mark.fast
+
+FULL = {"fused": {
+    "brute_force": {"fused_wins": True, "pallas_ms": 1.0, "xla_ms": 2.0},
+    "ivf_flat": {"fused_wins": False, "pallas_ms": 3.0, "xla_ms": 2.0},
+    "ivf_pq": {"fused_wins": True},
+    "ivf_scan": {"fused_wins": False},
+    "l2_argmin": {"fused_wins": True},
+    "merge_ring": {"fused_wins": True, "ring_ms": 1.0, "tree_ms": 2.0},
+}}
+
+
+def test_complete_artifact_passes():
+    assert pallas_probe.missing_verdicts(FULL, on_tpu=True,
+                                         mergeable_mesh=True) == []
+
+
+def test_single_chip_host_does_not_require_merge_ring():
+    art = {"fused": {k: v for k, v in FULL["fused"].items()
+                     if k != "merge_ring"}}
+    assert pallas_probe.missing_verdicts(art, on_tpu=True,
+                                         mergeable_mesh=False) == []
+    # ...but a pod host must land the merge row
+    assert pallas_probe.missing_verdicts(art, on_tpu=True,
+                                         mergeable_mesh=True) == \
+        ["merge_ring"]
+
+
+def test_missing_and_errored_rows_are_flagged():
+    art = {"fused": dict(FULL["fused"])}
+    del art["fused"]["ivf_pq"]                       # absent row
+    art["fused"]["merge_ring"] = {                   # errored row
+        "pallas_error": "MosaicError: ...", "fused_wins": False}
+    art["fused"]["l2_argmin"] = {"derived_from": "x"}  # verdict-less row
+    got = pallas_probe.missing_verdicts(art, on_tpu=True,
+                                        mergeable_mesh=True)
+    assert got == ["ivf_pq", "l2_argmin", "merge_ring"]
+
+
+def test_off_tpu_host_can_never_mint_verdicts():
+    # scan_mode="pallas" silently falls back off-TPU, so even a
+    # complete-looking artifact is XLA-vs-XLA timings — all required
+    got = pallas_probe.missing_verdicts(FULL, on_tpu=False,
+                                        mergeable_mesh=True)
+    assert got == list(pallas_probe.REQUIRED_VERDICT_FAMILIES) + \
+        ["merge_ring"]

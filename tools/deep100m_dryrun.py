@@ -1,15 +1,20 @@
-"""DEEP-100M shapes-only dry-run + per-chip HBM math (VERDICT r3 #4).
+"""DEEP-100M dry-run: HBM math, shape traces, and the staged build path.
 
 The reference's flagship config is ivf_pq at 100M x 96, nlist=50000,
-pq_dim 64/96 (run/conf/deep-100M.json:252-340). This tool:
+pq_dim 64/96 (run/conf/deep-100M.json:252-340). Stages:
 
-1. computes the per-chip HBM budget of that index sharded over 8/16/32
-   v5e chips (16 GB HBM each): packed codes, decoded-cache alternative,
-   centers/rotation, scan working set at nprobe in {20..5000};
-2. TRACES the sharded LUT search at the FULL per-chip shapes via
-   ``jax.eval_shape`` (shape propagation only - no arrays are ever
-   allocated), proving the SPMD program is well-formed at 100M scale on
-   this machine without 100M rows of anything.
+- ``--stage=shapes`` (default): per-chip HBM budget of that index over
+  8/16/32 v5e chips, plus ``jax.eval_shape`` of the sharded LUT search at
+  FULL per-chip shapes — the SPMD program is well-formed at 100M scale
+  without allocating 100M rows of anything.
+- ``--stage=10m`` / ``--stage=100m``: the REAL pipeline at staged scale —
+  synthesize (or reuse) an on-disk fbin dataset, run the pod-scale build
+  (``sharded.build_ivf_pq_from_file_pod``: one mesh-wide balanced k-means
+  + sharded PQ encode), search over the mesh, and score recall against a
+  CHUNKED ground-truth oracle that streams the file in bounded batches —
+  recall at 100M is verifiable without ever holding the dataset, the
+  distance matrix, or the oracle in memory. Peak RSS is recorded so the
+  workspace-budget claim is checkable from the artifact.
 
 Artifact: DEEP100M_DRYRUN.json.
 """
@@ -18,11 +23,142 @@ import argparse
 import json
 import math
 import os
+import resource
 import sys
+import time
+
+import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 GB = 1 << 30
+
+# staged defaults: (rows, n_lists, max_train_rows); nq/k/n_probes shared
+STAGES = {
+    "10m": (10_000_000, 5_000, 250_000),
+    "100m": (100_000_000, 50_000, 1_000_000),
+}
+
+
+def synth_fbin(path: str, rows: int, dim: int, seed: int = 0,
+               batch_rows: int = 1 << 18, n_modes: int = 1024) -> None:
+    """Write a clustered synthetic fbin dataset batch-by-batch (mixture of
+    ``n_modes`` Gaussians — IVF recall is meaningful, memory stays one
+    batch). Deterministic in (rows, dim, seed)."""
+    rng = np.random.default_rng(seed)
+    modes = (rng.standard_normal((n_modes, dim)) * 4.0).astype(np.float32)
+    with open(path + ".tmp", "wb") as f:
+        np.asarray([rows, dim], np.int32).tofile(f)
+        for start in range(0, rows, batch_rows):
+            b = min(batch_rows, rows - start)
+            lab = rng.integers(0, n_modes, b)
+            x = modes[lab] + rng.standard_normal((b, dim)).astype(
+                np.float32) * 0.6
+            x.astype(np.float32).tofile(f)
+    os.replace(path + ".tmp", path)
+
+
+def synth_queries(path: str, nq: int, seed: int = 1) -> "np.ndarray":
+    """Held-out queries from the same mixture as :func:`synth_fbin`
+    (same mode seed, fresh noise)."""
+    from raft_tpu import native
+
+    _, dim = native.read_bin_header(path)
+    rng = np.random.default_rng(0)  # replay the mode table
+    modes = (rng.standard_normal((1024, dim)) * 4.0).astype(np.float32)
+    qrng = np.random.default_rng(seed)
+    lab = qrng.integers(0, 1024, nq)
+    return (modes[lab] + qrng.standard_normal((nq, dim)).astype(
+        np.float32) * 0.6).astype(np.float32)
+
+
+def chunked_ground_truth(path: str, queries, k: int,
+                         batch_rows: int = 1 << 16, dtype=None):
+    """Exact top-k over the WHOLE file in bounded memory: stream row
+    batches, brute-force each against the queries, fold into a running
+    top-k (select_k over the [nq, 2k] concat — the host-side analog of
+    the cross-chip tree merge). Peak memory is one [nq, batch_rows]
+    distance tile + the [nq, k] carry, independent of file rows."""
+    import jax.numpy as jnp
+    from raft_tpu import native
+    from raft_tpu.ops.distance import DistanceType, pairwise_core
+    from raft_tpu.ops.select_k import select_k
+
+    q = jnp.asarray(np.asarray(queries, np.float32))
+    best_v = best_i = None
+    for start, batch in native.iter_bin_batches_prefetch(
+            path, batch_rows, dtype):
+        d = pairwise_core(q, jnp.asarray(batch, jnp.float32),
+                          DistanceType.L2Expanded, 2.0, 1 << 30)
+        v, i = select_k(d, min(k, d.shape[1]), select_min=True)
+        gi = (i + start).astype(jnp.int32)
+        if best_v is None:
+            best_v, best_i = v, gi
+        else:
+            cat_v = jnp.concatenate([best_v, v], axis=1)
+            cat_i = jnp.concatenate([best_i, gi], axis=1)
+            best_v, sel = select_k(cat_v, min(k, cat_v.shape[1]),
+                                   select_min=True)
+            best_i = jnp.take_along_axis(cat_i, sel, axis=1)
+    return np.asarray(best_v), np.asarray(best_i)
+
+
+def run_stage(args, art: dict) -> None:
+    """The staged build+search+oracle pipeline (see module docstring)."""
+    import jax
+
+    from raft_tpu import native
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.parallel import sharded
+    from raft_tpu.parallel.comms import init_comms
+
+    rows, n_lists, max_train = STAGES[args.stage]
+    if args.rows != 100_000_000:  # explicit --rows overrides stage scale
+        rows = args.rows
+        n_lists = min(n_lists, args.nlist, max(rows // 500, 8))
+        max_train = min(max_train, rows)
+    data = args.data or f"deep_synth_{rows}x{args.dim}.fbin"
+    t = {}
+    t0 = time.time()
+    if not os.path.exists(data):
+        print(f"synthesizing {rows}x{args.dim} -> {data}", flush=True)
+        synth_fbin(data, rows, args.dim)
+    t["synth_s"] = round(time.time() - t0, 1)
+
+    comms = init_comms(jax.devices(), axis="data")
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=args.pq_dim,
+                                kmeans_n_iters=10)
+    t0 = time.time()
+    index = sharded.build_ivf_pq_from_file_pod(
+        comms, data, params, max_train_rows=max_train, scan_mode="lut",
+        batch_rows=args.batch_rows)
+    t["build_s"] = round(time.time() - t0, 1)
+    print(f"pod build: {t['build_s']}s bounds={list(index.bounds)}",
+          flush=True)
+
+    queries = synth_queries(data, args.nq)
+    t0 = time.time()
+    v, i = sharded.search_ivf_pq(
+        index, queries, args.k, ivf_pq.SearchParams(n_probes=args.nprobe))
+    i = np.asarray(i)
+    t["search_s"] = round(time.time() - t0, 1)
+
+    t0 = time.time()
+    _, gt = chunked_ground_truth(data, queries, args.k,
+                                 batch_rows=args.gt_batch_rows)
+    t["oracle_s"] = round(time.time() - t0, 1)
+    recall = float(np.mean([
+        len(set(i[r]) & set(gt[r])) / args.k for r in range(len(gt))]))
+    rss_gb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20)
+    art["stage"] = {
+        "stage": args.stage, "rows": rows, "dim": args.dim,
+        "n_lists": n_lists, "pq_dim": args.pq_dim, "nq": args.nq,
+        "k": args.k, "n_probes": args.nprobe, "recall": round(recall, 4),
+        "timings_s": t, "peak_rss_gb": round(rss_gb, 2),
+        "n_devices": comms.size, "data": data,
+    }
+    print(f"stage={args.stage} recall@{args.k}={recall:.4f} "
+          f"peak_rss={rss_gb:.2f}GB timings={t}", flush=True)
 
 
 def hbm_math(rows: int, dim: int, nlist: int, pq_dim: int, pq_bits: int,
@@ -55,10 +191,19 @@ def hbm_math(rows: int, dim: int, nlist: int, pq_dim: int, pq_bits: int,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="DEEP100M_DRYRUN.json")
+    ap.add_argument("--stage", choices=("shapes", "10m", "100m"),
+                    default="shapes")
     ap.add_argument("--rows", type=int, default=100_000_000)
     ap.add_argument("--dim", type=int, default=96)
     ap.add_argument("--nlist", type=int, default=50_000)
     ap.add_argument("--pq-dim", type=int, default=64)
+    ap.add_argument("--data", default=None,
+                    help="fbin dataset path (synthesized if missing)")
+    ap.add_argument("--nq", type=int, default=512)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--nprobe", type=int, default=100)
+    ap.add_argument("--batch-rows", type=int, default=1 << 18)
+    ap.add_argument("--gt-batch-rows", type=int, default=1 << 16)
     args = ap.parse_args()
 
     os.environ.setdefault("XLA_FLAGS",
@@ -67,6 +212,24 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
+
+    if args.stage in STAGES:
+        art = {"config": vars(args)}
+        run_stage(args, art)
+        # merge into an existing artifact so staged runs accumulate next
+        # to the shapes math instead of clobbering it
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                prev = json.load(f)
+            prev["stage"] = art["stage"]
+            prev[f"stage_{args.stage}"] = art["stage"]
+            art = prev
+        else:
+            art[f"stage_{args.stage}"] = art["stage"]
+        with open(args.out, "w") as f:
+            json.dump(art, f, indent=1)
+        print(f"-> {args.out}")
+        return
 
     art = {"config": vars(args), "hbm": [], "eval_shape": {}}
     for chips in (8, 16, 32):

@@ -18,8 +18,17 @@ the XLA paths, writing PALLAS_PROBE_tpu.json (schema v2):
   verdicts, so THIS FILE is where ``scan_mode="auto"`` routing is
   decided — re-run after kernel or compiler changes.
 
+On a multi-chip (power-of-two) mesh the probe also A/Bs the cross-chip
+merge ladder: the Pallas RDMA ring shift vs the XLA ppermute tree merge
+(``fused.merge_ring.fused_wins`` is what ``merge_mode="auto"`` consults,
+docs/sharding.md). Single-chip hosts write NO merge_ring row, keeping
+``ring_merge_verdict()`` at the three-state "no artifact row".
+
 Usage: python tools/pallas_probe.py [--out PALLAS_PROBE_tpu.json]
        [--n 1000000]  (database rows for the fused A/B grid)
+       [--require-verdicts]  (exit 2 unless every routing family landed
+       a real measured verdict — the TPU-queue guard against silently
+       shipping an artifact that leaves auto unrouted)
 """
 
 import argparse
@@ -31,6 +40,28 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
+
+#: families whose fused_wins verdicts ARE auto-mode routing tables
+REQUIRED_VERDICT_FAMILIES = (
+    "brute_force", "ivf_flat", "ivf_pq", "ivf_scan", "l2_argmin")
+
+
+def missing_verdicts(art: dict, on_tpu: bool, mergeable_mesh: bool) -> list:
+    """Routing families whose artifact row is NOT a real measured
+    verdict: absent, errored, or produced off-TPU (where scan_mode=
+    "pallas" silently falls back and times XLA against itself).
+    ``merge_ring`` is required only where it is measurable — a
+    power-of-two multi-chip mesh."""
+    required = list(REQUIRED_VERDICT_FAMILIES)
+    if mergeable_mesh:
+        required.append("merge_ring")
+    if not on_tpu:
+        return required
+    fused = art.get("fused", {})
+    return [f for f in required
+            if not isinstance(fused.get(f), dict)
+            or "fused_wins" not in fused[f]
+            or "pallas_error" in fused[f]]
 
 
 def _overlap(i_a, i_b, rows: int = 2048) -> float:
@@ -48,6 +79,9 @@ def main():
     ap.add_argument("--out", default="PALLAS_PROBE_tpu.json")
     ap.add_argument("--n", type=int, default=1_000_000,
                     help="database rows for the fused scan+select grid")
+    ap.add_argument("--require-verdicts", action="store_true",
+                    help="exit 2 unless every auto-routing family landed "
+                         "a real measured fused_wins verdict (TPU hosts)")
     args = ap.parse_args()
 
     import jax
@@ -207,6 +241,66 @@ def main():
             for r in l2_rows))}
     print(f"fused l2_argmin: {art['fused']['l2_argmin']}", flush=True)
 
+    # ---- cross-chip merge: Pallas RDMA ring shift vs the XLA ppermute
+    # tree (the merge_mode="auto" routing for sharded searches,
+    # docs/sharding.md). Only measurable on a power-of-two multi-chip
+    # mesh; other hosts write NO row so ring_merge_verdict() stays at
+    # the three-state None ("no_ring_verdict" -> tree).
+    n_dev = len(jax.devices())
+    mergeable = n_dev >= 2 and (n_dev & (n_dev - 1)) == 0
+    if mergeable:
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.parallel import comms as comms_mod
+
+        comms = comms_mod.init_comms(jax.devices(), axis="mergeprobe")
+        nq_m, kk_m = 1024, 100
+        k_out = min(kk_m, n_dev * kk_m)
+        v_g = prepare(rng.standard_normal(
+            (n_dev * nq_m, kk_m)).astype(np.float32))
+        i_g = prepare(rng.integers(
+            0, args.n, (n_dev * nq_m, kk_m)).astype(np.int32))
+        in_sp = (P("mergeprobe", None), P("mergeprobe", None))
+        out_sp = (P(None, None), P(None, None))
+        shift = (functools.partial(pk.pallas_ring_shift, axis="mergeprobe",
+                                   size=n_dev) if on_tpu else None)
+        row = {"n_devices": n_dev, "nq": nq_m, "kk": kk_m}
+        try:
+            ring_fn = jax.jit(comms.run(
+                lambda v, i: comms.ring_topk_merge(v, i, k_out,
+                                                   shift=shift),
+                in_sp, out_sp))
+            tree_fn = jax.jit(comms.run(
+                lambda v, i: comms.tree_topk_merge(v, i, k_out),
+                in_sp, out_sp))
+            rv, ri = ring_fn(v_g, i_g)
+            tv, ti = tree_fn(v_g, i_g)
+            identical = bool(
+                np.array_equal(np.asarray(rv), np.asarray(tv))
+                and np.array_equal(np.asarray(ri), np.asarray(ti)))
+            row["agreement"] = 1.0 if identical else round(
+                _overlap(ri, ti), 5)
+            row["ring_ms"] = round(time_dispatches(
+                lambda: ring_fn(v_g, i_g), iters=5) * 1e3, 2)
+            row["tree_ms"] = round(time_dispatches(
+                lambda: tree_fn(v_g, i_g), iters=5) * 1e3, 2)
+            # the ladder is bit-identical by construction; a mismatch is
+            # a kernel bug and must never earn the routing
+            row["fused_wins"] = bool(on_tpu and identical
+                                     and row["ring_ms"] < row["tree_ms"])
+            if not on_tpu:
+                row["note"] = "xla ring shift (no TPU): not a verdict"
+        except Exception as e:
+            row["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+            row["fused_wins"] = False
+        art["fused"]["merge_ring"] = row
+        print(f"fused merge_ring: {row}", flush=True)
+    else:
+        print(f"merge_ring: not measurable on {n_dev} device(s), "
+              "no row written", flush=True)
+
     # flat mirror for tools/bench_gate.py (its "metrics" document shape):
     # "<section>.<row>.<field>" → number, so queue runs can diff probe
     # rounds with the noise-aware tolerance band. Bools stay out — a
@@ -227,6 +321,20 @@ def main():
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
     print(f"-> {args.out}")
+
+    if args.require_verdicts:
+        missing = missing_verdicts(art, on_tpu, mergeable)
+        if missing:
+            print(f"pallas_probe: REQUIRED VERDICTS MISSING: {missing} — "
+                  "the committed artifact would leave scan_mode/"
+                  "merge_mode auto unrouted (or routed on a stale row). "
+                  + ("Run this on a TPU host." if not on_tpu else
+                     "Fix the errored rows above before committing."),
+                  file=sys.stderr)
+            sys.exit(2)
+        print(f"pallas_probe: all required verdicts present "
+              f"({len(REQUIRED_VERDICT_FAMILIES) + int(mergeable)} "
+              "families)")
 
 
 if __name__ == "__main__":

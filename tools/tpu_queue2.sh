@@ -90,7 +90,8 @@ run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
 # starve the queue.
 run_step pallasbase /tmp/q5_pallasbase.done \
   cp PALLAS_PROBE_tpu.json /tmp/q_pallas_baseline.json
-run_step pallas2 /tmp/q5_pallas2.done timeout 3600 python tools/pallas_probe.py
+run_step pallas2 /tmp/q5_pallas2.done timeout 3600 \
+  python tools/pallas_probe.py --require-verdicts
 run_step pallasgate /tmp/q5_pallasgate.done timeout 600 \
   python tools/bench_gate.py --allow-missing \
   --json /tmp/q_pallasgate_verdicts.json \
@@ -132,6 +133,36 @@ run_step flagship10m2 /tmp/q5_flagship10m2.done env RAFT_TPU_BENCH_PLATFORM=defa
   --nlist 16384 --train-rows 1000000 --data /tmp/flagship_10m.fbin \
   --refine-ratio 4 --probes 32 64 128 256 512 1024 --skip-cagra \
   --out FLAGSHIP_10M_tpu.json
+
+# ---- pod-scale validation (docs/sharding.md): merge ladder + placement
+# plans on the real mesh, then the staged DEEP dryrun. multichip6 runs
+# the full distributed dryrun (collective self-tests, sharded
+# kmeans/knn/ivf with recall gates, merge-mode bit-identity sweep incl.
+# the Pallas RDMA ring) and drops a round-6 artifact; the gate diffs it
+# against the committed round-5 artifact — non-fatal, a drift is a
+# finding for the wrap-up commit.
+run_step multichip6 /tmp/q5_multichip6.done timeout 2400 bash -c '
+  python __graft_entry__.py && python -c "
+import json, jax
+json.dump({\"n_devices\": len(jax.devices()), \"rc\": 0, \"ok\": True,
+           \"skipped\": False, \"tail\": \"\"},
+          open(\"MULTICHIP_tpu_r06.json\", \"w\"), indent=1)"'
+run_step multichipgate /tmp/q5_multichipgate.done timeout 600 \
+  python tools/bench_gate.py --allow-missing \
+  --json /tmp/q_multichipgate_verdicts.json \
+  MULTICHIP_r05.json MULTICHIP_tpu_r06.json
+
+# staged DEEP dryrun: the 10M stage must pass (build + search + chunked
+# exact oracle in bounded host memory) before the 100M stage burns a
+# multi-hour slice; both merge into the same artifact under
+# stage_10m/stage_100m keys.
+run_step deep10m /tmp/q5_deep10m.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 7200 python tools/deep100m_dryrun.py --stage=10m \
+  --data /tmp/deep_synth_10m.fbin --out DEEP100M_DRYRUN_tpu.json
+[ -f /tmp/q5_deep10m.done ] && \
+run_step deep100m /tmp/q5_deep100m.done env RAFT_TPU_BENCH_PLATFORM=default \
+  timeout 21600 python tools/deep100m_dryrun.py --stage=100m \
+  --data /tmp/deep_synth_100m.fbin --out DEEP100M_DRYRUN_tpu.json
 
 # chip-scale baseline targets (BASELINE.md rows)
 run_step targets /tmp/q5_targets.done env RAFT_TPU_BENCH_PLATFORM=default \
