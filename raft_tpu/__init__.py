@@ -14,7 +14,7 @@ instead of hand-rolled CUDA, jax.sharding.Mesh collectives instead of NCCL.
 from raft_tpu.core.resources import Resources
 from raft_tpu import core, ops, cluster, neighbors, parallel, sparse, stats, utils
 from raft_tpu import bench, common, distance, label, matrix, random
-from raft_tpu import serving, solver, spatial, spectral
+from raft_tpu import planner, serving, solver, spatial, spectral
 
 __version__ = "0.1.0"
 
@@ -32,6 +32,7 @@ __all__ = [
     "distance",
     "label",
     "matrix",
+    "planner",
     "random",
     "serving",
     "solver",

@@ -431,6 +431,7 @@ DISPATCH_CALLS = frozenset({
     "raft_tpu.ops.pallas_kernels.fused_dispatch",
     "raft_tpu.ops.pallas_kernels.fused_dispatch_explained",
     "raft_tpu.parallel.sharded.plan_sharded_search",
+    "raft_tpu.planner.adaptive.choose_operating_point",
 })
 #: attribution emitters that satisfy R007 — each produces a reason-coded
 #: ExplainRecord / dispatch-counter increment (or the select_k note)
@@ -438,9 +439,11 @@ ATTRIBUTION_CALLS = frozenset({
     "raft_tpu.obs.explain.record_dispatch",
     "raft_tpu.obs.explain.note_select_k",
     "raft_tpu.parallel.sharded._record_plan",
+    "raft_tpu.planner.adaptive.record_choice",
 })
 #: packages whose dispatch sites must be attributed
-R007_SCOPES = ("raft_tpu.neighbors.", "raft_tpu.ops.", "raft_tpu.parallel.")
+R007_SCOPES = ("raft_tpu.neighbors.", "raft_tpu.ops.", "raft_tpu.parallel.",
+               "raft_tpu.planner.")
 #: the module that DEFINES the dispatch helpers is not a dispatch site
 R007_EXEMPT = frozenset({"raft_tpu.ops.pallas_kernels"})
 
@@ -449,9 +452,11 @@ def rule_unattributed_dispatch(mod: ModuleInfo) -> list:
     """R007: dispatch decision without execution-plan attribution.
 
     A function in ``raft_tpu.neighbors``/``raft_tpu.ops``/
-    ``raft_tpu.parallel`` that consults ``fused_dispatch``/
-    ``fused_dispatch_explained`` (or ``plan_sharded_search`` for the
-    cross-chip merge schedule) is choosing between
+    ``raft_tpu.parallel``/``raft_tpu.planner`` that consults
+    ``fused_dispatch``/``fused_dispatch_explained`` (or
+    ``plan_sharded_search`` for the cross-chip merge schedule, or
+    ``choose_operating_point`` for the adaptive speed/recall policy) is
+    choosing between
     engines — and historically the losing branch fell back *silently*
     (the scan_mode="auto" XLA fallback that motivated the explain layer,
     docs/observability.md). Such a function must also call

@@ -78,6 +78,13 @@ REASONS = frozenset({
     "merge_ring",              # auto on TPU: measured merge_ring win
     "merge_allgather",         # auto: non-power-of-two mesh fallback
     "no_ring_verdict",         # auto on TPU, probe has no merge_ring row
+    # deadline-aware adaptive planning (planner/adaptive.py choice
+    # reasons — emitted with requested="adaptive", engine="planner";
+    # also counted in raft_tpu_adaptive_choice_total{family,reason})
+    "pareto_default",          # highest-recall frontier point fits
+    "deadline_degraded",       # budget forced a lower-recall point
+    "floor_clamped",           # recall floor stopped the degradation
+    "no_frontier",             # no committed points: static params serve
     # schema escape hatch for readers; never emitted by this repo
     "unknown",
 })

@@ -1,0 +1,46 @@
+"""raft_tpu.planner — deadline-aware adaptive query planning.
+
+Turns the telemetry stack (phase latencies, compiled-cost rooflines,
+per-request deadlines, online recall) from observability into control
+(docs/tuning.md "Adaptive planning"):
+
+- :mod:`~raft_tpu.planner.adaptive` — the committed QPS-vs-recall
+  Pareto-frontier artifact (``PARETO_<platform>.json``), the pure
+  :func:`~raft_tpu.planner.adaptive.choose_operating_point` policy, and
+  the EWMA prediction calibration the serving engine feeds from the
+  live device-time histogram;
+- :mod:`~raft_tpu.planner.sweep` — the offline parameter sweep behind
+  ``tools/autotune.py``: per family/shape/k, measure every grid point
+  through the PUBLIC search APIs against an exact oracle and prune to
+  the non-dominated frontier.
+
+Layering: ``adaptive`` is registry-only (no jax import) so the serving
+hot path and the bench_gate tool can load frontiers cheaply; ``sweep``
+imports the neighbor families and is tool/offline territory.
+"""
+
+from raft_tpu.planner.adaptive import (ADAPTIVE_REASONS, PARETO_SCHEMA,
+                                       AdaptivePlanner, Calibration, Choice,
+                                       Frontier, OperatingPoint,
+                                       adaptive_choice_counts,
+                                       choose_operating_point,
+                                       frontier_metrics, hypervolume,
+                                       load_frontier, pareto_prune,
+                                       record_choice)
+
+__all__ = [
+    "ADAPTIVE_REASONS",
+    "PARETO_SCHEMA",
+    "AdaptivePlanner",
+    "Calibration",
+    "Choice",
+    "Frontier",
+    "OperatingPoint",
+    "adaptive_choice_counts",
+    "choose_operating_point",
+    "frontier_metrics",
+    "hypervolume",
+    "load_frontier",
+    "pareto_prune",
+    "record_choice",
+]

@@ -80,6 +80,22 @@ class Request:
         self.trace_id = trace_id
         self.t_admit: Optional[float] = None
 
+    def remaining_ms(self, now: float) -> Optional[float]:
+        """Latency budget left at ``now``, ms — admission + queue time
+        already consumed; None for a request without a deadline. May be
+        negative (past-deadline); THE deadline arithmetic for shed
+        pruning (:meth:`Batcher.select`) and the engine's adaptive
+        operating-point policy, so the two can never disagree."""
+        if self.t_deadline is None:
+            return None
+        return (self.t_deadline - now) * 1e3
+
+    def expired(self, now: float) -> bool:
+        """True when the shed deadline has passed (deadline-less
+        requests never expire)."""
+        rem = self.remaining_ms(now)
+        return rem is not None and rem <= 0.0
+
 
 class Batch:
     """A coalesced, launched batch riding the completion queue.
@@ -175,8 +191,7 @@ class Batcher:
         parked for :meth:`pop_expired`, where the engine fails their
         futures with :class:`DeadlineExceeded`.
         """
-        expired = [r for r in self._queue
-                   if r.t_deadline is not None and now >= r.t_deadline]
+        expired = [r for r in self._queue if r.expired(now)]
         if expired:
             self._queue = [r for r in self._queue if r not in expired]
             self._expired.extend(expired)

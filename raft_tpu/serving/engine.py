@@ -310,6 +310,15 @@ class EngineConfig:
     shadow_seed: int = 0  # deterministic sampling draws (tests)
     slos: Optional[Tuple[object, ...]] = None  # obs.SLO objectives
     slo_window_s: float = 300.0
+    # ---- adaptive planning (docs/tuning.md "Adaptive planning"): an
+    # ``raft_tpu.planner.AdaptivePlanner`` (committed Pareto frontier +
+    # recall floor + live calibration). At batch formation the dispatcher
+    # resolves the batch's operating point from the MINIMUM remaining
+    # deadline of its riders and serves it via Searcher.search_with —
+    # degrading nprobe/itopk under pressure instead of shedding, never
+    # below the planner's recall floor. None (default) serves the
+    # handle's static SearchParams, byte-for-byte the pre-planner path.
+    planner: Optional[object] = None
 
 
 def _default_warm_buckets(max_batch: int) -> Tuple[int, ...]:
@@ -354,6 +363,7 @@ class Engine:
         self._low_watermark = min(max(int(low), 0),
                                   self._high_watermark - 1)
         self._shed_rng = _random.Random(cfg.shed_seed)
+        self.planner = cfg.planner
         self._admission_lock = threading.Lock()
         self._shedding = False  # guarded_by: _admission_lock
         self.breaker = CircuitBreaker(cfg.breaker_cooldown_s, clock)
@@ -455,6 +465,15 @@ class Engine:
             zeros = np.zeros((b, searcher.dim), searcher.query_dtype)
             for k in cfg.warm_ks:
                 fence(searcher.search(zeros, int(k)))
+                if self.planner is None or searcher.search_with is None:
+                    continue
+                # pre-compile every frontier operating point at this
+                # (bucket, k): a deadline-driven param change must never
+                # pay a cold XLA compile on the hot path
+                for point in self.planner.warm_points(
+                        searcher.family, int(k), b):
+                    fence(searcher.search_with(zeros, int(k),
+                                               point.params))
 
     def start(self) -> "Engine":
         """Warm everything, then start the dispatch/completion/watchdog
@@ -1074,11 +1093,20 @@ class Engine:
             meta["pad_copy_ms"] = round((self.clock() - t_pad0) * 1e3, 3)
             call = self._begin_device_call(live, "dispatch", meta)
             try:
-                # execution-plan attribution: every family search
-                # records its dispatch decision into the open capture;
-                # briefs ride batch meta into every rider's span record
+                # execution-plan attribution: the adaptive choice AND
+                # every family search record their decisions into the
+                # open capture; briefs ride batch meta into every
+                # rider's span record
                 with obs_explain.capture() as cap:
-                    d, i = searcher.search(batch, live[0].k)
+                    choice = self._choose_operating_point(
+                        searcher, live, t_launch)
+                    if choice is not None:
+                        meta["adaptive"] = choice.brief()
+                    if choice is not None and choice.point is not None:
+                        d, i = searcher.search_with(
+                            batch, live[0].k, choice.point.params)
+                    else:
+                        d, i = searcher.search(batch, live[0].k)
                 if cap.records:
                     meta["explain"] = cap.briefs()
             finally:
@@ -1096,6 +1124,30 @@ class Engine:
             return
         self._completion.put(Batch(live, d, i, t_launch, bucket, searcher,
                                    meta))
+
+    def _choose_operating_point(self, searcher: Searcher,
+                                live: List[Request], now: float):
+        """Resolve the batch's effective operating point: the planner's
+        policy at the MINIMUM remaining deadline across the riders (the
+        batch serves its most urgent rider's budget — degrade, don't
+        shed). None when no planner is configured or the handle has no
+        adjustable knobs; the choice (point, closed reason, prediction)
+        is attributed by the planner itself and rides ``meta`` into the
+        spans. A raising planner degrades to static params — planning
+        never fails serving."""
+        if self.planner is None or searcher.search_with is None:
+            return None
+        budget_ms: Optional[float] = None
+        for r in live:
+            rem = r.remaining_ms(now)
+            if rem is not None and (budget_ms is None or rem < budget_ms):
+                budget_ms = rem
+        try:
+            return self.planner.choose(
+                searcher.family, int(live[0].k),
+                query_bucket(len(live)), budget_ms)
+        except Exception:
+            return None
 
     def _completion_loop(self) -> None:
         while True:
@@ -1131,6 +1183,15 @@ class Engine:
             meta = dict(b.meta or {})
             meta["device_ms"] = round((t_read0 - b.t_launch) * 1e3, 3)
             meta["readback_ms"] = round((t_read1 - t_read0) * 1e3, 3)
+            # close the calibration loop: measured device time vs the
+            # frontier's (calibrated) prediction for the point that
+            # actually served this batch
+            adaptive = meta.get("adaptive")
+            if (self.planner is not None and adaptive
+                    and adaptive.get("predicted_ms")):
+                with contextlib.suppress(Exception):
+                    self.planner.observe(float(adaptive["predicted_ms"]),
+                                         meta["device_ms"])
             resolved = 0
             for j, r in enumerate(b.requests):
                 # placement breadcrumbs for the exactness oracle

@@ -38,6 +38,17 @@ class Searcher:
     #: (queries_np [n, dim], k) -> (distances, indices) device arrays [n, k]
     search: Callable[[np.ndarray, int], Tuple[jax.Array, jax.Array]]
     query_dtype: np.dtype = np.dtype(np.float32)
+    #: (queries, k, overrides) -> (distances, indices): ``search`` with
+    #: per-call SearchParams overrides — the adaptive planner's hook
+    #: (docs/tuning.md "Adaptive planning"). Overrides are applied onto
+    #: the handle's base params via ``dataclasses.replace`` (unknown
+    #: keys are a typed error, so a stale frontier artifact fails loud);
+    #: the same public wrapper serves, so every exactness/memory-budget
+    #: guarantee of ``search`` carries over. None for handles without
+    #: adjustable knobs (elastic restores).
+    search_with: Optional[
+        Callable[[np.ndarray, int, dict],
+                 Tuple[jax.Array, jax.Array]]] = None
 
     def place(self) -> int:
         """Pin every array attribute of the index on the default device
@@ -67,14 +78,24 @@ def brute_force_searcher(index, res=None, scan_dtype=None,
                          select_recall: float = 1.0) -> Searcher:
     from raft_tpu.neighbors import brute_force
 
+    base = {"scan_dtype": scan_dtype, "refine_ratio": refine_ratio,
+            "select_recall": select_recall, "scan_mode": "auto"}
+
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        kw = dict(base)
+        for name, value in overrides.items():
+            if name not in kw:
+                raise TypeError(
+                    f"brute_force operating point has no knob {name!r} "
+                    f"(knobs: {sorted(kw)})")
+            kw[name] = value
+        return brute_force.search(index, queries, k, res=res, **kw)
+
     def search(queries: np.ndarray, k: int):
-        return brute_force.search(index, queries, k, res=res,
-                                  scan_dtype=scan_dtype,
-                                  refine_ratio=refine_ratio,
-                                  select_recall=select_recall)
+        return search_with(queries, k, {})
 
     return Searcher("brute_force", int(index.dim), index, search,
-                    np.dtype(index.dataset.dtype))
+                    np.dtype(index.dataset.dtype), search_with=search_with)
 
 
 def ivf_flat_searcher(index, params=None, res=None) -> Searcher:
@@ -82,10 +103,16 @@ def ivf_flat_searcher(index, params=None, res=None) -> Searcher:
 
     params = params or ivf_flat.SearchParams()
 
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        p = dataclasses.replace(params, **overrides) if overrides \
+            else params
+        return ivf_flat.search(index, queries, k, p, res=res)
+
     def search(queries: np.ndarray, k: int):
         return ivf_flat.search(index, queries, k, params, res=res)
 
-    return Searcher("ivf_flat", int(index.dim), index, search)
+    return Searcher("ivf_flat", int(index.dim), index, search,
+                    search_with=search_with)
 
 
 def ivf_pq_searcher(index, params=None, res=None) -> Searcher:
@@ -93,10 +120,16 @@ def ivf_pq_searcher(index, params=None, res=None) -> Searcher:
 
     params = params or ivf_pq.SearchParams()
 
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        p = dataclasses.replace(params, **overrides) if overrides \
+            else params
+        return ivf_pq.search(index, queries, k, p, res=res)
+
     def search(queries: np.ndarray, k: int):
         return ivf_pq.search(index, queries, k, params, res=res)
 
-    return Searcher("ivf_pq", int(index.dim), index, search)
+    return Searcher("ivf_pq", int(index.dim), index, search,
+                    search_with=search_with)
 
 
 def cagra_searcher(index, params=None, res=None) -> Searcher:
@@ -104,10 +137,16 @@ def cagra_searcher(index, params=None, res=None) -> Searcher:
 
     params = params or cagra.SearchParams()
 
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        p = dataclasses.replace(params, **overrides) if overrides \
+            else params
+        return cagra.search(index, queries, k, p, res=res)
+
     def search(queries: np.ndarray, k: int):
         return cagra.search(index, queries, k, params, res=res)
 
-    return Searcher("cagra", int(index.dim), index, search)
+    return Searcher("cagra", int(index.dim), index, search,
+                    search_with=search_with)
 
 
 def elastic_searcher(index, params=None, res=None) -> Searcher:

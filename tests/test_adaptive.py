@@ -1,0 +1,499 @@
+"""Deadline-aware adaptive planning tests (docs/tuning.md "Adaptive
+planning").
+
+Pins the acceptance contract of the planner layer:
+
+- ``pareto_prune`` produces a monotone non-dominated frontier,
+  deterministic under input shuffling (the committed artifact must not
+  depend on sweep-log order);
+- ``choose_operating_point`` is pure given (points, budget, floor,
+  scale) and spends the latency budget on recall: generous budget →
+  highest-recall point, tight budget → degrade, floor stops the
+  degradation, no frontier → static params, all with closed reasons;
+- the ``Frontier`` artifact round-trips, rejects foreign schemas, and
+  the committed ``PARETO_cpu.json`` covers all four ANN families;
+- ``Calibration`` is a bounded EWMA that cannot be owned by one sample;
+- every choice is attributed (counter + explain record, closed
+  vocabulary);
+- the Engine policy degrades nprobe/itopk under deadline pressure
+  instead of shedding: at 2x overload, goodput with degradation beats
+  goodput with shed-only at the same recall floor.
+"""
+
+import json
+import os
+import sys
+import types
+import time
+from concurrent.futures import Future
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.obs import explain as obs_explain
+from raft_tpu.planner import adaptive
+from raft_tpu.planner.adaptive import (ADAPTIVE_REASONS, PARETO_SCHEMA,
+                                       AdaptivePlanner, Calibration,
+                                       Frontier, OperatingPoint,
+                                       adaptive_choice_counts,
+                                       choose_operating_point,
+                                       frontier_metrics, hypervolume,
+                                       load_frontier, pareto_prune,
+                                       qps_at_recall, record_choice)
+from raft_tpu.serving.batcher import Request
+from raft_tpu.serving.searchers import Searcher
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+import autotune  # noqa: E402
+
+pytestmark = pytest.mark.fast
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _pt(recall, qps, ms, params=None, bucket=8):
+    return OperatingPoint(params=dict(params or {"n_probes": int(qps)}),
+                          bucket=bucket, qps=float(qps),
+                          recall=float(recall), predicted_ms=float(ms))
+
+
+def _doc(points, family="ivf_flat", k=10, bucket=8, platform="cpu"):
+    fams = {family: {"frontier": {str(k): {
+        str(bucket): [p.to_dict() for p in points]}}}}
+    return {"schema": PARETO_SCHEMA, "platform": platform,
+            "families": fams}
+
+
+# A hand-built frontier: recall down, qps up, predicted time down.
+FRONTIER = [
+    _pt(0.99, 100.0, 40.0, {"n_probes": 64}),
+    _pt(0.95, 400.0, 10.0, {"n_probes": 16}),
+    _pt(0.90, 900.0, 4.0, {"n_probes": 4}),
+]
+
+
+# ------------------------------------------------------------ pareto_prune
+def test_pareto_prune_monotone_and_nondominated():
+    rng = np.random.default_rng(7)
+    pts = [_pt(r, q, 1000.0 / q, {"p": i})
+           for i, (r, q) in enumerate(zip(rng.uniform(0.5, 1.0, 40),
+                                          rng.uniform(10, 1000, 40)))]
+    pruned = pareto_prune(pts)
+    assert pruned
+    for a, b in zip(pruned, pruned[1:]):
+        assert a.recall > b.recall   # recall strictly decreasing
+        assert a.qps < b.qps         # qps strictly increasing
+    # nothing kept is dominated by anything in the input
+    for p in pruned:
+        assert not any(o.recall >= p.recall and o.qps > p.qps
+                       for o in pts)
+    # everything dropped is dominated (or a tie-collapsed duplicate)
+    for p in pts:
+        if p not in pruned:
+            assert any(o.recall >= p.recall and o.qps >= p.qps
+                       for o in pruned)
+
+
+def test_pareto_prune_deterministic_under_shuffle():
+    rng = np.random.default_rng(11)
+    pts = [_pt(r, q, 5.0, {"p": i})
+           for i, (r, q) in enumerate(zip(rng.uniform(0.5, 1.0, 25),
+                                          rng.uniform(10, 1000, 25)))]
+    base = pareto_prune(pts)
+    for seed in range(5):
+        shuffled = list(pts)
+        np.random.default_rng(seed).shuffle(shuffled)
+        assert pareto_prune(shuffled) == base
+    # idempotent: a frontier is its own frontier
+    assert pareto_prune(base) == base
+
+
+def test_pareto_prune_collapses_ties_to_one_representative():
+    a = _pt(0.95, 100.0, 5.0, {"p": 1})
+    b = _pt(0.95, 100.0, 5.0, {"p": 2})
+    pruned = pareto_prune([a, b])
+    assert len(pruned) == 1
+    assert pruned[0].params == {"p": 1}  # deterministic tie-break
+
+
+# --------------------------------------------------- choose_operating_point
+def test_choose_no_points_is_no_frontier():
+    assert choose_operating_point([], 100.0) == (None, "no_frontier")
+
+
+def test_choose_no_budget_takes_highest_recall():
+    p, reason = choose_operating_point(FRONTIER, None)
+    assert (p.recall, reason) == (0.99, "pareto_default")
+
+
+def test_choose_generous_budget_takes_highest_recall():
+    p, reason = choose_operating_point(FRONTIER, 1000.0)
+    assert (p.recall, reason) == (0.99, "pareto_default")
+
+
+def test_choose_tight_budget_degrades():
+    p, reason = choose_operating_point(FRONTIER, 12.0)
+    assert (p.recall, reason) == (0.95, "deadline_degraded")
+    p, reason = choose_operating_point(FRONTIER, 5.0)
+    assert (p.recall, reason) == (0.90, "deadline_degraded")
+
+
+def test_choose_nothing_fits_without_floor_is_fastest_point():
+    p, reason = choose_operating_point(FRONTIER, 1.0)
+    assert (p.recall, reason) == (0.90, "deadline_degraded")
+
+
+def test_choose_floor_stops_degradation():
+    # budget would want the 0.90 point, the floor forbids it
+    p, reason = choose_operating_point(FRONTIER, 5.0, recall_floor=0.95)
+    assert (p.recall, reason) == (0.95, "floor_clamped")
+
+
+def test_choose_floor_above_entire_frontier_clamps_to_best():
+    p, reason = choose_operating_point(FRONTIER, 5.0, recall_floor=0.999)
+    assert (p.recall, reason) == (0.99, "floor_clamped")
+
+
+def test_choose_scale_shifts_the_cutoff():
+    # at scale 1 the 0.95 point (10 ms) fits a 12 ms budget...
+    p, _ = choose_operating_point(FRONTIER, 12.0, scale=1.0)
+    assert p.recall == 0.95
+    # ...at scale 2 its calibrated cost is 20 ms and it no longer does
+    p, reason = choose_operating_point(FRONTIER, 12.0, scale=2.0)
+    assert (p.recall, reason) == (0.90, "deadline_degraded")
+
+
+def test_choose_is_pure_and_reasons_are_closed():
+    for budget in (None, 0.0, 1.0, 12.0, 1e6):
+        first = choose_operating_point(FRONTIER, budget,
+                                       recall_floor=0.9, scale=1.3)
+        for _ in range(3):
+            assert choose_operating_point(
+                FRONTIER, budget, recall_floor=0.9, scale=1.3) == first
+        assert first[1] in ADAPTIVE_REASONS
+
+
+def test_adaptive_reasons_are_a_subset_of_explain_vocabulary():
+    assert ADAPTIVE_REASONS <= obs_explain.REASONS
+
+
+# --------------------------------------------------------- curve summaries
+def test_hypervolume_staircase_area():
+    pts = [_pt(1.0, 10.0, 1.0), _pt(0.5, 100.0, 1.0)]
+    # area: recall 0→0.5 at qps 100, plus 0.5→1.0 at qps 10
+    assert hypervolume(pts) == pytest.approx(0.5 * 100 + 0.5 * 10)
+    # dominated points don't change the curve
+    assert hypervolume(pts + [_pt(0.4, 50.0, 1.0)]) == \
+        pytest.approx(hypervolume(pts))
+
+
+def test_qps_at_recall_bands():
+    assert qps_at_recall(FRONTIER, 0.90) == 900.0
+    assert qps_at_recall(FRONTIER, 0.97) == 100.0
+    assert qps_at_recall(FRONTIER, 0.999) is None
+
+
+def test_frontier_metrics_names_and_values():
+    m = frontier_metrics(_doc(FRONTIER))
+    assert m["pareto.ivf_flat.k10.b8.n_points"] == 3.0
+    assert m["pareto.ivf_flat.k10.b8.qps_at_r90"] == 900.0
+    assert m["pareto.ivf_flat.k10.b8.qps_at_r95"] == 400.0
+    assert m["pareto.ivf_flat.k10.b8.hypervolume"] == pytest.approx(
+        hypervolume(FRONTIER), abs=1e-3)
+    assert "pareto.ivf_flat.k10.b8.qps_at_r99" in m
+
+
+# ------------------------------------------------------------ the artifact
+def test_frontier_round_trip_and_bucket_scaling():
+    doc = _doc(FRONTIER, bucket=8)
+    f = Frontier(doc)
+    assert f.families == ["ivf_flat"]
+    assert f.ks("ivf_flat") == [10]
+    pts = f.points("ivf_flat", 10, 8)
+    assert [p.recall for p in pts] == [0.99, 0.95, 0.90]
+    # nearest-bucket lookup scales predicted_ms linearly by row ratio
+    scaled = f.points("ivf_flat", 10, 16)
+    assert [p.predicted_ms for p in scaled] == [80.0, 20.0, 8.0]
+    assert [p.bucket for p in scaled] == [8, 8, 8]  # provenance kept
+    assert f.points("cagra", 10, 8) == []
+    assert f.points("ivf_flat", 99, 8) == []
+
+
+def test_frontier_rejects_foreign_schema():
+    doc = _doc(FRONTIER)
+    doc["schema"] = "raft_tpu.pareto/v999"
+    with pytest.raises(ValueError, match="schema"):
+        Frontier(doc)
+
+
+def test_load_frontier_missing_file_raises_oserror(tmp_path):
+    with pytest.raises(OSError):
+        load_frontier(str(tmp_path / "nope.json"))
+
+
+def test_committed_artifact_covers_all_families_and_checks_clean():
+    path = REPO_ROOT / "PARETO_cpu.json"
+    assert path.exists(), "commit PARETO_cpu.json via tools/autotune.py"
+    f = load_frontier(str(path))
+    assert f.families == ["brute_force", "cagra", "ivf_flat", "ivf_pq"]
+    for fam in f.families:
+        assert f.points(fam, 10, 8), fam
+    assert autotune.check_artifact(str(path)) == 0
+
+
+def test_check_artifact_rejects_non_monotone_curve(tmp_path):
+    doc = _doc(FRONTIER)
+    # sneak a dominated point into the committed list
+    doc["families"]["ivf_flat"]["frontier"]["10"]["8"].append(
+        _pt(0.5, 1.0, 99.0).to_dict())
+    p = tmp_path / "PARETO_bad.json"
+    p.write_text(json.dumps(doc))
+    assert autotune.check_artifact(str(p)) == 1
+
+
+# ------------------------------------------------------------- calibration
+def test_calibration_ewma_converges_and_is_bounded():
+    c = Calibration(alpha=0.5)
+    assert c.scale == 1.0 and c.n_observed == 0
+    for _ in range(20):
+        c.observe(10.0, 20.0)  # device runs 2x slower than predicted
+    assert c.scale == pytest.approx(2.0, rel=1e-3)
+    assert c.n_observed == 20
+    # one absurd sample is clamped before it enters the EWMA
+    c.observe(1.0, 1e9)
+    assert c.scale <= c.hi
+    # non-positive samples are ignored
+    n = c.n_observed
+    c.observe(0.0, 5.0)
+    c.observe(5.0, -1.0)
+    assert c.n_observed == n
+
+
+def test_calibration_single_sample_cannot_own_the_scale():
+    c = Calibration(alpha=0.2)
+    c.observe(10.0, 10_000.0)  # 1000x blowout, clamped to hi=4
+    assert c.scale == pytest.approx(1.0 + 0.2 * (4.0 - 1.0))
+
+
+# ------------------------------------------------------------- attribution
+def test_record_choice_rejects_open_vocabulary():
+    with pytest.raises(ValueError, match="vocabulary"):
+        record_choice("ivf_flat", "because_reasons")
+
+
+def test_record_choice_bumps_counter_and_rides_captures():
+    before = adaptive_choice_counts().get(("ivf_flat", "deadline_degraded"),
+                                          0)
+    with obs_explain.capture() as cap:
+        record_choice("ivf_flat", "deadline_degraded", point=FRONTIER[1],
+                      budget_ms=12.0, predicted_ms=10.0)
+    after = adaptive_choice_counts()[("ivf_flat", "deadline_degraded")]
+    assert after == before + 1
+    assert len(cap.records) == 1
+    rec = cap.records[0]
+    assert (rec.family, rec.requested, rec.engine) == (
+        "ivf_flat", "adaptive", "planner")
+    assert rec.reason == "deadline_degraded"
+    assert rec.plan["budget_ms"] == 12.0
+
+
+# ------------------------------------------------------------- the planner
+def test_planner_from_missing_artifact_serves_static_params(tmp_path):
+    planner = AdaptivePlanner.from_artifact(str(tmp_path / "nope.json"))
+    choice = planner.choose("ivf_flat", 10, 8, 50.0)
+    assert choice.point is None and choice.reason == "no_frontier"
+
+
+def test_planner_choose_and_observe_close_the_loop():
+    planner = AdaptivePlanner(Frontier(_doc(FRONTIER)), recall_floor=0.9)
+    generous = planner.choose("ivf_flat", 10, 8, 1000.0)
+    assert generous.reason == "pareto_default"
+    assert generous.point.recall == 0.99
+    tight = planner.choose("ivf_flat", 10, 8, 12.0)
+    assert tight.reason == "deadline_degraded"
+    assert tight.point.recall == 0.95
+    # the device consistently runs 3x the prediction: the EWMA learns it
+    for _ in range(30):
+        choice = planner.choose("ivf_flat", 10, 8, 12.0)
+        planner.observe(choice.predicted_ms,
+                        3.0 * choice.point.predicted_ms)
+    assert planner.calibration.scale == pytest.approx(3.0, rel=0.05)
+    # and the same 12 ms budget now degrades one step further
+    recal = planner.choose("ivf_flat", 10, 8, 12.0)
+    assert recal.point.recall == 0.90
+
+
+# ----------------------------------------------------- Request.remaining_ms
+def test_request_remaining_ms_units_and_expiry():
+    req = Request(np.zeros(4, np.float32), 10, Future(), t_submit=1.0,
+                  t_deadline=1.250)
+    assert req.remaining_ms(1.0) == pytest.approx(250.0)
+    assert req.remaining_ms(1.2) == pytest.approx(50.0)
+    assert not req.expired(1.2499)
+    assert req.expired(1.2501)
+    bare = Request(np.zeros(4, np.float32), 10, Future(), t_submit=1.0)
+    assert bare.remaining_ms(99.0) is None
+    assert not bare.expired(99.0)
+
+
+# -------------------------------------------------------- Engine policy
+HI_MS, LO_MS = 40.0, 2.0
+STUB_DIM, STUB_K = 8, 5
+
+
+def _stub_searcher(counts=None):
+    """A Searcher whose device cost is the operating point: ``search``
+    (the static path) costs HI_MS, ``search_with`` costs the point's
+    ``cost_ms`` knob — so the policy's choices are directly observable
+    as wall time."""
+    counts = counts if counts is not None else {}
+
+    def _result(n, k):
+        return (np.zeros((n, k), np.float32),
+                np.zeros((n, k), np.int32))
+
+    def search_with(queries, k, overrides):
+        cost = float(overrides.get("cost_ms", HI_MS))
+        time.sleep(cost * 1e-3)
+        counts[cost] = counts.get(cost, 0) + 1
+        return _result(len(queries), k)
+
+    def search(queries, k):
+        return search_with(queries, k, {})
+
+    return Searcher("ivf_flat", STUB_DIM, types.SimpleNamespace(),
+                    search, search_with=search_with)
+
+
+def _stub_frontier():
+    return Frontier(_doc([
+        _pt(1.0, 100.0, HI_MS, {"cost_ms": HI_MS}, bucket=4),
+        _pt(0.90, 2000.0, LO_MS, {"cost_ms": LO_MS}, bucket=4),
+    ], k=STUB_K, bucket=4))
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, rec):
+        self.records.append(rec)
+
+
+def _engine(searcher, planner=None, sink=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_us", 1000)
+    kw.setdefault("warm_ks", (STUB_K,))
+    kw.setdefault("warm_buckets", (4,))
+    kw.setdefault("hang_timeout_s", None)
+    return serving.Engine(searcher, serving.EngineConfig(
+        planner=planner, span_sink=sink, **kw))
+
+
+def test_engine_generous_deadline_serves_highest_recall_point():
+    counts = {}
+    sink = _ListSink()
+    planner = AdaptivePlanner(_stub_frontier(), recall_floor=0.9)
+    before = adaptive_choice_counts().get(("ivf_flat", "pareto_default"), 0)
+    with _engine(_stub_searcher(counts), planner, sink) as eng:
+        d, i = eng.search(np.zeros(STUB_DIM, np.float32), STUB_K,
+                          deadline_ms=5000.0)
+    assert d.shape == (STUB_K,)
+    assert adaptive_choice_counts()[("ivf_flat", "pareto_default")] > before
+    briefs = [r["adaptive"] for r in sink.records
+              if r.get("kind") == "request" and "adaptive" in r]
+    assert briefs and briefs[-1]["reason"] == "pareto_default"
+    assert briefs[-1]["params"] == {"cost_ms": HI_MS}
+
+
+def test_engine_tight_deadline_degrades_instead_of_shedding():
+    counts = {}
+    sink = _ListSink()
+    planner = AdaptivePlanner(_stub_frontier(), recall_floor=0.9)
+    with _engine(_stub_searcher(counts), planner, sink) as eng:
+        # 25 ms budget < HI_MS: the static engine would serve this late
+        # (or shed it under load); the planner drops to the LO point
+        d, i = eng.search(np.zeros(STUB_DIM, np.float32), STUB_K,
+                          deadline_ms=25.0)
+    assert d.shape == (STUB_K,)
+    briefs = [r["adaptive"] for r in sink.records
+              if r.get("kind") == "request" and "adaptive" in r]
+    assert briefs and briefs[-1]["reason"] == "deadline_degraded"
+    assert briefs[-1]["params"] == {"cost_ms": LO_MS}
+    # the LO program actually served (warmup used both)
+    assert counts.get(LO_MS, 0) >= 1
+
+
+def test_engine_without_frontier_serves_static_params_attributed():
+    sink = _ListSink()
+    planner = AdaptivePlanner(frontier=None)
+    before = adaptive_choice_counts().get(("ivf_flat", "no_frontier"), 0)
+    with _engine(_stub_searcher(), planner, sink) as eng:
+        d, i = eng.search(np.zeros(STUB_DIM, np.float32), STUB_K)
+    assert d.shape == (STUB_K,)
+    assert adaptive_choice_counts()[("ivf_flat", "no_frontier")] > before
+    briefs = [r["adaptive"] for r in sink.records
+              if r.get("kind") == "request" and "adaptive" in r]
+    assert briefs and briefs[-1]["reason"] == "no_frontier"
+    assert "params" not in briefs[-1]
+
+
+def _drive_overload(eng, n, deadline_ms):
+    """Burst-submit ``n`` requests (2x+ the deadline-window capacity at
+    the HI cost) and count served vs shed."""
+    futures = []
+    for _ in range(n):
+        futures.append(eng.submit(np.zeros(STUB_DIM, np.float32), STUB_K,
+                                  deadline_ms=deadline_ms))
+    ok = shed = 0
+    for f in futures:
+        try:
+            f.result(timeout=30.0)
+            ok += 1
+        except Exception:
+            shed += 1
+    return ok, shed
+
+
+def test_engine_overload_goodput_degradation_beats_shedding():
+    # 36 requests x HI_MS=40 ms at max_batch=4 is ~360 ms of device time
+    # against a 150 ms deadline — ~2.4x overload. The shed-only engine
+    # serves the first few batches and sheds the rest; the adaptive
+    # engine degrades to the LO point (recall 0.90 = the floor) as the
+    # budget tightens and serves (nearly) everything.
+    n, deadline_ms = 36, 150.0
+
+    with _engine(_stub_searcher()) as shed_eng:
+        shed_ok, shed_shed = _drive_overload(shed_eng, n, deadline_ms)
+
+    planner = AdaptivePlanner(_stub_frontier(), recall_floor=0.9)
+    before = dict(adaptive_choice_counts())
+    with _engine(_stub_searcher(), planner) as ada_eng:
+        ada_ok, ada_shed = _drive_overload(ada_eng, n, deadline_ms)
+
+    assert shed_shed > 0  # the baseline really was overloaded
+    assert ada_ok > shed_ok  # degradation strictly beats shedding
+    assert ada_ok >= int(0.6 * n)
+    # the policy visibly degraded, and every reason stayed closed
+    after = adaptive_choice_counts()
+    degraded = after.get(("ivf_flat", "deadline_degraded"), 0) - \
+        before.get(("ivf_flat", "deadline_degraded"), 0)
+    assert degraded >= 1
+    for (_, reason), _cnt in after.items():
+        assert reason in ADAPTIVE_REASONS
+    # degradation never went below the floor: the only points served
+    # carry recall >= 0.9 by construction of the frontier
+    assert planner.recall_floor == 0.9
+
+
+def test_engine_calibration_observes_completed_batches():
+    planner = AdaptivePlanner(_stub_frontier(), recall_floor=0.9)
+    assert planner.calibration.n_observed == 0
+    with _engine(_stub_searcher(), planner) as eng:
+        for _ in range(3):
+            eng.search(np.zeros(STUB_DIM, np.float32), STUB_K,
+                       deadline_ms=5000.0)
+    assert planner.calibration.n_observed >= 1
+    assert 0.25 <= planner.calibration.scale <= 4.0

@@ -145,3 +145,58 @@ def test_main_writes_verdict_json(tmp_path, capsys):
     assert doc["n_repeats"] == 1
     assert doc["verdicts"][0]["verdict"] == "improved"
     assert "1 improved" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------- frontier kind
+def _pareto_doc(curves):
+    """``curves``: {family: [(recall, qps), ...]} → a minimal
+    raft_tpu.pareto/v1 doc (k=10, bucket=8)."""
+    fams = {}
+    for fam, pts in curves.items():
+        fams[fam] = {"frontier": {"10": {"8": [
+            {"params": {"n_probes": i}, "bucket": 8, "qps": q,
+             "recall": r, "predicted_ms": 8.0 / q * 1e3}
+            for i, (r, q) in enumerate(pts)]}}}
+    return {"schema": "raft_tpu.pareto/v1", "platform": "cpu",
+            "families": fams}
+
+
+def test_flatten_frontier_yields_curve_summaries_not_points():
+    flat = bench_gate.flatten_metrics(
+        _pareto_doc({"ivf_flat": [(0.99, 100.0), (0.90, 900.0)]}))
+    assert flat["pareto.ivf_flat.k10.b8.n_points"] == 2.0
+    assert flat["pareto.ivf_flat.k10.b8.qps_at_r90"] == 900.0
+    assert flat["pareto.ivf_flat.k10.b8.hypervolume"] > 0
+    # no per-point metric leaks out — points may move freely on re-sweep
+    assert not any("n_probes" in k or "predicted_ms" in k for k in flat)
+
+
+def test_gate_frontier_pass_on_moved_points_same_curve(tmp_path):
+    base = _write(tmp_path, "pareto_base.json",
+                  _pareto_doc({"ivf_flat": [(0.99, 100.0), (0.90, 900.0)]}))
+    # a re-sweep found a different but equivalent frontier: an extra
+    # mid-curve point, slight point movement within tolerance
+    cand = _write(tmp_path, "pareto_cand.json",
+                  _pareto_doc({"ivf_flat": [(0.99, 101.0), (0.95, 400.0),
+                                            (0.90, 905.0)]}))
+    assert bench_gate.main([base, cand, "--allow-missing"]) == 0
+
+
+def test_gate_frontier_fails_on_degraded_curve(tmp_path):
+    base = _write(tmp_path, "pareto_base.json",
+                  _pareto_doc({"ivf_flat": [(0.99, 100.0), (0.90, 900.0)]}))
+    # the high-recall end got 40% slower: hypervolume + qps_at_r99 drop
+    worse = _write(tmp_path, "pareto_worse.json",
+                   _pareto_doc({"ivf_flat": [(0.99, 60.0), (0.90, 900.0)]}))
+    assert bench_gate.main([base, worse]) == 1
+
+
+def test_gate_frontier_recomputes_ignoring_stale_mirror(tmp_path):
+    # an embedded metrics mirror claiming a better curve must not mask
+    # the regression — the gate recomputes from the points
+    doc = _pareto_doc({"ivf_flat": [(0.99, 60.0)]})
+    doc["metrics"] = {"pareto.ivf_flat.k10.b8.qps_at_r99": 100.0}
+    base = _write(tmp_path, "pareto_base.json",
+                  _pareto_doc({"ivf_flat": [(0.99, 100.0)]}))
+    lying = _write(tmp_path, "pareto_lying.json", doc)
+    assert bench_gate.main([base, lying, "--allow-missing"]) == 1

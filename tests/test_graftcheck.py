@@ -143,12 +143,14 @@ def test_r007_repo_dispatch_sites_are_all_attributed():
     import raft_tpu.neighbors as npkg
     import raft_tpu.ops as opkg
     import raft_tpu.parallel as ppkg
+    import raft_tpu.planner as plpkg
     from raft_tpu.analysis.rules_ast import DISPATCH_CALLS
     findings, seen_dispatch = [], 0
     seen_by_prefix = {}
     for pkg, prefix in ((npkg, "raft_tpu.neighbors"),
                         (opkg, "raft_tpu.ops"),
-                        (ppkg, "raft_tpu.parallel")):
+                        (ppkg, "raft_tpu.parallel"),
+                        (plpkg, "raft_tpu.planner")):
         pkg_dir = os.path.dirname(pkg.__file__)
         for fn in sorted(os.listdir(pkg_dir)):
             if not fn.endswith(".py"):
@@ -173,6 +175,9 @@ def test_r007_repo_dispatch_sites_are_all_attributed():
     # the sharded search entry points (knn / cagra / ivf_pq / ivf_flat)
     # each plan their merge schedule through plan_sharded_search
     assert seen_by_prefix.get("raft_tpu.parallel", 0) >= 3
+    # AdaptivePlanner.choose resolves the speed/recall operating point
+    # through choose_operating_point (attributed via record_choice)
+    assert seen_by_prefix.get("raft_tpu.planner", 0) >= 1
 
 
 def test_layering_flags_cross_package_private_import():

@@ -24,6 +24,17 @@ Accepts the repo's bench artifact shapes: the ``tpu_queue`` wrapper
 ``{"metrics": {name: value}}`` document, or a ``.log`` file whose last
 JSON-parseable line contains ``"metric"``.
 
+Frontier kind: a document whose ``schema`` is ``raft_tpu.pareto/*``
+(the committed ``PARETO_<platform>.json`` autotune artifacts) is
+compared as a CURVE, not pointwise — per (family, k, bucket) frontier
+the gate scores the hypervolume and the best-QPS per recall band
+(``pareto.<fam>.k<k>.b<b>.hypervolume`` / ``.qps_at_r<band>``, both
+higher-better). Individual operating points may move, appear, or
+vanish freely across a re-sweep; only a shrinking dominated area or a
+QPS loss at a recall band gates. The summaries are recomputed from the
+points themselves (``raft_tpu.planner.adaptive.frontier_metrics``) so
+a stale embedded mirror cannot mask a curve regression.
+
 Exit status: 0 all gated metrics flat/improved; 1 any ``regressed`` (or
 ``missing`` without ``--allow-missing``); 2 usage/parse errors.
 
@@ -46,7 +57,7 @@ DEFAULT_TOLERANCE = 0.05
 
 #: metric-name suffix/token → direction. Longest match wins; tokens are
 #: matched against '.'-and-'_'-split pieces of the metric name.
-_HIGHER = ("qps", "recall", "rows_per_s", "throughput")
+_HIGHER = ("qps", "recall", "rows_per_s", "throughput", "hypervolume")
 _LOWER = ("latency_ms", "latency_ms_b1", "latency_ms_b10", "mean_ms",
           "p50_ms", "p99_ms", "build_s", "build_warm_s", "warm_s",
           "wall_s", "fit_s", "chained_ms")
@@ -76,11 +87,36 @@ def _payload(doc: dict) -> dict:
     return doc
 
 
+def _flatten_frontier(p: dict) -> dict:
+    """Pareto-frontier doc → curve summaries (the ``frontier`` artifact
+    kind). Recomputed from the points via the planner's own summary code
+    when importable; the artifact's embedded ``metrics`` mirror is the
+    fallback (identical by construction — tools/autotune.py writes the
+    mirror with the same function)."""
+    try:
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from raft_tpu.planner.adaptive import frontier_metrics
+        return {k: float(v) for k, v in frontier_metrics(p).items()}
+    except Exception:
+        metrics = p.get("metrics")
+        if isinstance(metrics, dict):
+            return {str(k): float(v) for k, v in metrics.items()
+                    if isinstance(v, (int, float))}
+        return {}
+
+
 def flatten_metrics(doc: dict) -> dict:
     """Bench doc → ``{metric_name: float}``. The top-level metric keeps
-    its own name; per-family ``extra`` entries become ``family.field``."""
+    its own name; per-family ``extra`` entries become ``family.field``.
+    Frontier docs (``schema: raft_tpu.pareto/*``) flatten to their curve
+    summaries instead — see :func:`_flatten_frontier`."""
     out: dict = {}
     p = _payload(doc)
+    if str(p.get("schema", "")).startswith("raft_tpu.pareto/"):
+        return _flatten_frontier(p)
     if isinstance(p.get("metrics"), dict):  # flat mini-bench document
         for k, v in p["metrics"].items():
             if isinstance(v, (int, float)):

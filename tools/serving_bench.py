@@ -22,6 +22,15 @@ Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
   must stay within ~2x of the at-capacity p99 instead of diverging with
   the queue. Every shed is a typed rejection (Overloaded / QueueFull /
   DeadlineExceeded); an untyped wait-timeout fails the run.
+- ``adaptive``: the same 2x overload against an engine with an
+  ``raft_tpu.planner.AdaptivePlanner`` (the committed
+  ``PARETO_<platform>.json``, or an inline mini sweep when the platform
+  has none): batches degrade nprobe/itopk to fit their riders' remaining
+  deadlines instead of shedding — goodput must meet or beat the
+  shed-only baseline while shadow-sampled online recall stays at or
+  above the ``--recall-floor``, with every operating-point choice
+  attributed in ``raft_tpu_adaptive_choice_total`` (``--no-adaptive``
+  skips the arm).
 
 Telemetry (docs/observability.md): every engine in the bench runs with a
 span sink writing ``<out>.spans.jsonl`` (one record per request with its
@@ -248,6 +257,70 @@ def bench_overload(engine, queries, k, rate_qps, n_requests, rng,
     return row
 
 
+def make_planner(family, k, db, queries, artifact_path, recall_floor,
+                 res):
+    """AdaptivePlanner for the adaptive-overload arm: the committed
+    ``PARETO_<platform>.json`` when it covers (family, k), else an
+    inline mini sweep on the bench's own data (CI machines without a
+    committed artifact for their platform still measure the policy)."""
+    from raft_tpu.planner import (AdaptivePlanner, Frontier,
+                                  sweep as planner_sweep)
+
+    planner = AdaptivePlanner.from_artifact(artifact_path,
+                                            recall_floor=recall_floor)
+    if planner.frontier is not None and planner.warm_points(family, int(k)):
+        return planner, f"artifact:{artifact_path}"
+    fam = planner_sweep.sweep_family(family, db, queries[:64], [int(k)],
+                                     [8, 64], mini=True, res=res)
+    doc = planner_sweep.build_artifact("inline", {family: fam})
+    return AdaptivePlanner(Frontier(doc),
+                           recall_floor=recall_floor), "inline_mini_sweep"
+
+
+def bench_adaptive_overload(searcher, overload_cfg, planner, queries, k,
+                            rate_qps, n_requests, rng, deadline_ms,
+                            oracle, shadow_rate=0.25):
+    """The degrade-instead-of-shed arm: the same Poisson overload as
+    :func:`bench_overload`, against an engine whose batches resolve
+    their operating point from the riders' remaining deadlines
+    (docs/serving.md "Degradation vs shedding"). Shadow sampling grades
+    the degraded answers online, so the row carries proof that goodput
+    was not bought below the recall floor."""
+    import dataclasses as _dc
+
+    from raft_tpu import serving
+    from raft_tpu.planner.adaptive import adaptive_choice_counts
+
+    before = dict(adaptive_choice_counts())
+    cfg = _dc.replace(overload_cfg, planner=planner,
+                      shadow_oracle=oracle, shadow_sample_rate=shadow_rate,
+                      shadow_deadline_ms=30_000.0, shadow_queue_limit=256)
+    engine = serving.Engine(searcher, cfg)
+    engine.start()
+    try:
+        over = bench_overload(engine, queries, k, rate_qps, n_requests,
+                              rng, deadline_ms=deadline_ms)
+    finally:
+        engine.stop()
+    choices = {}
+    for (fam, reason), n in adaptive_choice_counts().items():
+        delta = n - before.get((fam, reason), 0)
+        if fam == searcher.family and delta:
+            choices[reason] = delta
+    online = None
+    if engine.shadow is not None:
+        est = engine.shadow.estimator.snapshot()
+        n_total = sum(n for n, _ in est.values())
+        if n_total:
+            online = round(sum(n * mean for n, mean in est.values())
+                           / n_total, 4)
+    over["choices"] = choices
+    over["online_recall"] = online
+    over["recall_floor"] = planner.recall_floor
+    over["calibration_scale"] = round(planner.calibration.scale, 4)
+    return over
+
+
 class _TaggedSink:
     """Stamps every span record with the family before forwarding, so
     one spans file serves the whole bench and reads back per-family."""
@@ -421,6 +494,16 @@ def main():
     ap.add_argument("--shadow-tolerance", type=float, default=0.02,
                     help="max |online - offline| recall gap gated for "
                          "ivf_flat / ivf_pq")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="skip the adaptive (degrade-vs-shed) overload "
+                         "arm")
+    ap.add_argument("--pareto", default=None,
+                    help="committed Pareto artifact for the adaptive arm "
+                         "(default PARETO_<platform>.json next to this "
+                         "script's repo; missing -> inline mini sweep)")
+    ap.add_argument("--recall-floor", type=float, default=0.9,
+                    help="adaptive arm: degradation never picks a point "
+                         "below this recall")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -452,6 +535,10 @@ def main():
         max_inflight=args.max_inflight, warm_ks=(args.k,))
     spans_path = args.spans if args.spans is not None \
         else out_path + ".spans.jsonl"
+    # JsonlSink appends; the reconciliation below assumes this run's
+    # spans only, so a leftover file from a prior run must not survive
+    if spans_path and os.path.exists(spans_path):
+        os.remove(spans_path)
     spans_sink = obs_spans.JsonlSink(spans_path) if spans_path else None
     art = {
         "platform": platform,
@@ -566,6 +653,59 @@ def main():
             finally:
                 ov_engine.stop()
             completed_total += ov_engine.stats.n_completed
+
+            if not args.no_adaptive and deadline_ms is not None:
+                # degrade-vs-shed: same 2x Poisson overload + deadlines,
+                # but the engine spends each batch's remaining budget on
+                # recall instead of serving static params and shedding
+                pareto_path = args.pareto or os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    f"PARETO_{platform}.json")
+                planner, source = make_planner(
+                    family, args.k, db, queries, pareto_path,
+                    args.recall_floor, res)
+                factor = (2.0 if 2.0 in args.overload_factors
+                          else args.overload_factors[0])
+                ada = bench_adaptive_overload(
+                    searcher, overload_cfg, planner, queries, args.k,
+                    factor * cap, args.overload_queries, rng,
+                    deadline_ms, make_exact_oracle(db))
+                shed_run = next(
+                    (r for r in row["overload"]["runs"]
+                     if r.get("factor") == factor), None)
+                ada["factor"] = factor
+                ada["frontier_source"] = source
+                if shed_run is not None:
+                    ada["goodput_vs_shed_only"] = round(
+                        ada["goodput_qps"]
+                        / max(shed_run["goodput_qps"], 1e-9), 3)
+                row["overload"]["adaptive"] = ada
+                completed_total += ada["served"]
+                print(f"  adaptive @{factor}x: goodput="
+                      f"{ada['goodput_qps']} qps "
+                      f"({ada.get('goodput_vs_shed_only')}x shed-only), "
+                      f"online recall {ada['online_recall']} "
+                      f"(floor {args.recall_floor}), "
+                      f"choices={ada['choices']}", flush=True)
+                # every decision is visible, never below the floor
+                assert sum(ada["choices"].values()) > 0, (
+                    "adaptive arm ran but no choice was attributed")
+                if (family in ("ivf_flat", "ivf_pq")
+                        and ada["online_recall"] is not None):
+                    assert ada["online_recall"] >= args.recall_floor \
+                        - args.shadow_tolerance, (
+                        f"adaptive goodput bought below the floor: "
+                        f"online recall {ada['online_recall']} < "
+                        f"{args.recall_floor}")
+                if (family in ("ivf_flat", "ivf_pq")
+                        and shed_run is not None
+                        and shed_run["shed_rate"] > 0.05):
+                    assert ada["goodput_qps"] >= shed_run["goodput_qps"], (
+                        f"degradation goodput {ada['goodput_qps']} < "
+                        f"shed-only {shed_run['goodput_qps']} at "
+                        f"{factor}x — the adaptive policy is not "
+                        f"paying for itself")
 
         if spans_sink is not None:
             # consume the span file back: the ok spans must reconcile

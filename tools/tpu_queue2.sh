@@ -106,6 +106,22 @@ run_step explainhist /tmp/q5_explainhist.done timeout 1200 \
   python tools/explain.py --family all --n 100000 --out EXPLAIN_tpu.json
 run_step aot /tmp/q5_aot.done timeout 1800 python tools/aot_cache_probe.py
 
+# adaptive-planning Pareto frontier on the real chip (docs/tuning.md
+# "Adaptive planning"): stash the committed artifact, re-sweep the knob
+# grid through the public search APIs, then diff the CURVES (hypervolume
+# + per-recall-band QPS; points move freely across a re-sweep) with the
+# frontier-aware gate — non-fatal like pallasgate: a shrinking frontier
+# is a finding for the wrap-up commit, not a reason to starve the queue.
+run_step paretobase /tmp/q5_paretobase.done bash -c \
+  '[ -f PARETO_tpu.json ] && cp PARETO_tpu.json /tmp/q_pareto_baseline.json || true'
+run_step autotune /tmp/q5_autotune.done timeout 3600 \
+  python tools/autotune.py --out PARETO_tpu.json
+run_step paretogate /tmp/q5_paretogate.done bash -c \
+  '[ -f /tmp/q_pareto_baseline.json ] && timeout 600 \
+   python tools/bench_gate.py --allow-missing \
+   --json /tmp/q_paretogate_verdicts.json \
+   /tmp/q_pareto_baseline.json PARETO_tpu.json || true'
+
 # micro-batching serving engine: closed-loop QPS vs the sequential-b1
 # baseline + open-loop tail latency at Poisson load (docs/serving.md) —
 # quick; exactness cross-check against solo search is on by default
