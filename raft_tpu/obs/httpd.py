@@ -4,8 +4,12 @@ One ``ThreadingHTTPServer`` on a daemon thread per :class:`MetricsServer`
 — no framework, no dependency, good enough for a scraper hitting it a
 few times a minute. The serving :class:`~raft_tpu.serving.engine.Engine`
 owns one when ``EngineConfig.metrics_port`` is set (or via
-``Engine.serve_metrics()``); anything else with a registry and an
-optional health callable can run one too.
+``Engine.serve_metrics()``); a :class:`~raft_tpu.serving.fleet.Fleet`
+runs one as the SINGLE scrape target for all its replicas
+(``Fleet.serve_metrics()`` — the shared registry at ``/metrics`` and
+the aggregated ``Fleet.health()`` at ``/healthz``, so 503 means "below
+quorum", not "one replica sneezed"); anything else with a registry and
+an optional health callable can run one too.
 
 Routes:
 
@@ -14,6 +18,8 @@ Routes:
 - ``GET /healthz``  → JSON health doc; 200 for ``ok``/``degraded``
   (alive but shedding is still alive), 503 for anything else — the
   TPU_RUNBOOK pre-flight curls this before pointing traffic at a host.
+  Fleet-backed servers aggregate: ``"degraded"`` while any replica is
+  degraded/draining but quorum holds, ``"unhealthy"`` below quorum.
 - ``GET /debug/bundle`` → a freshly-built flight-recorder diagnostics
   bundle (``bundle_fn``, typically ``Engine.dump_diagnostics`` — the
   span tape + registry snapshot + health + config in one JSON doc);

@@ -1,4 +1,4 @@
-"""raft_tpu.serving — async micro-batching serving engine.
+"""raft_tpu.serving — async micro-batching serving engine + replica fleet.
 
 Coalesces concurrent single-query searches into AOT-warmed
 ``query_bucket`` batch shapes in front of every index family
@@ -16,21 +16,63 @@ Quick start::
         fut = eng.submit(query, k=10)        # -> concurrent.futures.Future
         distances, indices = fut.result()    # rows, bit-identical to solo
 
+Scale past one replica with the fleet (docs/serving.md "Fleet")::
+
+    with serving.Fleet.from_searchers(
+            [searcher_a, searcher_b, searcher_c],
+            config=serving.FleetConfig(quorum=2)) as fleet:
+        d, i = fleet.search(query, k=10, deadline_ms=50.0)
+
+Typed-failure hierarchy — classify by ``isinstance``, never by string
+matching. Retryability below is what the fleet's router enforces
+(:func:`raft_tpu.serving.router.is_retryable`): "retryable" means a
+sibling replica could plausibly answer where this one failed.
+
+====================  ===================  =========  ====================
+exception             base                 retryable  raised when
+====================  ===================  =========  ====================
+``BatchFailed``       ``RuntimeError``     yes        one batch's device
+                                                      call failed/hung;
+                                                      cause on ``.cause``
+``Overloaded``        ``RuntimeError``     yes        admission shed
+                                                      (watermark/ramp)
+``CircuitOpen``       ``Overloaded``       yes        breaker open after
+                                                      a device hang
+``QueueFull``         ``RuntimeError``     yes        ``block=False`` and
+                                                      queue at capacity
+``EngineStopped``     ``RuntimeError``     yes        replica stopped —
+                                                      the fleet case
+``DeadlineExceeded``  ``RuntimeError``     no         the rider's budget
+                                                      is spent; no
+                                                      sibling un-spends
+                                                      it
+``IntegrityError``    ``RaftError``        no         corrupt checkpoint
+                                                      / index bytes —
+                                                      retrying re-serves
+                                                      the corruption
+====================  ===================  =========  ====================
+
 Overload & failure semantics (docs/serving.md): per-request
 ``deadline_ms`` shed (``DeadlineExceeded``), watermark admission control
 (``Overloaded``), per-batch failure containment (``BatchFailed``), a
 hang watchdog + circuit breaker (``CircuitOpen``, ``Engine.health()``),
-and zero-downtime ``Engine.swap_index``. Chaos-tested in
-tests/test_serving_chaos.py with the injectors in
-``raft_tpu.testing.faults``.
+zero-downtime ``Engine.swap_index``, and fleet-level sibling retries +
+quorum-gated rolling upgrades (``Fleet.rolling_swap``). Chaos-tested in
+tests/test_serving_chaos.py and tests/test_fleet_chaos.py with the
+injectors in ``raft_tpu.testing.faults``.
 """
 
+from raft_tpu.core.errors import IntegrityError
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
                                       EngineStopped, QueueFull, Request)
 from raft_tpu.serving.engine import (BatchFailed, CircuitBreaker,
                                      CircuitOpen, Engine, EngineConfig,
                                      Overloaded, compile_count,
                                      solo_reference, verify_bit_identity)
+from raft_tpu.serving.fleet import Fleet, FleetConfig, Replica
+from raft_tpu.serving.router import (FleetBelowQuorum, NoReplicaAvailable,
+                                     RetriesExhausted, RetryPolicy,
+                                     Router, failure_kind, is_retryable)
 from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
                                         cagra_searcher, elastic_searcher,
                                         ivf_flat_searcher,
@@ -47,15 +89,26 @@ __all__ = [
     "Engine",
     "EngineConfig",
     "EngineStopped",
+    "Fleet",
+    "FleetBelowQuorum",
+    "FleetConfig",
+    "IntegrityError",
+    "NoReplicaAvailable",
     "Overloaded",
     "QueueFull",
+    "Replica",
     "Request",
+    "RetriesExhausted",
+    "RetryPolicy",
+    "Router",
     "Searcher",
     "ServingStats",
     "brute_force_searcher",
     "cagra_searcher",
     "compile_count",
     "elastic_searcher",
+    "failure_kind",
+    "is_retryable",
     "ivf_flat_searcher",
     "ivf_pq_searcher",
     "make_searcher",

@@ -19,7 +19,14 @@ to survive (docs/robustness.md):
   :class:`~raft_tpu.serving.searchers.Searcher` handle's device call,
   exercising the engine's per-batch failure containment, the hang
   watchdog + circuit breaker, and deadline/overload shedding
-  (tests/test_serving_chaos.py).
+  (tests/test_serving_chaos.py);
+- fleet replicas — :func:`kill_replica` hard-stops one engine of a
+  :class:`~raft_tpu.serving.fleet.Fleet` mid-traffic (queued riders
+  fail typed and must be retried on a sibling), :func:`hang_replica`
+  stalls one replica's next device call (watchdog → breaker → the
+  fleet routes around it), and :func:`trip_breaker` opens a replica's
+  circuit breaker directly (the route-around + probe re-admission path
+  without waiting out a real hang) — tests/test_fleet_chaos.py.
 
 All injectors operate on real bytes/sockets — no monkeypatched readers —
 so the detection paths under test are the ones production restores run.
@@ -191,6 +198,60 @@ def slow_searcher(searcher, delay_s: float) -> Iterator:
         yield searcher
     finally:
         restore()
+
+
+# ------------------------------------------------------- fleet injectors
+
+
+def _resolve_replica(fleet_or_engine, replica):
+    """Accept either an Engine (``replica`` ignored) or a Fleet plus a
+    replica name/index, returning the target engine. Keeps chaos tests
+    readable: ``kill_replica(fleet, "replica1")``."""
+    engine = fleet_or_engine
+    replicas = getattr(fleet_or_engine, "replicas", None)
+    if replicas is not None:
+        if isinstance(replica, int):
+            engine = replicas[replica].engine
+        else:
+            by_name = {r.name: r.engine for r in replicas}
+            if replica not in by_name:
+                raise KeyError(
+                    f"no replica {replica!r} (have {sorted(by_name)})")
+            engine = by_name[replica]
+    return engine
+
+
+def kill_replica(fleet_or_engine, replica=None) -> None:
+    """Hard-kill one replica mid-traffic: ``Engine.stop(drain=False)``
+    — queued riders fail typed (``EngineStopped`` / cancelled futures,
+    never silent), batches already on the device still complete, and
+    the replica's ``health()`` goes ``"unhealthy"`` so the fleet routes
+    around it and retries the casualties on siblings. The injected
+    analog of a replica process dying; a killed engine does not come
+    back."""
+    _resolve_replica(fleet_or_engine, replica).stop(drain=False)
+
+
+def hang_replica(fleet_or_engine, replica=None, hang_s: float = 60.0,
+                 times: int = 1):
+    """Stall one replica's next ``times`` device calls for ``hang_s``
+    (default long enough that the hang watchdog, not the sleep, ends
+    the episode): the watchdog fails the batch (``BatchFailed`` with
+    ``.hang``), trips the breaker, and the fleet must route around the
+    replica until a probe closes the breaker again. Returns the
+    zero-arg disarm function from :func:`hang_next_dispatch`."""
+    engine = _resolve_replica(fleet_or_engine, replica)
+    return hang_next_dispatch(engine.searcher, hang_s, times=times)
+
+
+def trip_breaker(fleet_or_engine, replica=None) -> None:
+    """Open one replica's circuit breaker NOW, exactly as the watchdog
+    would on a hang (same ``trip()`` + trip counter), without paying a
+    real ``hang_timeout_s`` wait — the fast path to exercising the
+    fleet's route-around and half-open probe re-admission."""
+    engine = _resolve_replica(fleet_or_engine, replica)
+    engine.breaker.trip()
+    engine.stats.record_breaker_trip()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,494 @@
+"""Chaos tests for the multi-replica serving fleet (docs/serving.md
+"Fleet").
+
+Each test injects one replica-level failure domain through the real
+routing path (the ``raft_tpu.testing.faults`` fleet injectors stop a
+real engine / wrap a real handle's ``search``) and pins an invariant
+the fleet claims:
+
+- a replica killed mid-batch loses nothing: its riders are retried on
+  a sibling and every result stays bit-identical to a solo search on
+  whichever replica actually served it;
+- a breaker-open replica is routed around, then re-admitted after a
+  rate-limited live probe closes the breaker half-open;
+- ``rolling_swap`` under concurrent submitters drops zero requests and
+  the healthy-replica count never dips below quorum (and refuses to
+  start when it would);
+- retries honor the rider's ``remaining_ms``: a tight-deadline request
+  sheds typed (``DeadlineExceeded``) instead of burning a retry whose
+  backoff cannot fit — the deadline is never reset by retrying;
+- every submitted request resolves to exactly one typed outcome —
+  ``submitted == ok + sheds + failures + cancelled`` reconciles
+  exactly, with one ``kind="fleet"`` span per request under one trace
+  id;
+- the fleet ``/healthz`` aggregate answers 200 while quorum holds
+  (``"degraded"`` when any replica is) and 503 below quorum.
+
+The router's race windows (choose vs admin flips vs retry timers vs
+completion callbacks) are hammered across >= 100 amplified interleave
+seeds in the slow tier (``-m interleave``), over stub-searcher engines
+so a seed costs milliseconds, not device time.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.obs.spans import ListSink
+from raft_tpu.serving.engine import solo_reference
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 16
+K = 5
+
+
+@pytest.fixture(scope="module")
+def flat_index():
+    rng = np.random.default_rng(3)
+    db = rng.standard_normal((1500, DIM)).astype(np.float32)
+    return ivf_flat.build(db, ivf_flat.IndexParams(n_lists=16))
+
+
+def _searcher(flat_index):
+    # fresh handle per replica: injectors rebind .search per handle, so
+    # a fault armed on one replica never leaks to a sibling
+    return serving.ivf_flat_searcher(flat_index,
+                                     ivf_flat.SearchParams(n_probes=8))
+
+
+def _fleet(flat_index, n=2, sink=None, engine_kw=None, **fleet_kw):
+    ekw = {"max_batch": 8, "max_wait_us": 5000, "warm_ks": (K,)}
+    ekw.update(engine_kw or {})
+    fleet_kw.setdefault("quorum", 1)
+    fleet_kw.setdefault("seed", 7)
+    fleet_kw.setdefault("probe_interval_s", 0.05)
+    cfg = serving.FleetConfig(span_sink=sink, **fleet_kw)
+    return serving.Fleet.from_searchers(
+        [_searcher(flat_index) for _ in range(n)],
+        engine_config=serving.EngineConfig(**ekw), config=cfg)
+
+
+def _q(rng):
+    return rng.standard_normal(DIM).astype(np.float32)
+
+
+def _reconcile(fleet):
+    """Every submitted request resolved to exactly one typed outcome."""
+    oc = fleet.stats.outcome_counts()
+    resolved = sum(v for k, v in oc.items() if k != "submitted")
+    assert oc["submitted"] == resolved, f"silent loss: {oc}"
+    return oc
+
+
+def _assert_bit_identical(fut, query):
+    d, i = fut.result(timeout=0)
+    ref_d, ref_i = solo_reference(fut.searcher, query, K, *fut.placement)
+    assert np.array_equal(d, ref_d) and np.array_equal(i, ref_i)
+
+
+# ------------------------------------------------- replica kill retries
+def test_replica_kill_mid_batch_retries_on_sibling(flat_index):
+    """Kill replica0 with riders queued mid-batch: every future still
+    resolves ok — retried on the sibling — and every result is
+    bit-identical to a solo search on the replica that served it."""
+    sink = ListSink()
+    fleet = _fleet(flat_index, n=2, sink=sink)
+    rng = np.random.default_rng(0)
+    with fleet:
+        r0 = fleet.replicas[0]
+        # slow r0 so a backlog builds there, guaranteeing the kill
+        # catches queued/in-flight riders (not an idle engine)
+        restore = faults._wrap_search(
+            r0.engine.searcher,
+            lambda orig, q, k: (time.sleep(0.05), orig(q, k))[1])
+        queries = [_q(rng) for _ in range(60)]
+        futs = [fleet.submit(q, K) for q in queries]
+        deadline = time.monotonic() + 10
+        while (len(r0.engine.batcher) == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert len(r0.engine.batcher) > 0, "no backlog built on r0"
+        faults.kill_replica(fleet, "replica0")
+        restore()
+        for q, f in zip(queries, futs):
+            f.result(timeout=30)
+            _assert_bit_identical(f, q)
+        oc = _reconcile(fleet)
+        assert oc["ok"] == len(queries)
+        # the kill's casualties were retried on the sibling, typed
+        retried = fleet.stats._retried
+        total_retries = sum(int(c.value)
+                            for (rep, _), c in retried.items()
+                            if rep == "replica0")
+        assert total_retries > 0, "kill produced no sibling retries"
+    # one fleet span per request, each under its own single trace id
+    spans = [r for r in sink.records if r["kind"] == "fleet"]
+    assert len(spans) == len(queries)
+    assert len({s["trace_id"] for s in spans}) == len(queries)
+    for s in spans:
+        assert s["outcome"] == "ok"
+        assert all(("trace" in a) or ("error" in a)
+                   for a in s["attempts"])
+
+
+def test_injected_batch_failure_retries_bit_identically(flat_index):
+    """A transient mid-batch device failure (BatchFailed) on one replica
+    is retried on a sibling with a bit-identical result, not surfaced
+    to the caller."""
+    fleet = _fleet(flat_index, n=2)
+    rng = np.random.default_rng(1)
+    with fleet:
+        disarm = faults.fail_next_dispatch(
+            fleet.replicas[0].engine.searcher, times=5)
+        queries = [_q(rng) for _ in range(30)]
+        futs = [fleet.submit(q, K) for q in queries]
+        for q, f in zip(queries, futs):
+            f.result(timeout=30)
+            _assert_bit_identical(f, q)
+        disarm()
+        oc = _reconcile(fleet)
+        assert oc["ok"] == len(queries)
+
+
+# --------------------------------------------- breaker route-around
+def test_breaker_open_routed_around_then_readmitted(flat_index):
+    """A breaker-open replica takes no regular traffic, but the router's
+    rate-limited probes re-admit it once the half-open probe batch
+    closes the breaker."""
+    fleet = _fleet(flat_index, n=2, probe_interval_s=0.05,
+                   engine_kw={"breaker_cooldown_s": 0.2})
+    rng = np.random.default_rng(2)
+    with fleet:
+        faults.trip_breaker(fleet, "replica0")
+        r0 = fleet.replicas[0].engine
+        assert r0.health()["status"] == "unhealthy"
+        assert fleet.health()["status"] == "degraded"
+        # traffic keeps flowing around the sick replica, typed retries
+        # absorbing any too-early probes (CircuitOpen -> sibling)
+        for _ in range(10):
+            fleet.search(_q(rng), K, timeout=30)
+        # after the cooldown a probe goes half-open and closes it
+        deadline = time.monotonic() + 15
+        while (r0.health()["status"] != "ok"
+               and time.monotonic() < deadline):
+            fleet.search(_q(rng), K, timeout=30)
+            time.sleep(0.02)
+        assert r0.health()["status"] == "ok", "probe never closed breaker"
+        assert fleet.health()["status"] == "ok"
+        routed_before = int(fleet.stats._routed["replica0"].value)
+        for _ in range(40):
+            fleet.search(_q(rng), K, timeout=30)
+        assert int(fleet.stats._routed["replica0"].value) > routed_before, \
+            "re-admitted replica got no traffic"
+        _reconcile(fleet)
+
+
+# -------------------------------------------------- rolling swap + quorum
+def test_rolling_swap_under_load_zero_drops_never_below_quorum(flat_index):
+    """rolling_swap with concurrent submitters: zero dropped requests,
+    every result bit-identical on its serving handle, and the healthy
+    in-service count sampled throughout never dips below quorum."""
+    fleet = _fleet(flat_index, n=3, quorum=2)
+    rng = np.random.default_rng(4)
+    results = []
+    lock = threading.Lock()
+    stop_sampling = threading.Event()
+    quorum_samples = []
+
+    def sampler():
+        while not stop_sampling.is_set():
+            quorum_samples.append(fleet.healthy_count())
+            time.sleep(0.002)
+
+    def submitter(ti):
+        trng = np.random.default_rng(100 + ti)
+        for _ in range(40):
+            q = _q(trng)
+            f = fleet.submit(q, K)
+            with lock:
+                results.append((q, f))
+
+    with fleet:
+        threads = [threading.Thread(target=submitter, args=(ti,))
+                   for ti in range(3)]
+        sam = threading.Thread(target=sampler)
+        sam.start()
+        for t in threads:
+            t.start()
+        old = fleet.rolling_swap([_searcher(flat_index)
+                                  for _ in range(3)])
+        for t in threads:
+            t.join()
+        assert fleet.drain(timeout=60)
+        stop_sampling.set()
+        sam.join()
+        assert all(o is not None for o in old)
+        assert quorum_samples and min(quorum_samples) >= 2, \
+            f"quorum dipped: min={min(quorum_samples or [0])}"
+        for q, f in results:
+            assert f.done()
+            _assert_bit_identical(f, q)
+        oc = _reconcile(fleet)
+        assert oc["ok"] == len(results)
+        assert fleet.stats._swaps.value == 3
+
+
+def test_rolling_swap_refuses_below_quorum(flat_index):
+    """Draining any replica of a 2-replica quorum-2 fleet would leave 1
+    healthy — the swap must refuse before touching anything."""
+    fleet = _fleet(flat_index, n=2, quorum=2)
+    with fleet:
+        gens_before = [r.engine._searcher_gen for r in fleet.replicas]
+        with pytest.raises(serving.FleetBelowQuorum):
+            fleet.rolling_swap([_searcher(flat_index) for _ in range(2)])
+        assert [r.engine._searcher_gen
+                for r in fleet.replicas] == gens_before
+        assert all(r.admin == "in_service" for r in fleet.replicas)
+
+
+# -------------------------------------------------- deadline discipline
+def test_tight_deadline_sheds_typed_instead_of_retrying(flat_index):
+    """A request whose deadline expires while queued sheds typed
+    (DeadlineExceeded) with NO retry: the rider's budget is spent and
+    no sibling can un-spend it."""
+    # huge flush deadline: a lone request sits queued well past its
+    # 30 ms shed deadline, so the engine-side shed path fires
+    fleet = _fleet(flat_index, n=2,
+                   engine_kw={"max_wait_us": 2_000_000})
+    rng = np.random.default_rng(5)
+    with fleet:
+        fut = fleet.submit(_q(rng), K, deadline_ms=30.0)
+        with pytest.raises(serving.DeadlineExceeded):
+            fut.result(timeout=10)
+        oc = _reconcile(fleet)
+        assert oc["shed_deadline"] == 1
+        retried = sum(int(c.value)
+                      for c in fleet.stats._retried.values())
+        assert retried == 0, "deadline shed must not burn retries"
+
+
+def test_retry_backoff_honors_remaining_ms(flat_index):
+    """When the drawn backoff cannot fit the rider's remaining budget
+    the request sheds DeadlineExceeded immediately (cause chained)
+    instead of sleeping past its own deadline: a retry never resets or
+    outlives the deadline."""
+    # single replica: the first BatchFailed wants a retry; with
+    # seed=0 the full-jitter draw over [0, 200] ms is ~169 ms >> the
+    # ~1 s budget remaining is... see below: deadline 2 s minus the
+    # instant failure leaves < 200 ms only with a tight deadline
+    fleet = _fleet(flat_index, n=1, seed=0, retry_limit=4,
+                   backoff_base_ms=4000.0, backoff_cap_ms=4000.0)
+    rng = np.random.default_rng(6)
+    with fleet:
+        disarm = faults.fail_next_dispatch(
+            fleet.replicas[0].engine.searcher, times=10)
+        t0 = time.perf_counter()
+        fut = fleet.submit(_q(rng), K, deadline_ms=2000.0)
+        with pytest.raises(serving.DeadlineExceeded) as ei:
+            fut.result(timeout=30)
+        elapsed = time.perf_counter() - t0
+        disarm()
+        # shed the moment the draw (uniform[0, 4000) ms, seeded, far
+        # above the remaining budget at every plausible draw) could not
+        # fit — NOT after sleeping the backoff or the full deadline
+        assert elapsed < 1.5, f"slept into the backoff: {elapsed:.2f}s"
+        assert isinstance(ei.value.__cause__, serving.BatchFailed)
+        oc = _reconcile(fleet)
+        assert oc["shed_deadline"] == 1
+
+
+# ------------------------------------------------- typed shed exhaustion
+def test_all_replicas_dead_sheds_typed(flat_index):
+    """With every replica killed, a submit resolves typed
+    (NoReplicaAvailable, an Overloaded) — never raises raw, never
+    hangs, never lost."""
+    fleet = _fleet(flat_index, n=2)
+    rng = np.random.default_rng(7)
+    with fleet:
+        faults.kill_replica(fleet, 0)
+        faults.kill_replica(fleet, 1)
+        fut = fleet.submit(_q(rng), K)
+        with pytest.raises(serving.NoReplicaAvailable):
+            fut.result(timeout=10)
+        assert isinstance(fut.exception(), serving.Overloaded)
+        oc = _reconcile(fleet)
+        assert oc["shed_no_replica"] == 1
+
+
+def test_fleet_stop_strands_no_future(flat_index):
+    """stop(drain=False) racing live submissions: every outstanding
+    future resolves typed (EngineStopped / outcome accounting exact)."""
+    fleet = _fleet(flat_index, n=2)
+    rng = np.random.default_rng(8)
+    with fleet:
+        futs = [fleet.submit(_q(rng), K) for _ in range(40)]
+        fleet.stop(drain=False)
+        for f in futs:
+            assert f.done(), "stranded future after stop"
+            if f.exception() is not None:
+                assert isinstance(f.exception(),
+                                  serving.EngineStopped)
+        _reconcile(fleet)
+
+
+# ------------------------------------------------------ healthz aggregate
+def test_healthz_aggregates_fleet_state(flat_index):
+    """One scrape target for the fleet: 200 "ok" with all replicas up,
+    200 "degraded" with a replica dead but quorum held, 503 below
+    quorum."""
+    fleet = _fleet(flat_index, n=3, quorum=2)
+    with fleet:
+        srv = fleet.serve_metrics(port=0)
+        url = f"http://127.0.0.1:{srv.port}/healthz"
+
+        def get():
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, doc = get()
+        assert code == 200 and doc["status"] == "ok"
+        assert doc["quorum"] == {"required": 2, "healthy": 3, "ok": True}
+        faults.kill_replica(fleet, "replica2")
+        code, doc = get()
+        assert code == 200 and doc["status"] == "degraded"
+        assert doc["quorum"]["healthy"] == 2
+        assert doc["replicas"]["replica2"]["status"] == "unhealthy"
+        faults.kill_replica(fleet, "replica1")
+        code, doc = get()
+        assert code == 503 and doc["status"] == "unhealthy"
+        assert doc["quorum"]["ok"] is False
+
+
+# ---------------------------------------------- typed hierarchy (exports)
+def test_typed_failure_hierarchy_and_retryability():
+    """Satellite pin: the full hierarchy is exported from raft_tpu.serving
+    and the router classifies by isinstance exactly as the package
+    docstring's table says."""
+    for name in ("BatchFailed", "Overloaded", "CircuitOpen",
+                 "DeadlineExceeded", "IntegrityError", "QueueFull",
+                 "EngineStopped", "NoReplicaAvailable",
+                 "RetriesExhausted", "FleetBelowQuorum"):
+        assert name in serving.__all__, name
+        assert hasattr(serving, name), name
+    assert issubclass(serving.CircuitOpen, serving.Overloaded)
+    assert issubclass(serving.NoReplicaAvailable, serving.Overloaded)
+    assert issubclass(serving.RetriesExhausted, serving.Overloaded)
+    assert serving.is_retryable(serving.BatchFailed("x"))
+    assert serving.is_retryable(serving.Overloaded("x"))
+    assert serving.is_retryable(serving.CircuitOpen("x"))
+    assert serving.is_retryable(serving.QueueFull("x"))
+    assert serving.is_retryable(serving.EngineStopped("x"))
+    assert not serving.is_retryable(serving.DeadlineExceeded("x"))
+    assert not serving.is_retryable(serving.IntegrityError("x"))
+    assert not serving.is_retryable(ValueError("x"))
+    # labels come from type, not message text
+    assert serving.failure_kind(
+        serving.CircuitOpen("overloaded-looking text")) == "circuit_open"
+
+
+# ------------------------------------- amplified interleavings (slow tier)
+class _StubIndex:
+    pass
+
+
+def _stub_searcher(dim=8):
+    """Pure-numpy handle: deterministic per-query rows (so sibling
+    replicas are bit-identical by construction) at microsecond cost —
+    makes 100-seed amplified fleets affordable."""
+
+    def search(queries, k):
+        q = np.asarray(queries, np.float32)
+        base = q.sum(axis=1, keepdims=True)
+        d = base + np.arange(k, dtype=np.float32)[None, :]
+        i = (np.abs(q).sum(axis=1, keepdims=True).astype(np.int64)
+             + np.arange(k, dtype=np.int64)[None, :])
+        return d.astype(np.float32), i
+
+    return serving.Searcher(family="stub", dim=dim, index=_StubIndex(),
+                            search=search)
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_router_races_amplified(flat_index):
+    """Hammer the router/fleet race windows — choose vs admin flips vs
+    retry timers vs completion callbacks vs stop — across >= 100
+    amplified interleave seeds: at every seed, every future resolves
+    typed and the outcome accounting reconciles exactly (zero silent
+    losses). Seed base via RAFT_TPU_INTERLEAVE_SEED."""
+    from raft_tpu.testing.interleave import InterleaveAmplifier, seeds
+
+    DIM_S = 8
+    for seed in seeds(100):
+        cfg = serving.FleetConfig(quorum=1, seed=seed, retry_limit=4,
+                                  backoff_base_ms=0.2,
+                                  backoff_cap_ms=2.0,
+                                  probe_interval_s=0.01)
+        ecfg = serving.EngineConfig(
+            max_batch=4, max_wait_us=200, warm_ks=(K,),
+            hang_timeout_s=None, persistent_cache=False,
+            flight_recorder=False)
+        fleet = serving.Fleet.from_searchers(
+            [_stub_searcher(DIM_S) for _ in range(3)],
+            engine_config=ecfg, config=cfg)
+        futs = []
+        lock = threading.Lock()
+
+        def submitter(ti, fleet=fleet, futs=futs, lock=lock):
+            trng = np.random.default_rng(1000 + ti)
+            for _ in range(15):
+                q = trng.standard_normal(DIM_S).astype(np.float32)
+                try:
+                    f = fleet.submit(q, K)
+                except serving.EngineStopped:
+                    return
+                with lock:
+                    futs.append(f)
+
+        def chaos(fleet=fleet):
+            faults.fail_next_dispatch(
+                fleet.replicas[0].engine.searcher, times=3)
+            try:
+                fleet.rolling_swap([_stub_searcher(DIM_S)
+                                    for _ in range(3)],
+                                   warm=False)
+            except serving.FleetBelowQuorum:
+                pass
+            faults.kill_replica(fleet, "replica2")
+
+        with InterleaveAmplifier(seed=seed, yield_probability=0.05,
+                                 path_filters=("raft_tpu/serving",)):
+            fleet.start()
+            threads = [threading.Thread(target=submitter, args=(ti,))
+                       for ti in range(3)]
+            threads.append(threading.Thread(target=chaos))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert fleet.drain(timeout=60), f"seed {seed}: drain hung"
+            fleet.stop(drain=False)
+
+        for f in futs:
+            assert f.done(), f"seed {seed}: stranded future"
+            exc = f.exception()
+            if exc is not None:
+                assert isinstance(
+                    exc, (serving.Overloaded, serving.BatchFailed,
+                          serving.EngineStopped,
+                          serving.DeadlineExceeded)), (seed, exc)
+        oc = fleet.stats.outcome_counts()
+        resolved = sum(v for k, v in oc.items() if k != "submitted")
+        assert oc["submitted"] == resolved, (seed, oc)
+        assert oc["submitted"] == len(futs), (seed, oc)

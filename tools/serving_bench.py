@@ -22,6 +22,15 @@ Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
   must stay within ~2x of the at-capacity p99 instead of diverging with
   the queue. Every shed is a typed rejection (Overloaded / QueueFull /
   DeadlineExceeded); an untyped wait-timeout fails the run.
+- ``fleet`` (first family only): Poisson arrivals at 10x ONE replica's
+  capacity against a 3-replica :class:`~raft_tpu.serving.fleet.Fleet`
+  while a rolling swap of every replica runs mid-load and two replicas
+  are killed mid-run — the docs/serving.md "Fleet" story measured:
+  exact typed accounting (every submitted request resolves ok / typed
+  shed / typed failure; zero silent losses), ``kind="fleet"`` spans
+  reconciling 1:1 under one trace id per request, the swap completing
+  with zero drops, and the quorum gauge never below its threshold
+  (``--fleet-replicas 0`` disables the arm).
 - ``adaptive``: the same 2x overload against an engine with an
   ``raft_tpu.planner.AdaptivePlanner`` (the committed
   ``PARETO_<platform>.json``, or an inline mini sweep when the platform
@@ -257,6 +266,211 @@ def bench_overload(engine, queries, k, rate_qps, n_requests, rng,
     return row
 
 
+def bench_fleet(searcher, cfg_kwargs, queries, k, capacity_qps,
+                phase_queries, rng, replicas=3, kills=2, factor=10.0,
+                max_batch=64, sink=None):
+    """Fleet arm: Poisson open-loop at ``factor``x ONE replica's
+    measured closed-loop capacity against a ``replicas``-wide
+    :class:`~raft_tpu.serving.fleet.Fleet`, while the run degrades it on
+    purpose — a rolling swap of every replica mid-load, then ``kills``
+    staggered replica kills (docs/serving.md "Fleet").
+
+    The contracts asserted here are the fleet's whole reason to exist:
+
+    - exact accounting — every submitted request resolves to ok, a
+      typed shed, or a typed failure; an untyped wait-timeout or an
+      unexpected exception type fails the run (zero silent losses),
+      and the ``raft_tpu_fleet_requests_total`` outcome counters must
+      reconcile exactly (submitted == sum of resolutions, ok == served);
+    - the rolling swap completes all ``replicas`` rotations under load
+      with zero drops (no skipped replica, every displaced handle
+      returned);
+    - the quorum gauge (sampled via ``healthy_count()``, the same
+      callback ``raft_tpu_fleet_quorum_healthy`` reads) never dips
+      below the configured threshold at any point in the run.
+
+    Arrival pacing is phase-driven, not a fixed count: ``phase_queries``
+    arrivals warm the overload, then arrivals continue for as long as
+    the swap is in flight (so the drain + warm happen under real
+    traffic), then ``phase_queries`` more after each kill and a final
+    tail. Span reconciliation (one ``kind="fleet"`` record per request
+    under one trace id) happens in ``main`` from the JSONL file.
+
+    Returns ``(row, fleet_engine_completed)`` — the second term feeds
+    the caller's engine-level span/counter reconciliation.
+    """
+    import dataclasses as _dc
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from raft_tpu import serving
+    from raft_tpu.testing import faults
+
+    if not 0 < kills < replicas:
+        raise ValueError(f"need 0 < kills < replicas, got {kills} of "
+                         f"{replicas}")
+    quorum = replicas - kills
+    rate = factor * capacity_qps
+    # one handle per replica over the SAME built index (a Searcher is a
+    # stateless shallow view; replicas must not share the handle object
+    # itself or a swap/injector on one would touch all)
+    engine_cfg = serving.EngineConfig(
+        queue_limit=max(4 * max_batch, 64),
+        queue_high_watermark=max_batch, **cfg_kwargs)
+    fleet = serving.Fleet.from_searchers(
+        [_dc.replace(searcher) for _ in range(replicas)],
+        engine_config=engine_cfg,
+        config=serving.FleetConfig(quorum=quorum, span_sink=sink))
+    fleet.start()
+
+    samples = {"min": replicas, "n": 0}
+    stop_sampling = threading.Event()
+
+    def sampler():
+        while not stop_sampling.is_set():
+            samples["min"] = min(samples["min"], fleet.healthy_count())
+            samples["n"] += 1
+            time.sleep(0.002)
+
+    futs = []
+    state = {"next_t": time.perf_counter()}
+
+    def pump(n=None, until=None, max_n=None):
+        j = 0
+        while (j < n if n is not None else
+               (max_n is None or j < max_n)):
+            if until is not None and until():
+                break
+            state["next_t"] += rng.exponential(1.0 / rate)
+            now = time.perf_counter()
+            if state["next_t"] > now:
+                time.sleep(state["next_t"] - now)
+            elif state["next_t"] < now - 0.5:
+                state["next_t"] = now  # cap the arrival debt
+            futs.append(fleet.submit(queries[len(futs) % len(queries)],
+                                     k))
+            j += 1
+        return j
+
+    swap_info = {}
+
+    def do_swap():
+        t0 = time.perf_counter()
+        displaced = fleet.rolling_swap(
+            [_dc.replace(searcher) for _ in range(replicas)], warm=True)
+        swap_info["duration_s"] = round(time.perf_counter() - t0, 3)
+        swap_info["displaced"] = displaced
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    t0 = time.perf_counter()
+    killed = []
+    try:
+        pump(n=phase_queries)                 # all replicas healthy
+        swap_t = threading.Thread(target=do_swap)
+        swap_t.start()
+        # load DURING the swap; the drain makes the swap's duration
+        # load-dependent, so bound the arrivals and SAY SO when the
+        # bound engages (the swap then finishes against a quiet fleet
+        # instead of the run growing without limit)
+        swap_cap = 20 * phase_queries
+        swap_pumped = pump(until=lambda: not swap_t.is_alive(),
+                           max_n=swap_cap)
+        swap_load_capped = swap_pumped >= swap_cap
+        if swap_load_capped:
+            print(f"  fleet: swap outlived the load window "
+                  f"({swap_pumped} arrivals) — remainder drains "
+                  f"unloaded", flush=True)
+        swap_t.join()
+        in_flight_at_kill = []
+        for i in range(kills):
+            victim = replicas - 1 - i         # replica0 survives the run
+            in_flight_at_kill.append(
+                len(fleet.replicas[victim].engine.batcher))
+            faults.kill_replica(fleet, victim)
+            killed.append(fleet.replicas[victim].name)
+            pump(n=phase_queries)             # load on the shrunken fleet
+        pump(n=phase_queries)                 # tail
+        n_total = len(futs)
+
+        served = 0
+        shed = {}
+        untyped = 0
+        for f in futs:
+            try:
+                # same generous bound as bench_overload: hitting it
+                # means a request was neither served nor typed-shed —
+                # exactly the silent loss the fleet must never produce
+                f.result(timeout=120)
+                served += 1
+            except FutTimeout:
+                raise AssertionError(
+                    "fleet request neither served nor typed-shed "
+                    "within 120 s — untyped timeout, shed contract "
+                    "broken") from None
+            except (serving.Overloaded, serving.QueueFull,
+                    serving.BatchFailed, serving.EngineStopped,
+                    serving.DeadlineExceeded,
+                    serving.IntegrityError) as e:
+                kind = serving.failure_kind(e)
+                shed[kind] = shed.get(kind, 0) + 1
+            except BaseException:
+                untyped += 1
+        elapsed = time.perf_counter() - t0
+        assert untyped == 0, (
+            f"{untyped} requests resolved with an UNTYPED exception — "
+            "every fleet failure must be classifiable by isinstance")
+        n_shed = sum(shed.values())
+        assert served + n_shed == n_total  # zero silent losses
+
+        assert fleet.drain(120), "fleet did not quiesce after the run"
+        counts = fleet.stats.outcome_counts()
+        resolved = sum(v for ev, v in counts.items()
+                       if ev != "submitted")
+        assert counts["submitted"] == n_total == resolved, (
+            f"fleet counters do not reconcile: submitted="
+            f"{counts['submitted']}, resolved={resolved}, "
+            f"futures={n_total}")
+        assert counts["ok"] == served, (
+            f"ok counter {counts['ok']} != served futures {served}")
+
+        assert swap_info.get("displaced") is not None, (
+            "rolling swap did not complete during the run")
+        skipped = sum(1 for d in swap_info["displaced"] if d is None)
+        assert skipped == 0, (
+            f"rolling swap skipped {skipped} replicas — expected all "
+            f"{replicas} rotations to land before the kills")
+    finally:
+        stop_sampling.set()
+        sampler_t.join()
+        fleet.stop(drain=False)
+    assert samples["min"] >= quorum, (
+        f"quorum gauge dipped to {samples['min']} < threshold {quorum}")
+
+    fleet_completed = sum(r.engine.stats.n_completed
+                          for r in fleet.replicas)
+    row = {
+        "replicas": replicas,
+        "quorum": quorum,
+        "factor": factor,
+        "offered_qps": round(rate, 1),
+        "n": n_total,
+        "served": served,
+        "shed": shed,
+        "shed_rate": round(n_shed / n_total, 4),
+        "goodput_qps": round(served / elapsed, 1),
+        "outcomes": counts,
+        "rolling_swap": {"swapped": replicas,
+                         "duration_s": swap_info["duration_s"],
+                         "arrivals_during": swap_pumped,
+                         "load_capped": swap_load_capped},
+        "kills": {"replicas": killed,
+                  "in_flight_at_kill": in_flight_at_kill},
+        "quorum_gauge": {"min": samples["min"], "threshold": quorum,
+                         "samples": samples["n"]},
+    }
+    return row, fleet_completed
+
+
 def make_planner(family, k, db, queries, artifact_path, recall_floor,
                  res):
     """AdaptivePlanner for the adaptive-overload arm: the committed
@@ -471,6 +685,20 @@ def main():
                          "in-flight slots absorb, so the watermark shed "
                          "actually engages; empty disables)")
     ap.add_argument("--overload-queries", type=int, default=300)
+    ap.add_argument("--fleet-replicas", type=int, default=3,
+                    help="fleet arm (first family only): replicas in "
+                         "the chaos fleet; 0 disables the arm")
+    ap.add_argument("--fleet-kills", type=int, default=2,
+                    help="replicas killed mid-run in the fleet arm "
+                         "(must stay below --fleet-replicas; the "
+                         "difference is the quorum threshold)")
+    ap.add_argument("--fleet-factor", type=float, default=10.0,
+                    help="fleet arm offered load as a multiple of ONE "
+                         "replica's closed-loop capacity")
+    ap.add_argument("--fleet-queries", type=int, default=400,
+                    help="fleet arm arrivals per phase (warm-up, after "
+                         "each kill, tail); the swap phase is paced by "
+                         "the swap itself")
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the per-request bit-identity sweep")
     ap.add_argument("--spans", default=None,
@@ -706,6 +934,46 @@ def main():
                         f"shed-only {shed_run['goodput_qps']} at "
                         f"{factor}x — the adaptive policy is not "
                         f"paying for itself")
+
+        if (fi == 0 and args.fleet_replicas > 0
+                and "closed_loop" in row):
+            fl, fleet_completed = bench_fleet(
+                searcher, cfg_kwargs, queries, args.k,
+                row["closed_loop"]["qps"], args.fleet_queries, rng,
+                replicas=args.fleet_replicas, kills=args.fleet_kills,
+                factor=args.fleet_factor, max_batch=args.max_batch,
+                sink=fam_sink)
+            completed_total += fleet_completed
+            print(f"  fleet @{fl['factor']}x * {fl['replicas']} "
+                  f"replicas: n={fl['n']}, served={fl['served']}, "
+                  f"shed={fl['shed']}, goodput={fl['goodput_qps']} "
+                  f"qps, swap {fl['rolling_swap']['duration_s']} s, "
+                  f"kills={fl['kills']['replicas']}, quorum gauge "
+                  f"min {fl['quorum_gauge']['min']} >= "
+                  f"{fl['quorum_gauge']['threshold']}", flush=True)
+            if spans_sink is not None:
+                # one kind="fleet" span per request under ONE fleet
+                # trace id, tying every retry to its final outcome
+                fspans = [r for r in obs_spans.read_jsonl(
+                              spans_path, kind="fleet")
+                          if r.get("family") == family]
+                traces = {r["trace_id"] for r in fspans}
+                ok_spans = sum(1 for r in fspans
+                               if r["outcome"] == "ok")
+                assert len(fspans) == fl["n"] == len(traces), (
+                    f"fleet spans do not reconcile 1:1: {len(fspans)} "
+                    f"spans / {len(traces)} trace ids for {fl['n']} "
+                    f"requests")
+                assert ok_spans == fl["served"], (
+                    f"{ok_spans} ok fleet spans vs {fl['served']} "
+                    f"served requests")
+                fl["spans"] = {"records": len(fspans),
+                               "trace_ids": len(traces),
+                               "ok": ok_spans}
+                print(f"  fleet spans: {len(fspans)} records, "
+                      f"{len(traces)} trace ids, {ok_spans} ok — "
+                      f"reconciled", flush=True)
+            row["fleet"] = fl
 
         if spans_sink is not None:
             # consume the span file back: the ok spans must reconcile
