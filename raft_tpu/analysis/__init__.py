@@ -15,6 +15,11 @@ Two tiers:
   over the serving/comms/obs stack — unguarded shared state, lock-order
   cycles, blocking calls under a lock, condition waits outside a
   predicate loop. See :mod:`raft_tpu.analysis.concurrency`.
+* **Tier F** (``--flow``): typed-failure & resource-lifecycle flow
+  rules F001–F005 over the request path (serving/, obs/, host_p2p) —
+  untyped raises, futures left unsettled on some CFG path, swallowed
+  exceptions, unreclaimed self-held resources, unbudgeted blocking
+  calls. See :mod:`raft_tpu.analysis.flow`.
 
 Findings are keyed ``(rule, file, qualname)`` so a committed baseline
 survives line churn; see :mod:`raft_tpu.analysis.findings`.
@@ -30,6 +35,8 @@ from raft_tpu.analysis.concurrency import THREAD_SCAN_DIRS, run_threads
 from raft_tpu.analysis.findings import (PLACEHOLDER_JUSTIFICATION, Finding,
                                         load_baseline, save_baseline,
                                         split_by_baseline, unjustified_keys)
+from raft_tpu.analysis.flow import (FLOW_RULES, FLOW_SCAN_DIRS,
+                                    FLOW_SCAN_FILES, flow_stats, run_flow)
 from raft_tpu.analysis.layering import check_layering
 from raft_tpu.analysis.rules_ast import AST_RULES
 
@@ -38,7 +45,9 @@ __all__ = [
     "load_baseline", "save_baseline", "split_by_baseline",
     "unjustified_keys", "PLACEHOLDER_JUSTIFICATION",
     "collect_modules", "run_tier_a", "run_threads",
+    "run_flow", "flow_stats", "FLOW_RULES",
     "DEFAULT_SCAN_DIRS", "THREAD_SCAN_DIRS",
+    "FLOW_SCAN_DIRS", "FLOW_SCAN_FILES",
 ]
 
 #: directories scanned by default, relative to the repo root.

@@ -84,7 +84,7 @@ class ModuleInfo:
             elif isinstance(node, ast.ImportFrom) and node.module:
                 if node.level:  # relative import: anchor in this package
                     base = ".".join(
-                        self.modname.split(".")[:-node.level] + [node.module])
+                        [*self.modname.split(".")[:-node.level], node.module])
                 else:
                     base = node.module
                 for a in node.names:

@@ -345,9 +345,9 @@ class _ClassScanner(ast.NodeVisitor):
             for kw in node.keywords:
                 if kw.arg in ("target", "function"):
                     target = kw.value
-            if target is None and dotted == "threading.Timer":
-                if len(node.args) >= 2:
-                    target = node.args[1]
+            if (target is None and dotted == "threading.Timer"
+                    and len(node.args) >= 2):
+                target = node.args[1]
             attr = _self_attr(target) if target is not None else None
             if attr:
                 kind = "timer" if dotted == "threading.Timer" else "thread"
@@ -444,11 +444,11 @@ class _HoldWalker(ast.NodeVisitor):
         if (isinstance(node.func, ast.Attribute)
                 and isinstance(node.func.value, ast.Name)
                 and node.func.value.id == "self"
-                and node.func.attr in self.m.methods):
-            if self.held:
-                for held, _ in self.held:
-                    self.m.held_calls.setdefault(self.method, []).append(
-                        (held, node.func.attr, node.lineno))
+                and node.func.attr in self.m.methods
+                and self.held):
+            for held, _ in self.held:
+                self.m.held_calls.setdefault(self.method, []).append(
+                    (held, node.func.attr, node.lineno))
         self.generic_visit(node)
 
     def _blocking_desc(self, node) -> Optional[str]:
@@ -645,7 +645,7 @@ def _find_cycles(graph: Dict[str, Set[Tuple[str, int, str]]],
                         seen_keys.add(key)
                         cycles.append(path[:])
                 elif nxt not in path and nxt > start:
-                    stack.append((nxt, path + [nxt]))
+                    stack.append((nxt, [*path, nxt]))
     return cycles
 
 
@@ -664,7 +664,7 @@ def rule_lock_order(mod: ModuleInfo) -> List[Finding]:
             rule="T002", file=mod.relfile,
             qualname="cycle:" + "->".join(sorted(cycle)), line=lineno,
             message=("lock-order cycle (deadlock hazard): "
-                     + " -> ".join(cycle + [cycle[0]])
+                     + " -> ".join([*cycle, cycle[0]])
                      + "; pick one acquisition order or merge the locks")))
     return out
 

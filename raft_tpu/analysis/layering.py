@@ -83,7 +83,7 @@ def _check_module(mod: ModuleInfo, known: set) -> list:
             base = node.module
             if node.level:
                 base = ".".join(
-                    mod.modname.split(".")[:-node.level] + [node.module])
+                    [*mod.modname.split(".")[:-node.level], node.module])
             if not base.startswith(ROOT):
                 continue
             for a in node.names:

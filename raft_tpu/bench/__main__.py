@@ -165,7 +165,7 @@ def main(argv=None):
     # back-compat: `--conf ...` without a subcommand means `run`
     # (but let --help/-h reach the top-level parser so subcommands show)
     if argv and argv[0].startswith("--") and argv[0] not in ("--help",):
-        argv = ["run"] + argv
+        argv = ["run", *argv]
 
     p = argparse.ArgumentParser(prog="raft_tpu.bench")
     sub = p.add_subparsers(dest="cmd", required=True)

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 import jax
 
@@ -29,7 +29,7 @@ _tokens: Dict[int, threading.Event] = {}
 _lock = threading.Lock()
 
 
-def get_token(thread_id: int = None) -> threading.Event:
+def get_token(thread_id: Optional[int] = None) -> threading.Event:
     """The cancellation token of a thread (reference: get_token())."""
     tid = thread_id if thread_id is not None else threading.get_ident()
     with _lock:
@@ -57,7 +57,7 @@ def yield_now() -> None:
                 "interruptible::synchronize cancelled")
 
 
-def release_token(thread_id: int = None) -> None:
+def release_token(thread_id: Optional[int] = None) -> None:
     """Drop a thread's token (call at thread exit in long-lived pools to
     bound the registry)."""
     tid = thread_id if thread_id is not None else threading.get_ident()

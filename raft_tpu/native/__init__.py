@@ -37,12 +37,12 @@ def ensure_built(force: bool = False) -> bool:
     """Compile the shared library if missing or older than its source;
     returns availability."""
     global _build_failed
-    if os.path.exists(_SO) and not force:
-        # rebuild only when the source exists and is newer; a shipped .so
-        # without src/ is still valid
-        if (not os.path.exists(_SRC)
-                or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-            return True
+    # rebuild only when the source exists and is newer; a shipped .so
+    # without src/ is still valid
+    if (os.path.exists(_SO) and not force
+            and (not os.path.exists(_SRC)
+                 or os.path.getmtime(_SO) >= os.path.getmtime(_SRC))):
+        return True
     if _build_failed and not force:
         return False
     try:

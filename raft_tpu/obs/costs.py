@@ -323,7 +323,8 @@ def default_cost_entries(budget_bytes: Optional[int] = None) -> list:
     from raft_tpu.analysis import jaxpr_audit as ja
 
     b = budget_bytes if budget_bytes is not None else ja.DEFAULT_BUDGET_BYTES
-    out = ja.canonical_cores(b) + [
+    out = [
+        *ja.canonical_cores(b),
         ("cagra.search@1m", lambda: ja.make_cagra_core(b)),
     ]
     nd = jax.device_count()

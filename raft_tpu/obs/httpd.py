@@ -133,13 +133,30 @@ class MetricsServer:
                     else:
                         self._send(404, "text/plain", b"not found\n")
                 except BrokenPipeError:
-                    pass
+                    # scraper hung up mid-response; count it so a flaky
+                    # collector shows up on the dashboard it scrapes
+                    server._registry.counter(
+                        "raft_tpu_http_disconnects_total",
+                        "Scrapes aborted by the client mid-response.",
+                        ("path",)).labels(path).inc()
                 except Exception as e:
+                    # count before answering: a client that sees the 500
+                    # must also see the incremented counter on a scrape
+                    server._registry.counter(
+                        "raft_tpu_http_errors_total",
+                        "Handler failures by path and exception type.",
+                        ("path", "error")).labels(
+                            path, type(e).__name__).inc()
                     try:
                         self._send(500, "text/plain",
                                    f"{type(e).__name__}: {e}\n".encode())
                     except Exception:
-                        pass
+                        # the 500 itself failed: the socket is already
+                        # gone, which is a disconnect, not a new error
+                        server._registry.counter(
+                            "raft_tpu_http_disconnects_total",
+                            "Scrapes aborted by the client mid-response.",
+                            ("path",)).labels(path).inc()
 
             def _do_healthz(self):
                 if server._health_fn is None:

@@ -329,9 +329,8 @@ class Histogram(_Family):
                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS) -> None:
         super().__init__(name, help, labelnames)
         bounds = tuple(sorted(float(b) for b in buckets))
-        if not bounds or any(b <= 0 for b in bounds if b != bounds[-1]):
-            if not bounds:
-                raise ValueError("histogram needs at least one bucket")
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket")
         if len(set(bounds)) != len(bounds):
             raise ValueError("duplicate bucket bounds")
         if bounds and bounds[-1] == math.inf:

@@ -42,6 +42,7 @@ quality.py never imports serving or jax.
 from __future__ import annotations
 
 import collections
+import contextlib
 import math
 import queue
 import threading
@@ -58,9 +59,10 @@ __all__ = ["overlap_at_k", "OnlineRecallEstimator", "ShadowSampler",
 
 #: per-request shadow accounting vocabulary; ``sampled`` counts every
 #: request offered into the shadow path and equals evaluated +
-#: shed_queue + shed_deadline + error + (still queued) at all times
+#: shed_queue + shed_deadline + shed_close + error + (still queued)
+#: at all times
 SHADOW_EVENTS = ("sampled", "evaluated", "shed_queue", "shed_deadline",
-                 "error")
+                 "shed_close", "error")
 
 
 def overlap_at_k(served_ids, oracle_ids) -> float:
@@ -151,7 +153,7 @@ class ShadowSampler:
                  record_event: Optional[Callable[[str, int], None]] = None,
                  span_sink=None, engine_label: str = "engine",
                  registry: Optional[_metrics.Registry] = None,
-                 clock: Callable[[], float] = None):
+                 clock: Optional[Callable[[], float]] = None):
         if not 0.0 <= float(rate) <= 1.0:
             raise ValueError(f"rate={rate}: expected a fraction in [0, 1]")
         self.rate = float(rate)
@@ -248,9 +250,15 @@ class ShadowSampler:
             return
         self._closed = True
         # the sentinel must land even when the queue is momentarily full
-        # (bounded queue + racing offers): block briefly, then drop one
+        # (bounded queue + racing offers): block briefly, then evict one
+        # queued sample to make room — dropping the sentinel instead
+        # would leave the worker parked on the queue forever
         try:
             self._queue.put(None, timeout=timeout)
         except queue.Full:
-            pass
+            self._record_event("shed_close", 1)
+            with contextlib.suppress(queue.Empty):
+                self._queue.get_nowait()
+            with contextlib.suppress(queue.Full):
+                self._queue.put_nowait(None)
         self._worker.join(timeout)

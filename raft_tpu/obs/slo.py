@@ -233,7 +233,14 @@ class SLOMonitor:
             try:
                 self._on_fast_burn(slo.name, burn)
             except Exception:
-                pass  # telemetry never fails the scrape path
+                # telemetry never fails the scrape path, but a broken
+                # pager hook must not vanish either — count it where
+                # the same scrape will surface it
+                self.registry.counter(
+                    "raft_tpu_slo_callback_errors_total",
+                    "fast-burn callbacks that raised.",
+                    ("engine", "slo")).labels(
+                        self.engine_label, slo.name).inc()
 
     # ---------------------------------------------------------- report
     def report(self) -> dict:

@@ -33,6 +33,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -509,7 +510,7 @@ def fused_topk_tile_bytes(tm: int, tn: int, dim: int, k: int) -> int:
 
 
 def plan_fused_topk_tiles(m: int, n: int, dim: int, k: int,
-                          vmem_budget: int = None):
+                          vmem_budget: Optional[int] = None):
     """(tm, tn) for ``fused_l2_topk`` from the VMEM budget via
     ``core.resources.solve_vmem_tiles`` — the ~16 MiB on-chip analog of
     the HBM ``solve_joint_tiles`` every other planner uses. Prefers
@@ -535,8 +536,8 @@ def plan_fused_topk_tiles(m: int, n: int, dim: int, k: int,
 
 
 def fused_topk_workspace_bytes(m: int, n: int, dim: int, k: int,
-                               tm: int = None, tn: int = None,
-                               vmem_budget: int = None) -> int:
+                               tm: Optional[int] = None, tn: Optional[int] = None,
+                               vmem_budget: Optional[int] = None) -> int:
     """HBM-side workspace of one fused brute-force dispatch: the padded
     query/db copies and norm rows staged for the kernel, the [mp, kp]
     val/idx outputs (temps of the enclosing jit — the caller slices
@@ -635,8 +636,8 @@ def _fused_topk_pallas(x, y, x_norms, y_norms, k: int, tm: int, tn: int,
 
 
 def fused_l2_topk(x, y, k: int, x_norms=None, y_norms=None,
-                  tm: int = None, tn: int = None,
-                  vmem_budget: int = None, interpret: bool = False):
+                  tm: Optional[int] = None, tn: Optional[int] = None,
+                  vmem_budget: Optional[int] = None, interpret: bool = False):
     """Fused squared-L2 scan + top-k: ``(distances [m, k], ids [m, k])``
     ascending, distances clamped at 0 (the l2_expanded convention), ids
     -1 where fewer than k rows exist. The [m, n] distance matrix never
@@ -683,7 +684,7 @@ def fused_ivf_vmem_bytes(pad_tile: int, rot: int, k: int,
 
 
 def plan_fused_ivf_tile(list_pad: int, rot: int, k: int,
-                        itemsize: int = 4, vmem_budget: int = None) -> int:
+                        itemsize: int = 4, vmem_budget: Optional[int] = None) -> int:
     """The list-slab row tile for ``fused_ivf_topk``: the largest divisor
     of ``list_pad`` whose grid-step live set fits the VMEM budget (the
     slab cannot be re-padded — that would copy the whole index — so the
@@ -706,7 +707,7 @@ def plan_fused_ivf_tile(list_pad: int, rot: int, k: int,
 def fused_ivf_workspace_bytes(nq: int, n_probes: int, rot: int,
                               n_lists: int, list_pad: int, k: int,
                               itemsize: int = 4,
-                              pad_tile: int = None) -> int:
+                              pad_tile: Optional[int] = None) -> int:
     """HBM-side workspace of one fused IVF dispatch: the probed slab
     counted twice (staged + held as the kernel operand across the grid
     loop, measured on the CPU interpreter; on TPU the slab is DMA'd in
@@ -806,8 +807,8 @@ def _fused_ivf_topk_pallas(probes, qres, qres_norms, list_data, row_norms,
 
 
 def fused_ivf_topk(probes, qres, qres_norms, list_data, row_norms,
-                   list_indices, k: int, pad_tile: int = None,
-                   clamp: bool = True, vmem_budget: int = None,
+                   list_indices, k: int, pad_tile: Optional[int] = None,
+                   clamp: bool = True, vmem_budget: Optional[int] = None,
                    interpret: bool = False):
     """Fused probe-gather + scan + top-k for the IVF families.
 
@@ -861,7 +862,7 @@ def fused_pq_vmem_bytes(pad_tile: int, pq_dim: int, book: int, pq_len: int,
 
 
 def plan_fused_pq_tile(list_pad: int, pq_dim: int, book: int, pq_len: int,
-                       k: int, vmem_budget: int = None) -> int:
+                       k: int, vmem_budget: Optional[int] = None) -> int:
     """Code-slab row tile for ``fused_pq_topk`` — largest divisor of
     ``list_pad`` fitting the VMEM budget (8-multiples preferred), exactly
     like ``plan_fused_ivf_tile``."""
@@ -881,7 +882,7 @@ def plan_fused_pq_tile(list_pad: int, pq_dim: int, book: int, pq_len: int,
 def fused_pq_workspace_bytes(nq: int, n_probes: int, rot: int,
                              n_lists: int, list_pad: int, pq_dim: int,
                              book: int, pq_len: int, k: int,
-                             pad_tile: int = None) -> int:
+                             pad_tile: Optional[int] = None) -> int:
     """HBM-side workspace of one fused PQ (LUT-engine) dispatch: the
     packed code slab counted twice (staged + kernel operand, same CPU
     interpreter measurement / TPU over-prediction note as
@@ -999,8 +1000,8 @@ def _fused_pq_topk_pallas(probes, q_rot, centers_rot, codebooks, cb_norms,
 
 
 def fused_pq_topk(probes, q_rot, centers_rot, codebooks, cb_norms,
-                  list_codes, list_indices, k: int, pad_tile: int = None,
-                  vmem_budget: int = None, interpret: bool = False):
+                  list_codes, list_indices, k: int, pad_tile: Optional[int] = None,
+                  vmem_budget: Optional[int] = None, interpret: bool = False):
     """Fused PQ LUT build + code gather + accumulate + top-k (ivf_pq's
     LUT regime without the per-probe candidate slab in HBM).
 

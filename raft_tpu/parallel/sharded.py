@@ -218,7 +218,7 @@ def _map_shards(comms: Comms, fn, res: Resources, spans=None) -> dict:
                 seen.add(s)
                 warm.append(r)
     else:
-        warm = [local[0]] + ([local[-1]] if len(local) > 1 else [])
+        warm = [local[0], *([local[-1]] if len(local) > 1 else [])]
     for r in warm:
         run(r)
     rest = [r for r in local if r not in warm]

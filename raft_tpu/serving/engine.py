@@ -858,7 +858,13 @@ class Engine:
         try:
             self.dump_diagnostics(reason=reason)
         except Exception:
-            pass
+            # never an exception out of a failure path, but a recorder
+            # that cannot record is itself an incident signal
+            self.stats.registry.counter(
+                "raft_tpu_serving_diagnostics_dump_errors_total",
+                "Flight-recorder bundles that failed to freeze.",
+                ("engine", "reason")).labels(
+                    self.stats.engine_label, reason).inc()
 
     def _on_batch_failure(self, epoch: Optional[int] = None) -> None:
         """Report a failed batch to the breaker; when that re-opens it

@@ -68,9 +68,9 @@ _REQUEST_EVENTS = ("submitted", "completed", "cancelled", "shed_deadline",
 
 #: shadow-sampling accounting (shadow_total's ``event`` vocabulary) —
 #: mirrors obs.quality.SHADOW_EVENTS; sampled = evaluated + shed_queue +
-#: shed_deadline + error + still-queued at every instant
+#: shed_deadline + shed_close + error + still-queued at every instant
 _SHADOW_EVENTS = ("sampled", "evaluated", "shed_queue", "shed_deadline",
-                  "error")
+                  "shed_close", "error")
 
 
 class ServingStats:

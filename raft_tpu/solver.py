@@ -15,7 +15,7 @@ host-side solves, ``solve_host`` wraps scipy's Jonker-Volgenant.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,7 +72,7 @@ def _auction_jit(cost, eps, maximize: bool, max_iters: int):
     return col_of_row, unassigned
 
 
-def solve(cost, maximize: bool = False, eps: float = None,
+def solve(cost, maximize: bool = False, eps: Optional[float] = None,
           max_iters: int = 0) -> Tuple[jax.Array, jax.Array]:
     """Solve the square dense assignment problem on-device via auction
     (reference entry: LinearAssignmentProblem::solve,

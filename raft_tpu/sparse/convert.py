@@ -10,6 +10,8 @@ provides it."""
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -52,7 +54,7 @@ def coo_to_dense(coo: COO) -> jax.Array:
     return out.at[coo.rows, coo.cols].add(coo.data)
 
 
-def dense_to_csr(dense, nnz: int = None) -> CSR:
+def dense_to_csr(dense, nnz: Optional[int] = None) -> CSR:
     """Dense → CSR with a static nnz (TPU shapes must be static: callers pass
     the known/max nnz; surplus slots become explicit zeros at (0, 0) —
     harmless under duplicate-sum densification)."""

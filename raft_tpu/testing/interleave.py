@@ -156,10 +156,10 @@ class InterleaveAmplifier:
         rng = self._rng()
 
         def line_tracer(frame, event, arg):
-            if event == "line" and rng.random() < self.yield_probability:
-                if self._line_matches(frame.f_code.co_filename,
-                                      frame.f_lineno):
-                    time.sleep(self.sleep_s if rng.random() < 0.5 else 0.0)
+            if (event == "line" and rng.random() < self.yield_probability
+                    and self._line_matches(frame.f_code.co_filename,
+                                           frame.f_lineno)):
+                time.sleep(self.sleep_s if rng.random() < 0.5 else 0.0)
             return line_tracer
 
         return line_tracer

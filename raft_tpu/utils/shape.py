@@ -49,7 +49,7 @@ def pad_rows(x, target_rows: int, fill=0):
     n = x.shape[0]
     if n == target_rows:
         return x
-    pad_widths = [(0, target_rows - n)] + [(0, 0)] * (x.ndim - 1)
+    pad_widths = [(0, target_rows - n), *[(0, 0)] * (x.ndim - 1)]
     if isinstance(x, np.ndarray):
         return np.pad(x, pad_widths, constant_values=fill)
     return jnp.pad(x, pad_widths, constant_values=fill)

@@ -1,0 +1,15 @@
+"""F001 fixture: the module declares a typed failure hierarchy in its
+``__all__`` but one raise site reaches for a bare ``RuntimeError`` —
+callers that classify failures by isinstance cannot route it."""
+
+__all__ = ["ShardError"]
+
+
+class ShardError(Exception):
+    pass
+
+
+def lookup(table, shard):
+    if shard not in table:
+        raise RuntimeError(f"no shard {shard}")  # untyped: the finding
+    return table[shard]

@@ -1,0 +1,37 @@
+"""F001 clean twin: every raise resolves into the module's exported
+typed hierarchy (directly, or via the class-hierarchy index for
+non-exported subclasses), uses the TypeError/ValueError/AssertionError
+validation whitelist, or is a bare re-raise."""
+
+__all__ = ["ShardError", "ShardTimeout"]
+
+
+class ShardError(Exception):
+    pass
+
+
+class ShardTimeout(ShardError):
+    pass
+
+
+class _Internal(ShardError):
+    # not exported, but resolves to ShardError through the hierarchy
+    pass
+
+
+def lookup(table, shard):
+    if not isinstance(shard, int):
+        raise TypeError("shard must be an int")  # validation whitelist
+    try:
+        return table[shard]
+    except KeyError:
+        raise ShardTimeout(f"no shard {shard}") from None
+
+
+def probe(table, shard):
+    try:
+        return lookup(table, shard)
+    except ShardTimeout:
+        raise  # bare re-raise keeps the original type
+    except ShardError as e:
+        raise _Internal(str(e))

@@ -1,0 +1,9 @@
+"""F002 fixture: the function owns the future it was handed (it settles
+on one branch) but the other branch returns without settling or visibly
+handing it off — a caller blocked on ``fut.result()`` hangs forever."""
+
+
+def finish(fut, outcome):
+    if outcome is not None:
+        fut.set_result(outcome)
+    return outcome  # the no-outcome path leaks fut unsettled

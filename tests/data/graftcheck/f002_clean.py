@@ -1,0 +1,23 @@
+"""F002 clean twin: every CFG path — including the exception edge —
+settles the owned future exactly once, and the double-settle race is
+fenced with an InvalidStateError once-guard."""
+
+
+def finish(fut, compute):
+    try:
+        fut.set_result(compute())
+    except Exception as e:
+        fut.set_exception(e)
+
+
+def finish_racy(fut, outcome):
+    # a late completion may race a deadline settle: second set loses
+    try:
+        fut.set_result(outcome)
+    except InvalidStateError:
+        pass
+
+
+def delegate(pool, query):
+    fut = pool.submit(query)
+    return fut  # visible hand-off: the caller now owns settlement

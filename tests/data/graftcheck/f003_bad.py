@@ -1,0 +1,14 @@
+"""F003 fixture: the except body swallows the failure — no re-raise, no
+settle, no metric/span/log, no capture — so a shed request simply
+vanishes from the accounting."""
+
+
+def drain(batch):
+    done = 0
+    for job in batch:
+        try:
+            job.run()
+            done += 1
+        except Exception:
+            pass  # the finding: failure leaves no trace anywhere
+    return done

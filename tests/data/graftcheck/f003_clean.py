@@ -1,0 +1,37 @@
+"""F003 clean twin: every handler accounts for the failure — records a
+metric, captures it into state, re-raises — and the best-effort
+teardown idiom (``try: sock.close() / except OSError: pass``) is
+exempt because silence IS the correct accounting for a socket that is
+already dying."""
+
+
+def drain(batch, errors_total, log):
+    done = 0
+    last_error = None
+    for job in batch:
+        try:
+            job.run()
+            done += 1
+        except TimeoutError:
+            errors_total.inc()
+        except ValueError as e:
+            last_error = e
+        except Exception:
+            log.exception("job failed")
+    if last_error is not None:
+        raise last_error
+    return done
+
+
+def reroute(job, primary, fallback):
+    try:
+        return primary.run(job)
+    except ConnectionError:
+        return fallback.run(job)  # the return IS the handling
+
+
+def hangup(sock):
+    try:
+        sock.close()
+    except OSError:
+        pass  # best-effort teardown: the peer is already gone

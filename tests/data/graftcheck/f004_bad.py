@@ -1,0 +1,19 @@
+"""F004 fixture: ``__init__`` stores a worker thread on ``self`` but no
+``join`` is reachable from stop/close/__exit__ — stop() flips a flag
+and forgets the thread, so shutdown leaks it."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while not self._stop.wait(0.05):
+            pass
+
+    def stop(self):
+        self._stop.set()  # the finding: self._worker is never joined

@@ -1,0 +1,32 @@
+"""F004 clean twin: every self-held resource is reclaimed from a
+stop/close root, including through the alias-swap idiom (``t,
+self._t = self._t, None`` then ``t.join()``) that the serving stack
+uses to make stop() idempotent."""
+
+import threading
+
+
+class Pump:
+    def __init__(self, interval_s):
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._timer = threading.Timer(interval_s, self._tick)
+        self._worker.start()
+        self._timer.start()
+
+    def _run(self):
+        while not self._stop.wait(0.05):
+            pass
+
+    def _tick(self):
+        pass
+
+    def stop(self):
+        self._stop.set()
+        w, self._worker = self._worker, None
+        if w is not None:
+            w.join()
+        self._timer.cancel()
+
+    def __exit__(self, *exc):
+        self.stop()

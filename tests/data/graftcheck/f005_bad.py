@@ -1,0 +1,12 @@
+"""F005 fixture: a request-path method blocks on ``result()`` with no
+timeout — an unhealthy dependency now wedges the caller's thread
+instead of degrading the one request."""
+
+
+class Client:
+    def __init__(self, pool):
+        self._pool = pool
+
+    def fetch(self, query):
+        fut = self._pool.submit(query)
+        return fut.result()  # the finding: unbudgeted block

@@ -1,0 +1,34 @@
+"""F005 clean twin: request-path blocking derives its budget from the
+caller's deadline, and the bare wait on the background worker thread is
+exempt — an idle park on a non-request thread is not a request stall."""
+
+import threading
+import time
+
+
+class Client:
+    def __init__(self, pool):
+        self._pool = pool
+
+    def fetch(self, query, deadline_s):
+        fut = self._pool.submit(query)
+        remaining = deadline_s - time.monotonic()
+        return fut.result(timeout=remaining)
+
+
+class Worker:
+    def __init__(self):
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._wake.wait()  # background idle park: exempt
+            self._wake.clear()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        self._thread.join()

@@ -1,0 +1,88 @@
+"""Shared fixture-twin plumbing for the graftcheck suites.
+
+Every tier's tests do the same four things: load a one-violation
+fixture twin from ``tests/data/graftcheck``, assert the bad twin is
+caught and the clean twin is silent, check an inline ``# graftcheck:
+RXXX`` suppression is honored, and drive ``tools/graftcheck.py`` as a
+subprocess against an injected violation. This module is that
+boilerplate, factored once; the tier suites keep only what is specific
+to their rules.
+
+A *runner* here is any callable ``(ModuleInfo) -> List[Finding]`` —
+for one-argument rules pass the rule itself, for context-taking rules
+(Tier F) pass a lambda that builds the context per module.
+"""
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from raft_tpu.analysis import ModuleInfo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
+
+
+def fixture_src(fname: str) -> str:
+    with open(os.path.join(FIXDIR, fname)) as f:
+        return f.read()
+
+
+def fixture_mod(fname: str, modname: Optional[str] = None) -> ModuleInfo:
+    """A fixture twin as a ModuleInfo, under the conventional
+    ``raft_tpu.fixture_pkg_b`` modname unless the rule is scoped."""
+    return ModuleInfo(os.path.join(FIXDIR, fname),
+                      f"tests/data/graftcheck/{fname}",
+                      modname or f"raft_tpu.fixture_pkg_b.{fname[:-3]}")
+
+
+def tmp_mod(tmp_path, name: str, src: str,
+            modname: Optional[str] = None) -> ModuleInfo:
+    """Write ``src`` under ``tmp_path`` and parse it as a ModuleInfo."""
+    p = tmp_path / name
+    p.write_text(src)
+    return ModuleInfo(str(p), name,
+                      modname or f"raft_tpu.fixture.{name[:-3]}")
+
+
+def check_twin(runner, rule_id: str, stem: str, expect_qual: str) -> None:
+    """The twin contract: ``{stem}_bad.py`` yields exactly one finding
+    of ``rule_id`` at ``expect_qual``; ``{stem}_clean.py`` is silent."""
+    found = runner(fixture_mod(f"{stem}_bad.py"))
+    assert [(f.rule, f.qualname) for f in found] == [(rule_id, expect_qual)], \
+        [f.format() for f in found]
+    clean = runner(fixture_mod(f"{stem}_clean.py"))
+    assert clean == [], [f.format() for f in clean]
+
+
+def check_suppression(runner, tmp_path, fname: str, anchor: str,
+                      rule_id: str, modname: Optional[str] = None) -> None:
+    """Appending ``# graftcheck: {rule_id}`` to the line containing
+    ``anchor`` silences the bad twin's finding."""
+    src = fixture_src(fname)
+    assert anchor in src, (fname, anchor)
+    src = src.replace(anchor, f"{anchor}  # graftcheck: {rule_id}", 1)
+    mod = tmp_mod(tmp_path, fname.replace(".py", "_supp.py"), src, modname)
+    found = runner(mod)
+    assert found == [], [f.format() for f in found]
+
+
+def run_cli(*args, cwd=None):
+    """``tools/graftcheck.py`` as CI runs it; returns CompletedProcess."""
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
+         *args],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def inject(tmp_path, fname: str, subdir: str = "raft_tpu",
+           as_name: str = "injected.py") -> str:
+    """Copy a bad twin into a scratch tree for CLI gate tests; returns
+    the scratch root."""
+    pkg = tmp_path
+    for part in subdir.split("/"):
+        pkg = pkg / part
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / as_name).write_text(fixture_src(fname))
+    return str(tmp_path)

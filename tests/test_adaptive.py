@@ -185,7 +185,7 @@ def test_hypervolume_staircase_area():
     # area: recall 0→0.5 at qps 100, plus 0.5→1.0 at qps 10
     assert hypervolume(pts) == pytest.approx(0.5 * 100 + 0.5 * 10)
     # dominated points don't change the curve
-    assert hypervolume(pts + [_pt(0.4, 50.0, 1.0)]) == \
+    assert hypervolume([*pts, _pt(0.4, 50.0, 1.0)]) == \
         pytest.approx(hypervolume(pts))
 
 

@@ -249,6 +249,27 @@ def test_auto_dump_rate_limit_swallows_flaps(searcher, tmp_path):
         assert manual is not first
 
 
+def test_auto_dump_failure_is_counted(searcher, tmp_path):
+    # graftcheck F003 regression: a recorder that cannot record must
+    # not vanish — the failure lands in the registry it was meant to
+    # snapshot
+    rng = np.random.default_rng(5)
+    with _engine(searcher, hang_timeout_s=None,
+                 diagnostics_dir=str(tmp_path)) as eng:
+        eng.search(_q(rng), K)
+
+        def broken_dump(reason=None, **kw):
+            raise RuntimeError("serializer broke")
+
+        eng.dump_diagnostics = broken_dump
+        eng._auto_dump("breaker_open")  # must not raise
+        fam = eng.stats.registry.get(
+            "raft_tpu_serving_diagnostics_dump_errors_total")
+        assert fam is not None
+        counts = {labels: child.value for labels, child in fam.collect()}
+        assert counts[(eng.stats.engine_label, "breaker_open")] == 1
+
+
 def _get(url):
     try:
         with urllib.request.urlopen(url, timeout=5) as resp:

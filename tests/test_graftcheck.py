@@ -3,10 +3,11 @@ twins), the baseline round-trip, and the Tier-B jaxpr memory audit
 cross-checked against the itemized LUT model from docs/tuning.md."""
 import json
 import os
-import subprocess
-import sys
 
 import pytest
+from graftcheck_util import (REPO, check_suppression, check_twin,
+                             fixture_mod as _mod, fixture_src, inject,
+                             run_cli, tmp_mod)
 
 from raft_tpu.analysis import (AST_RULES, ModuleInfo, check_layering,
                                load_baseline, run_tier_a, save_baseline,
@@ -17,33 +18,17 @@ from raft_tpu.analysis.rules_ast import (rule_host_sync, rule_recompile_hazard,
                                          rule_unguarded_broadcast,
                                          rule_untraced_entry_point)
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
-
-
-def _mod(fname, modname):
-    return ModuleInfo(os.path.join(FIXDIR, fname),
-                      f"tests/data/graftcheck/{fname}", modname)
-
 
 # ------------------------------------------------------------ Tier A rules
 
-@pytest.mark.parametrize("rule,bad,clean,expect_qual", [
-    (rule_host_sync, "r001_bad.py", "r001_clean.py", "pulls_to_host"),
-    (rule_traced_branch, "r002_bad.py", "r002_clean.py",
-     "branches_on_tracer"),
-    (rule_recompile_hazard, "r003_bad.py", "r003_clean.py",
-     "compiles_every_iteration"),
-    (rule_unguarded_broadcast, "r005_bad.py", "r005_clean.py",
-     "gathers_everything"),
+@pytest.mark.parametrize("rule,rule_id,stem,expect_qual", [
+    (rule_host_sync, "R001", "r001", "pulls_to_host"),
+    (rule_traced_branch, "R002", "r002", "branches_on_tracer"),
+    (rule_recompile_hazard, "R003", "r003", "compiles_every_iteration"),
+    (rule_unguarded_broadcast, "R005", "r005", "gathers_everything"),
 ], ids=["R001", "R002", "R003", "R005"])
-def test_rule_flags_bad_and_passes_clean(rule, bad, clean, expect_qual):
-    rule_id = {rule_host_sync: "R001", rule_traced_branch: "R002",
-               rule_recompile_hazard: "R003",
-               rule_unguarded_broadcast: "R005"}[rule]
-    found = rule(_mod(bad, f"raft_tpu.fixture_pkg_b.{bad[:-3]}"))
-    assert [(f.rule, f.qualname) for f in found] == [(rule_id, expect_qual)]
-    assert rule(_mod(clean, f"raft_tpu.fixture_pkg_b.{clean[:-3]}")) == []
+def test_rule_flags_bad_and_passes_clean(rule, rule_id, stem, expect_qual):
+    check_twin(rule, rule_id, stem, expect_qual)
 
 
 def test_clean_twins_pass_every_rule():
@@ -75,13 +60,11 @@ def test_r006_ignores_modules_outside_neighbors():
 
 
 def test_r006_suppression_on_def_line(tmp_path):
-    src = open(os.path.join(FIXDIR, "r006_bad.py")).read()
+    src = fixture_src("r006_bad.py")
     src = src.replace("def build(dataset):",
                       "def build(dataset):  # graftcheck: R006")
-    p = tmp_path / "r006_suppressed.py"
-    p.write_text(src)
-    mod = ModuleInfo(str(p), "r006_suppressed.py",
-                     "raft_tpu.neighbors.r006_suppressed")
+    mod = tmp_mod(tmp_path, "r006_suppressed.py", src,
+                  "raft_tpu.neighbors.r006_suppressed")
     assert [f.qualname for f in rule_untraced_entry_point(mod)] == ["search"]
 
 
@@ -123,15 +106,9 @@ def test_r007_ignores_out_of_scope_and_exempt_modules():
 
 
 def test_r007_suppression_on_dispatch_line(tmp_path):
-    src = open(os.path.join(FIXDIR, "r007_bad.py")).read()
-    src = src.replace(
-        'pk.fused_dispatch("brute_force", scan_mode)',
-        'pk.fused_dispatch("brute_force", scan_mode)  # graftcheck: R007')
-    p = tmp_path / "r007_suppressed.py"
-    p.write_text(src)
-    mod = ModuleInfo(str(p), "r007_suppressed.py",
-                     "raft_tpu.neighbors.r007_suppressed")
-    assert rule_unattributed_dispatch(mod) == []
+    check_suppression(rule_unattributed_dispatch, tmp_path, "r007_bad.py",
+                      'pk.fused_dispatch("brute_force", scan_mode)', "R007",
+                      modname="raft_tpu.neighbors.r007_supp")
 
 
 def test_r007_repo_dispatch_sites_are_all_attributed():
@@ -198,12 +175,8 @@ def test_layering_allows_same_package_private_use():
 
 
 def test_inline_suppression(tmp_path):
-    src = open(os.path.join(FIXDIR, "r002_bad.py")).read()
-    src = src.replace("    if s:", "    if s:  # graftcheck: R002")
-    p = tmp_path / "suppressed.py"
-    p.write_text(src)
-    mod = ModuleInfo(str(p), "suppressed.py", "raft_tpu.fixture.suppressed")
-    assert rule_traced_branch(mod) == []
+    check_suppression(rule_traced_branch, tmp_path, "r002_bad.py",
+                      "    if s:", "R002")
 
 
 # ------------------------------------------------------- baseline handling
@@ -246,14 +219,8 @@ def test_repo_is_clean_under_committed_baseline():
 
 
 def test_cli_nonzero_on_injected_violation(tmp_path):
-    pkg = tmp_path / "raft_tpu" / "fixture_pkg_b"
-    pkg.mkdir(parents=True)
-    bad = open(os.path.join(FIXDIR, "r001_bad.py")).read()
-    (pkg / "injected.py").write_text(bad)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
-         "--root", str(tmp_path), "--no-baseline"],
-        capture_output=True, text=True)
+    root = inject(tmp_path, "r001_bad.py", subdir="raft_tpu/fixture_pkg_b")
+    proc = run_cli("--root", root, "--no-baseline")
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "R001" in proc.stdout and "pulls_to_host" in proc.stdout
 
@@ -314,17 +281,11 @@ def test_cli_fails_on_placeholder_justification(tmp_path):
     run even when the findings themselves are all baselined."""
     from raft_tpu.analysis import PLACEHOLDER_JUSTIFICATION
 
-    pkg = tmp_path / "raft_tpu" / "fixture_pkg_b"
-    pkg.mkdir(parents=True)
-    bad = open(os.path.join(FIXDIR, "r001_bad.py")).read()
-    (pkg / "injected.py").write_text(bad)
+    root = inject(tmp_path, "r001_bad.py", subdir="raft_tpu/fixture_pkg_b")
     baseline = tmp_path / "baseline.json"
 
     def run(*extra):
-        return subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
-             "--root", str(tmp_path), "--baseline", str(baseline), *extra],
-            capture_output=True, text=True)
+        return run_cli("--root", root, "--baseline", str(baseline), *extra)
 
     # record the baseline: save_baseline stamps the placeholder text
     proc = run("--update-baseline")

@@ -1,0 +1,315 @@
+"""graftcheck --flow suite: F001–F005 on one-violation fixture twins,
+the CFG walker's path/exception-edge semantics, the repo gate (every
+live finding fixed or baseline-justified), the non-vacuity counters,
+and the CLI rc/--rules/--json contract."""
+import json
+import os
+
+import pytest
+from graftcheck_util import (REPO, check_suppression, check_twin,
+                             fixture_mod as _mod, inject, run_cli, tmp_mod)
+
+from raft_tpu.analysis import (FLOW_RULES, flow_stats, load_baseline,
+                               run_flow, split_by_baseline)
+from raft_tpu.analysis.flow import (FlowContext, rule_resource_lifecycle,
+                                    rule_settle_discipline,
+                                    rule_swallowed_exception,
+                                    rule_unbudgeted_blocking,
+                                    rule_untyped_raise)
+
+RULES = {"F001": rule_untyped_raise, "F002": rule_settle_discipline,
+         "F003": rule_swallowed_exception, "F004": rule_resource_lifecycle,
+         "F005": rule_unbudgeted_blocking}
+
+
+def _run(rule):
+    # flow rules take (mod, ctx); the fixture's own __all__ is the
+    # typed-export fallback when no serving package is in scope
+    return lambda mod: rule(mod, FlowContext([mod]))
+
+
+# ------------------------------------------------------------ F-rule twins
+
+@pytest.mark.parametrize("rule_id,stem,expect_qual", [
+    ("F001", "f001", "lookup"),
+    ("F002", "f002", "finish"),
+    ("F003", "f003", "drain"),
+    ("F004", "f004", "Pump._worker"),
+    ("F005", "f005", "Client.fetch"),
+], ids=list(RULES))
+def test_rule_flags_bad_and_passes_clean(rule_id, stem, expect_qual):
+    check_twin(_run(RULES[rule_id]), rule_id, stem, expect_qual)
+
+
+def test_clean_twins_pass_every_flow_rule():
+    for stem in ("f001", "f002", "f003", "f004", "f005"):
+        mod = _mod(f"{stem}_clean.py")
+        ctx = FlowContext([mod])
+        for rule in FLOW_RULES:
+            assert rule(mod, ctx) == [], (stem, rule.__name__)
+
+
+@pytest.mark.parametrize("rule_id,fname,anchor", [
+    ("F001", "f001_bad.py", "# untyped: the finding"),
+    ("F002", "f002_bad.py", "# the no-outcome path leaks fut unsettled"),
+    ("F003", "f003_bad.py", "except Exception:"),
+    ("F004", "f004_bad.py",
+     "self._worker = threading.Thread(target=self._run, daemon=True)"),
+    ("F005", "f005_bad.py", "# the finding: unbudgeted block"),
+], ids=list(RULES))
+def test_inline_suppression(tmp_path, rule_id, fname, anchor):
+    check_suppression(_run(RULES[rule_id]), tmp_path, fname, anchor, rule_id)
+
+
+# ------------------------------------------- F001 str(e) matching finding
+
+def test_f001_str_e_matching_is_its_own_finding(tmp_path):
+    src = (
+        '__all__ = ["BoomError"]\n\n\n'
+        "class BoomError(Exception):\n"
+        "    pass\n\n\n"
+        "def classify(op):\n"
+        "    try:\n"
+        "        op()\n"
+        "    except Exception as e:\n"
+        '        if "shard" in str(e):\n'
+        '            raise BoomError("shard")\n'
+        '        raise BoomError("other")\n'
+    )
+    mod = tmp_mod(tmp_path, "stre.py", src)
+    found = rule_untyped_raise(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F001", "classify")]
+    assert "matching" in found[0].message and "str(" in found[0].message
+
+
+# ----------------------------------------------- F002 CFG path semantics
+
+def test_f002_double_settle_without_once_guard(tmp_path):
+    src = (
+        "def finish(fut, a, b):\n"
+        "    fut.set_result(a)\n"
+        "    fut.set_result(b)\n"
+    )
+    mod = tmp_mod(tmp_path, "double.py", src)
+    found = rule_settle_discipline(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F002", "finish")]
+    assert "settled twice" in found[0].message
+
+
+def test_f002_once_guard_accepts_double_settle_race(tmp_path):
+    src = (
+        "def finish(fut, a, b):\n"
+        "    try:\n"
+        "        fut.set_result(a)\n"
+        "        fut.set_result(b)\n"
+        "    except InvalidStateError:\n"
+        "        pass\n"
+    )
+    mod = tmp_mod(tmp_path, "guarded.py", src)
+    assert rule_settle_discipline(mod, FlowContext([mod])) == []
+
+
+def test_f002_early_return_before_local_future_exists(tmp_path):
+    # the Fleet._attempt shape: a shed path returns before the future is
+    # ever created — that path owes nothing
+    src = (
+        "def attempt(pool, req):\n"
+        "    if req.expired:\n"
+        "        return None\n"
+        "    fut = pool.submit(req)\n"
+        "    fut.add_done_callback(req.on_done)\n"
+    )
+    mod = tmp_mod(tmp_path, "early.py", src)
+    assert rule_settle_discipline(mod, FlowContext([mod])) == []
+
+
+def test_f002_exception_edge_is_a_path(tmp_path):
+    # settling only in the try body leaks the future when compute raises
+    src = (
+        "def finish(fut, compute):\n"
+        "    try:\n"
+        "        fut.set_result(compute())\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    mod = tmp_mod(tmp_path, "edge.py", src)
+    found = rule_settle_discipline(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F002", "finish")]
+    assert "unsettled" in found[0].message
+
+
+def test_f002_handler_settle_covers_the_exception_edge(tmp_path):
+    src = (
+        "def finish(fut, compute):\n"
+        "    try:\n"
+        "        fut.set_result(compute())\n"
+        "    except Exception as e:\n"
+        "        fut.set_exception(e)\n"
+    )
+    mod = tmp_mod(tmp_path, "covered.py", src)
+    assert rule_settle_discipline(mod, FlowContext([mod])) == []
+
+
+# -------------------------------------------------- F004 reclaim variants
+
+def test_f004_missing_stop_method_message(tmp_path):
+    src = (
+        "import threading\n\n\n"
+        "class Leaky:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=print)\n"
+    )
+    mod = tmp_mod(tmp_path, "leaky.py", src)
+    found = rule_resource_lifecycle(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F004", "Leaky._t")]
+    assert "no stop/close" in found[0].message
+
+
+def test_f004_reclaim_through_helper_reached_from_stop(tmp_path):
+    src = (
+        "import threading\n\n\n"
+        "class Pump:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=print)\n\n"
+        "    def _teardown(self):\n"
+        "        self._t.join()\n\n"
+        "    def stop(self):\n"
+        "        self._teardown()\n"
+    )
+    mod = tmp_mod(tmp_path, "helper.py", src)
+    assert rule_resource_lifecycle(mod, FlowContext([mod])) == []
+
+
+# ------------------------------------------------- F005 budget derivation
+
+def test_f005_literal_timeout_is_flagged(tmp_path):
+    src = (
+        "class C:\n"
+        "    def fetch(self, pool, q):\n"
+        "        return pool.submit(q).result(timeout=30.0)\n"
+    )
+    mod = tmp_mod(tmp_path, "lit.py", src)
+    found = rule_unbudgeted_blocking(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F005", "C.fetch")]
+    assert "literal timeout 30.0" in found[0].message
+
+
+def test_f005_mapping_get_is_not_a_blocking_get(tmp_path):
+    src = (
+        "class C:\n"
+        "    def tally(self, counts, key):\n"
+        "        return counts.get(key, 0)\n"
+    )
+    mod = tmp_mod(tmp_path, "mapget.py", src)
+    assert rule_unbudgeted_blocking(mod, FlowContext([mod])) == []
+
+
+def test_f005_queue_get_with_bool_block_and_literal_timeout(tmp_path):
+    src = (
+        "class C:\n"
+        "    def take(self, q):\n"
+        "        return q.get(True, 5)\n"
+    )
+    mod = tmp_mod(tmp_path, "qget.py", src)
+    found = rule_unbudgeted_blocking(mod, FlowContext([mod]))
+    assert [(f.rule, f.qualname) for f in found] == [("F005", "C.take")]
+
+
+def test_f005_lifecycle_methods_are_exempt(tmp_path):
+    # stop() may block unbudgeted: shutdown is not the request path
+    src = (
+        "class C:\n"
+        "    def stop(self):\n"
+        "        self._worker.join()\n"
+    )
+    mod = tmp_mod(tmp_path, "lifecycle.py", src)
+    assert rule_unbudgeted_blocking(mod, FlowContext([mod])) == []
+
+
+# --------------------------------------------------------------- the gate
+
+def test_repo_is_clean_under_committed_baseline():
+    findings = run_flow(REPO)
+    baseline = load_baseline(os.path.join(REPO, "graftcheck_baseline.json"))
+    new, _ = split_by_baseline(findings, baseline)
+    assert new == [], "\n".join(f.format() for f in new)
+
+
+def test_flow_sweep_is_not_vacuous():
+    # a resolver regression must not pass as "zero findings" silently:
+    # the sweep must have actually seen the serving fabric
+    s = flow_stats(REPO)
+    assert s["modules"] >= 10, s
+    assert s["raise_sites"] >= 5, s
+    assert s["settle_owners"] >= 3, s
+    assert s["resources"] >= 3, s
+
+
+def test_cli_flow_nonzero_on_injected_violation(tmp_path):
+    root = inject(tmp_path, "f001_bad.py", subdir="raft_tpu/serving")
+    proc = run_cli("--root", root, "--no-baseline", "--flow")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "F001" in proc.stdout and "lookup" in proc.stdout
+    assert "[flow]" in proc.stdout  # the sweep stats line
+
+
+def test_cli_rules_filter_scopes_the_gate(tmp_path):
+    root = inject(tmp_path, "f001_bad.py", subdir="raft_tpu/serving")
+    proc = run_cli("--root", root, "--no-baseline", "--flow",
+                   "--rules", "F001")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    proc = run_cli("--root", root, "--no-baseline", "--flow",
+                   "--rules", "F002")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "F001" not in [ln[:4] for ln in proc.stdout.splitlines()]
+
+
+def test_cli_without_flow_skips_f_rules(tmp_path):
+    root = inject(tmp_path, "f001_bad.py", subdir="raft_tpu/serving")
+    proc = run_cli("--root", root, "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "F001" not in proc.stdout
+
+
+# ------------------------------------------------------------- --json dump
+
+def test_cli_json_dump_and_baselined_flag(tmp_path):
+    root = inject(tmp_path, "f001_bad.py", subdir="raft_tpu/serving")
+    baseline = tmp_path / "baseline.json"
+    out = tmp_path / "findings.json"
+
+    proc = run_cli("--root", root, "--flow", "--baseline", str(baseline),
+                   "--json", str(out))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == 1
+    (f,) = [e for e in doc["findings"] if e["rule"] == "F001"]
+    assert f["qualname"] == "lookup" and f["baselined"] is False
+    assert f["file"].endswith("injected.py") and f["line"] > 0
+    assert "RuntimeError" in f["message"]
+
+    # record + justify the baseline: same finding now dumps as baselined
+    proc = run_cli("--root", root, "--flow", "--baseline", str(baseline),
+                   "--update-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bdoc = json.loads(baseline.read_text())
+    for e in bdoc["entries"]:
+        e["justification"] = "fixture: exercises the --json baselined flag"
+    baseline.write_text(json.dumps(bdoc))
+    proc = run_cli("--root", root, "--flow", "--baseline", str(baseline),
+                   "--json", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    (f,) = [e for e in doc["findings"] if e["rule"] == "F001"]
+    assert f["baselined"] is True
+
+
+def test_cli_json_to_stdout(tmp_path):
+    root = inject(tmp_path, "f001_bad.py", subdir="raft_tpu/serving")
+    proc = run_cli("--root", root, "--no-baseline", "--flow", "-q",
+                   "--json", "-")
+    assert proc.returncode == 1
+    # the summary line follows the JSON document on stdout
+    doc, _ = json.JSONDecoder().raw_decode(
+        proc.stdout, proc.stdout.index("{"))
+    assert any(e["rule"] == "F001" for e in doc["findings"])

@@ -2,12 +2,13 @@
 the derived thread model, the lock-order DOT export, and the repo gate
 (every live finding fixed or baseline-justified)."""
 import os
-import subprocess
-import sys
 
 import pytest
+from graftcheck_util import (REPO, check_suppression, check_twin,
+                             fixture_mod as _mod, fixture_src, inject,
+                             run_cli, tmp_mod as _util_tmp_mod)
 
-from raft_tpu.analysis import ModuleInfo, load_baseline, split_by_baseline
+from raft_tpu.analysis import load_baseline, split_by_baseline
 from raft_tpu.analysis.concurrency import (THREAD_RULES, build_class_models,
                                            lock_order_dot,
                                            rule_blocking_while_locked,
@@ -16,42 +17,22 @@ from raft_tpu.analysis.concurrency import (THREAD_RULES, build_class_models,
                                            rule_unguarded_shared_state,
                                            run_threads)
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FIXDIR = os.path.join(REPO, "tests", "data", "graftcheck")
-
-
-def _mod(fname, modname=None):
-    return ModuleInfo(os.path.join(FIXDIR, fname),
-                      f"tests/data/graftcheck/{fname}",
-                      modname or f"raft_tpu.fixture_pkg_b.{fname[:-3]}")
-
 
 def _tmp_mod(tmp_path, name, src):
-    p = tmp_path / name
-    p.write_text(src)
-    return ModuleInfo(str(p), name, f"raft_tpu.fixture.{name[:-3]}")
+    return _util_tmp_mod(tmp_path, name, src)
 
 
 # ------------------------------------------------------------ T-rule twins
 
-@pytest.mark.parametrize("rule,bad,clean,expect_qual", [
-    (rule_unguarded_shared_state, "t001_bad.py", "t001_clean.py",
-     "SharedCounter.count"),
-    (rule_lock_order, "t002_bad.py", "t002_clean.py",
+@pytest.mark.parametrize("rule,rule_id,stem,expect_qual", [
+    (rule_unguarded_shared_state, "T001", "t001", "SharedCounter.count"),
+    (rule_lock_order, "T002", "t002",
      "cycle:Transfer._credit_lock->Transfer._debit_lock"),
-    (rule_blocking_while_locked, "t003_bad.py", "t003_clean.py",
-     "Collector.run"),
-    (rule_condition_wait_loop, "t004_bad.py", "t004_clean.py",
-     "Gate.await_ready"),
+    (rule_blocking_while_locked, "T003", "t003", "Collector.run"),
+    (rule_condition_wait_loop, "T004", "t004", "Gate.await_ready"),
 ], ids=["T001", "T002", "T003", "T004"])
-def test_rule_flags_bad_and_passes_clean(rule, bad, clean, expect_qual):
-    rule_id = {rule_unguarded_shared_state: "T001",
-               rule_lock_order: "T002",
-               rule_blocking_while_locked: "T003",
-               rule_condition_wait_loop: "T004"}[rule]
-    found = rule(_mod(bad))
-    assert [(f.rule, f.qualname) for f in found] == [(rule_id, expect_qual)]
-    assert rule(_mod(clean)) == []
+def test_rule_flags_bad_and_passes_clean(rule, rule_id, stem, expect_qual):
+    check_twin(rule, rule_id, stem, expect_qual)
 
 
 def test_clean_twins_pass_every_thread_rule():
@@ -63,15 +44,12 @@ def test_clean_twins_pass_every_thread_rule():
 
 
 def test_t001_suppression_on_write_line(tmp_path):
-    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
-    src = src.replace("self.count = v + 1",
-                      "self.count = v + 1  # graftcheck: T001")
-    mod = _tmp_mod(tmp_path, "t001_suppressed.py", src)
-    assert rule_unguarded_shared_state(mod) == []
+    check_suppression(rule_unguarded_shared_state, tmp_path, "t001_bad.py",
+                      "self.count = v + 1", "T001")
 
 
 def test_t001_bogus_guard_name_is_its_own_finding(tmp_path):
-    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    src = fixture_src("t001_bad.py")
     src = src.replace("self.count = 0",
                       "self.count = 0  # guarded_by: _no_such_lock")
     mod = _tmp_mod(tmp_path, "t001_bogus.py", src)
@@ -81,7 +59,7 @@ def test_t001_bogus_guard_name_is_its_own_finding(tmp_path):
 
 
 def test_t001_atomic_escape_hatch(tmp_path):
-    src = open(os.path.join(FIXDIR, "t001_bad.py")).read()
+    src = fixture_src("t001_bad.py")
     src = src.replace("self.count = 0",
                       "self.count = 0  # guarded_by: atomic")
     mod = _tmp_mod(tmp_path, "t001_atomic.py", src)
@@ -168,11 +146,8 @@ def test_condition_canonicalizes_to_underlying_lock():
 # ---------------------------------------------------------- lock-order DOT
 
 def test_lock_order_dot_renders_cycle_red(tmp_path):
-    pkg = tmp_path / "raft_tpu"
-    pkg.mkdir()
-    bad = open(os.path.join(FIXDIR, "t002_bad.py")).read()
-    (pkg / "transfer.py").write_text(bad)
-    dot = lock_order_dot(str(tmp_path))
+    root = inject(tmp_path, "t002_bad.py", as_name="transfer.py")
+    dot = lock_order_dot(root)
     assert dot.startswith("digraph lock_order")
     assert '"Transfer._debit_lock" -> "Transfer._credit_lock"' in dot
     assert '"Transfer._credit_lock" -> "Transfer._debit_lock"' in dot
@@ -199,16 +174,10 @@ def test_repo_is_clean_under_committed_baseline():
 
 
 def test_cli_threads_nonzero_on_injected_violation(tmp_path):
-    pkg = tmp_path / "raft_tpu"
-    pkg.mkdir()
-    bad = open(os.path.join(FIXDIR, "t001_bad.py")).read()
-    (pkg / "injected.py").write_text(bad)
+    root = inject(tmp_path, "t001_bad.py")
     dot_path = tmp_path / "lock_order.dot"
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
-         "--root", str(tmp_path), "--no-baseline", "--threads",
-         "--dot", str(dot_path)],
-        capture_output=True, text=True)
+    proc = run_cli("--root", root, "--no-baseline", "--threads",
+                   "--dot", str(dot_path))
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "T001" in proc.stdout
     assert "SharedCounter.count" in proc.stdout
@@ -218,22 +187,13 @@ def test_cli_threads_nonzero_on_injected_violation(tmp_path):
 
 
 def test_cli_dot_requires_threads(tmp_path):
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
-         "--root", str(tmp_path), "--dot", "-"],
-        capture_output=True, text=True)
+    proc = run_cli("--root", str(tmp_path), "--dot", "-")
     assert proc.returncode == 2
     assert "--dot requires --threads" in proc.stderr
 
 
 def test_cli_without_threads_skips_t_rules(tmp_path):
-    pkg = tmp_path / "raft_tpu"
-    pkg.mkdir()
-    bad = open(os.path.join(FIXDIR, "t001_bad.py")).read()
-    (pkg / "injected.py").write_text(bad)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "graftcheck.py"),
-         "--root", str(tmp_path), "--no-baseline"],
-        capture_output=True, text=True)
+    root = inject(tmp_path, "t001_bad.py")
+    proc = run_cli("--root", root, "--no-baseline")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "T001" not in proc.stdout

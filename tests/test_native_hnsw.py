@@ -38,14 +38,14 @@ def _golden_bytes(data, graph, max_level, enterpoint, mult, ef):
         struct.pack("<Q", degree // 2),        # M
         struct.pack("<d", mult),
         struct.pack("<Q", ef),                 # efConstruction
-    ] + [
         # per element: [int link_count][degree x uint32][dim x f32][size_t]
-        struct.pack("<i", degree)
-        + graph[i].astype("<u4").tobytes()
-        + data[i].astype("<f4").tobytes()
-        + struct.pack("<Q", i)
-        for i in range(n)
-    ] + [struct.pack("<i", 0)] * n)            # linkListSize zeros
+        *(struct.pack("<i", degree)
+          + graph[i].astype("<u4").tobytes()
+          + data[i].astype("<f4").tobytes()
+          + struct.pack("<Q", i)
+          for i in range(n)),
+        *[struct.pack("<i", 0)] * n,           # linkListSize zeros
+    ])
 
 
 def test_hnswlib_golden_byte_layout(tmp_path):

@@ -239,9 +239,9 @@ def test_timed_span_durations_and_errors():
     (r,) = sink.records
     assert r["kind"] == "phase" and r["step"] == "warmup" and r["n"] == 7
     assert r["duration_ms"] >= 0 and len(r["trace_id"]) == 16
-    with pytest.raises(ValueError, match="boom"):
-        with obs.timed_span(sink, "phase", trace_id="ff" * 8):
-            raise ValueError("boom")
+    with pytest.raises(ValueError, match="boom"), \
+            obs.timed_span(sink, "phase", trace_id="ff" * 8):
+        raise ValueError("boom")
     failed = sink.records[-1]
     assert failed["trace_id"] == "ff" * 8
     assert failed["error"].startswith("ValueError")
@@ -284,6 +284,22 @@ def test_metrics_server_503_when_health_fn_raises():
     with MetricsServer(port=0, health_fn=bad_health) as srv:
         code, body = _get(srv.url + "/healthz")
         assert code == 503 and "engine gone" in body
+
+
+def test_metrics_server_counts_handler_failures():
+    # graftcheck F003 regression: a handler failure must not vanish —
+    # the 500 is sent AND the error lands in the scraped registry
+    def bad_slo():
+        raise RuntimeError("monitor gone")
+
+    reg = obm.Registry()
+    with MetricsServer(port=0, registry=reg, slo_fn=bad_slo) as srv:
+        code, body = _get(srv.url + "/slo")
+        assert code == 500 and "monitor gone" in body
+        fam = reg.get("raft_tpu_http_errors_total")
+        assert fam is not None
+        counts = {labels: child.value for labels, child in fam.collect()}
+        assert counts[("/slo", "RuntimeError")] == 1
 
 
 def test_metrics_server_defaults_to_global_registry():

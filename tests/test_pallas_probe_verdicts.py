@@ -55,5 +55,4 @@ def test_off_tpu_host_can_never_mint_verdicts():
     # complete-looking artifact is XLA-vs-XLA timings — all required
     got = pallas_probe.missing_verdicts(FULL, on_tpu=False,
                                         mergeable_mesh=True)
-    assert got == list(pallas_probe.REQUIRED_VERDICT_FAMILIES) + \
-        ["merge_ring"]
+    assert got == [*pallas_probe.REQUIRED_VERDICT_FAMILIES, "merge_ring"]

@@ -154,6 +154,31 @@ def test_sampler_sheds_on_full_queue():
     assert by_outcome["shed_queue"] == "t-shed"
 
 
+def test_close_with_full_queue_still_stops_worker():
+    # graftcheck F002/F003 triage regression: close() used to drop the
+    # sentinel when the bounded queue was full, leaving the worker
+    # parked on the queue forever — it must evict a sample instead
+    record, tally = _events()
+    entered, release = threading.Event(), threading.Event()
+
+    def slow_oracle(queries, k):
+        entered.set()
+        release.wait(10)
+        n = np.asarray(queries).shape[0]
+        return np.zeros((n, k)), np.tile(np.arange(k), (n, 1))
+
+    s = ShadowSampler(slow_oracle, rate=1.0, queue_limit=1,
+                      record_event=record, registry=obm.Registry())
+    _offer_one(s, "t-worker")       # dequeued, wedges the worker
+    assert entered.wait(10)
+    _offer_one(s, "t-queued")       # occupies the single queue slot
+    s.close(timeout=0.2)            # full queue: sentinel must still land
+    assert tally.get("shed_close") == 1  # the evicted sample is counted
+    release.set()
+    s._worker.join(10)
+    assert not s._worker.is_alive()
+
+
 def test_sampler_sheds_stale_items_at_deadline():
     record, tally = _events()
     t = [0.0]
@@ -243,7 +268,8 @@ def _reconcile_shadow(sink, stats):
     sheds + error, and shadow_eval spans match the accounting 1:1."""
     sc = stats.shadow_counts
     assert sc["sampled"] == (sc["evaluated"] + sc["shed_queue"]
-                             + sc["shed_deadline"] + sc["error"]), sc
+                             + sc["shed_deadline"] + sc["shed_close"]
+                             + sc["error"]), sc
     spans = [r for r in sink.records if r["kind"] == "shadow_eval"]
     tally = collections.Counter(r["outcome"] for r in spans)
     assert tally.get("ok", 0) == sc["evaluated"], (dict(tally), sc)
@@ -381,7 +407,7 @@ def test_stats_views_isolate_engines_on_a_shared_registry():
     assert b.bucket_hist == {16: 1}
     assert a.shadow_counts == {"sampled": 4, "evaluated": 3,
                                "shed_queue": 1, "shed_deadline": 0,
-                               "error": 0}
+                               "shed_close": 0, "error": 0}
     assert b.shadow_counts["sampled"] == 1
     assert b.shadow_counts["shed_queue"] == 0
 

@@ -90,10 +90,10 @@ def test_extra_records_rejected_by_footer(tmp_path):
 
 def test_atomic_write_failure_leaves_nothing(tmp_path):
     path = str(tmp_path / "atomic")
-    with pytest.raises(RuntimeError, match="boom"):
-        with ser.writer_for(path) as stream:
-            stream.write(b"partial bytes")
-            raise RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="boom"), \
+            ser.writer_for(path) as stream:
+        stream.write(b"partial bytes")
+        raise RuntimeError("boom")
     assert not os.path.exists(path)
     assert os.listdir(tmp_path) == []  # tmp file cleaned up too
 
@@ -102,10 +102,9 @@ def test_atomic_write_preserves_previous_checkpoint(tmp_path):
     path = str(tmp_path / "keep")
     with ser.writer_for(path) as stream:
         stream.write(b"good v1")
-    with pytest.raises(RuntimeError):
-        with ser.writer_for(path) as stream:
-            stream.write(b"half of v2")
-            raise RuntimeError("crash mid-serialize")
+    with pytest.raises(RuntimeError), ser.writer_for(path) as stream:
+        stream.write(b"half of v2")
+        raise RuntimeError("crash mid-serialize")
     with open(path, "rb") as f:
         assert f.read() == b"good v1"  # old checkpoint intact
 

@@ -330,30 +330,30 @@ def test_deadline_shed_is_typed_never_silent(searcher):
 
 
 def test_overload_watermark_shed_and_recovery(searcher):
-    with faults.slow_searcher(searcher, 0.15):
-        with _engine(searcher, max_batch=1, max_wait_us=0, max_inflight=1,
-                     queue_high_watermark=4, queue_low_watermark=1,
-                     hang_timeout_s=None) as eng:
-            futs, rejected = [], 0
-            for _ in range(12):
-                try:
-                    futs.append(eng.submit(np.zeros(DIM, np.float32), K))
-                except serving.Overloaded:
-                    rejected += 1
-            assert rejected > 0
-            assert eng.health()["status"] == "degraded"  # latched
-            assert eng.stats.snapshot()["n_rejected_overload"] == rejected
+    with faults.slow_searcher(searcher, 0.15), \
+            _engine(searcher, max_batch=1, max_wait_us=0, max_inflight=1,
+                    queue_high_watermark=4, queue_low_watermark=1,
+                    hang_timeout_s=None) as eng:
+        futs, rejected = [], 0
+        for _ in range(12):
+            try:
+                futs.append(eng.submit(np.zeros(DIM, np.float32), K))
+            except serving.Overloaded:
+                rejected += 1
+        assert rejected > 0
+        assert eng.health()["status"] == "degraded"  # latched
+        assert eng.stats.snapshot()["n_rejected_overload"] == rejected
 
-            # every ADMITTED request still completes normally
-            for f in futs:
-                d, i = f.result(timeout=120)
-                assert d.shape == (K,)
-            eng.drain(120)
+        # every ADMITTED request still completes normally
+        for f in futs:
+            d, i = f.result(timeout=120)
+            assert d.shape == (K,)
+        eng.drain(120)
 
-            # drained under the low watermark -> admission unlatches
-            f = eng.submit(np.zeros(DIM, np.float32), K)
-            assert f.result(timeout=120)[0].shape == (K,)
-            assert eng.health()["status"] == "ok"
+        # drained under the low watermark -> admission unlatches
+        f = eng.submit(np.zeros(DIM, np.float32), K)
+        assert f.result(timeout=120)[0].shape == (K,)
+        assert eng.health()["status"] == "ok"
 
 
 # --------------------------------------------- stop() vs submitters

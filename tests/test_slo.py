@@ -124,6 +124,28 @@ def test_window_roll_rebaselines_counters():
     assert mon.burn_rate(slo) == pytest.approx(10.0)
 
 
+def test_broken_fast_burn_callback_is_counted_not_raised():
+    # graftcheck F003 regression: a pager hook that raises must neither
+    # fail the scrape path nor vanish — it lands in the registry
+    slo = SLO("avail", "availability", 0.999, fast_burn=14.0)
+    reg = obm.Registry()
+    st = ServingStats(registry=reg, engine_label="eng-a")
+
+    def broken_hook(name, burn):
+        raise RuntimeError("pager misconfigured")
+
+    mon = SLOMonitor([slo], "eng-a", registry=reg, window_s=300.0,
+                     on_fast_burn=broken_hook)
+    _complete(st, 90)
+    st.record_batch_failed(10)
+    burn = mon.burn_rate(slo)  # crossing fires the hook; must not raise
+    assert burn >= 14.0
+    fam = reg.get("raft_tpu_slo_callback_errors_total")
+    assert fam is not None
+    counts = {labels: child.value for labels, child in fam.collect()}
+    assert counts[("eng-a", "avail")] == 1
+
+
 def test_fast_burn_fires_once_per_excursion():
     t = [0.0]
     fired = []

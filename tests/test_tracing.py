@@ -36,9 +36,8 @@ def test_range_as_decorator_preserves_metadata():
 
 def test_exceptions_propagate_from_both_forms():
     r = tracing.range("test.raises")
-    with pytest.raises(ValueError, match="inner"):
-        with r:
-            raise ValueError("inner")
+    with pytest.raises(ValueError, match="inner"), r:
+        raise ValueError("inner")
 
     @tracing.range("test.raises_deco")
     def boom():
@@ -55,10 +54,9 @@ def test_exceptions_propagate_from_both_forms():
 def test_nesting_and_reentrancy():
     outer = tracing.range("test.outer")
     with outer:
-        with tracing.range("test.inner"):
-            # same instance re-entered (recursive decorated function)
-            with outer:
-                assert len(outer._stack) == 2
+        # same instance re-entered (recursive decorated function)
+        with tracing.range("test.inner"), outer:
+            assert len(outer._stack) == 2
         assert len(outer._stack) == 1
     assert outer._stack == []
 

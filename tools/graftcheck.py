@@ -16,12 +16,15 @@ Typical use::
     python tools/graftcheck.py --jaxpr-audit      # Tier A + Tier B
     python tools/graftcheck.py --threads          # + concurrency T001-T004
     python tools/graftcheck.py --threads --dot lock_order.dot
+    python tools/graftcheck.py --flow             # + flow rules F001-F005
+    python tools/graftcheck.py --json out.json    # machine-readable dump
     python tools/graftcheck.py --update-baseline  # re-record the baseline
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -29,9 +32,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
-from raft_tpu.analysis import (load_baseline, run_threads,  # noqa: E402
-                               run_tier_a, save_baseline, split_by_baseline,
-                               unjustified_keys)
+from raft_tpu.analysis import (load_baseline, run_flow,  # noqa: E402
+                               run_threads, run_tier_a, save_baseline,
+                               split_by_baseline, unjustified_keys)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "graftcheck_baseline.json")
 
@@ -61,6 +64,14 @@ def main(argv=None) -> int:
                     help="with --threads: write the acquires-while-"
                          "holding lock-order graph as Graphviz DOT "
                          "('-' = stdout)")
+    ap.add_argument("--flow", action="store_true",
+                    help="also run the Tier-F typed-failure & resource-"
+                         "lifecycle flow rules F001-F005 over the request "
+                         "path (serving/, obs/, host_p2p; pure AST)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write a machine-readable findings dump (rule, "
+                         "file, line, qualname, message, baselined flag); "
+                         "'-' = stdout")
     ap.add_argument("--costs", action="store_true",
                     help="also run the Tier-C compiled-cost calibration "
                          "audit: AOT-compile the canonical cores and flag "
@@ -97,6 +108,16 @@ def main(argv=None) -> int:
                 with open(args.dot, "w") as f:
                     f.write(dot)
                 print(f"graftcheck: lock-order graph -> {args.dot}")
+
+    if args.flow:
+        findings.extend(run_flow(args.root))
+        if not args.quiet:
+            from raft_tpu.analysis import flow_stats
+            s = flow_stats(args.root)
+            print(f"  [flow] {s['modules']} request-path modules: "
+                  f"{s['raise_sites']} raise sites, "
+                  f"{s['settle_owners']} settle owners, "
+                  f"{s['resources']} reclaimable resources")
 
     if args.jaxpr_audit:
         from raft_tpu.analysis import jaxpr_audit
@@ -143,6 +164,22 @@ def main(argv=None) -> int:
         return 0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, suppressed = split_by_baseline(findings, baseline)
+
+    if args.json is not None:
+        baselined_keys = {f.key for f in suppressed}
+        doc = {"version": 1, "findings": [
+            {"rule": f.rule, "file": f.file, "line": f.line,
+             "qualname": f.qualname, "message": f.message,
+             "baselined": f.key in baselined_keys}
+            for f in findings]}
+        payload = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+        if args.json == "-":
+            sys.stdout.write(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload)
+            print(f"graftcheck: findings dump -> {args.json}")
 
     placeholders = unjustified_keys(baseline)
     if placeholders:
@@ -155,8 +192,6 @@ def main(argv=None) -> int:
               f"the 'TODO: justify or fix' placeholder; a suppression "
               f"without a reason is not a suppression")
         return 1
-
-    new, suppressed = split_by_baseline(findings, baseline)
 
     if not args.quiet:
         for f in new:
