@@ -681,15 +681,58 @@ def make_ivf_pq_fused_cache_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES,
     return jax.make_jaxpr(core)(*args)
 
 
+def make_cagra_fused_core(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                          n: int = 1_000_000, nq: int = 1024,
+                          dim: int = 128, graph_degree: int = 64,
+                          k: int = 10, itopk: int = 64, width: int = 1):
+    """cagra fused Pallas beam search at the same 1M shape as
+    ``make_cagra_core``. Unlike the XLA walk (while_loop → vacuous
+    walker bound, excluded from the audited entries), the fused core IS
+    auditable: the traversal runs inside the kernel, whose jaxpr the
+    walker recurses into with VMEM-scale shapes only — the HBM live set
+    it bounds is the in-place ``ANY``-space operands + the small temps
+    ``fused_cagra_workspace_bytes`` predicts for C001 (no staged slab:
+    the design's whole point)."""
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops import pallas_kernels as pk
+    from raft_tpu.ops.distance import DistanceType
+
+    max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    n_seeds = min(max(itopk, 32), n)
+    ct = pk.plan_fused_cagra_tile(itopk, width, graph_degree, dim, n_seeds)
+    meta = {"family": "cagra",
+            "planner": "pallas_kernels.plan_fused_cagra_tile",
+            "predicted_bytes": pk.fused_cagra_workspace_bytes(
+                nq, n, dim, graph_degree, itopk, width, n_seeds, k, ct),
+            "tiles": {"ct": ct, "itopk": itopk, "width": width,
+                      "max_iter": max_iter}}
+
+    def core(queries, dataset, graph, seed_ids):
+        return cagra.search_fused_core(
+            queries, dataset, graph, seed_ids, DistanceType.L2Expanded,
+            k, itopk, width, max_iter, ct, interpret=True)
+
+    args = (
+        _sds((nq, dim), np.float32),
+        _sds((n, dim), np.float32),
+        _sds((n, graph_degree), np.int32),
+        _sds((nq, n_seeds), np.int32))
+    return core, args, meta
+
+
+def make_cagra_fused_jaxpr(budget_bytes: int = DEFAULT_BUDGET_BYTES, **kw):
+    core, args, _ = make_cagra_fused_core(budget_bytes, **kw)
+    return jax.make_jaxpr(core)(*args)
+
+
 def canonical_cores(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
-    """The eleven canonical entrypoints as ``(name, make_core)`` pairs —
+    """The twelve canonical entrypoints as ``(name, make_core)`` pairs —
     the SAME names and shapes ``default_entries`` audits, exposed so the
     compiled-cost layer (:mod:`raft_tpu.obs.costs`) lowers and compiles
     exactly what the jaxpr walker abstract-evals. ``make_core()`` →
     ``(core, args, meta)`` with the planner name + predicted workspace
-    bytes in ``meta``. The four ``[fused*]`` entries are the Pallas
-    scan+select variants, traced in interpret mode so they compile on
-    CPU."""
+    bytes in ``meta``. The five ``[fused*]`` entries are the Pallas
+    engines, traced in interpret mode so they compile on CPU."""
     b = budget_bytes
     return [
         ("ivf_pq.search[lut]@sift1m-crash",
@@ -714,6 +757,8 @@ def canonical_cores(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
          lambda: make_ivf_pq_fused_lut_core(b)),
         ("ivf_pq.search[fused-cache]@sift1m",
          lambda: make_ivf_pq_fused_cache_core(b)),
+        ("cagra.search[fused]@1m",
+         lambda: make_cagra_fused_core(b)),
     ]
 
 
@@ -742,6 +787,8 @@ def default_entries(budget_bytes: int = DEFAULT_BUDGET_BYTES) -> list:
                    lambda: make_ivf_pq_fused_lut_jaxpr(b)),
         AuditEntry("ivf_pq.search[fused-cache]@sift1m", b,
                    lambda: make_ivf_pq_fused_cache_jaxpr(b)),
+        AuditEntry("cagra.search[fused]@1m", b,
+                   lambda: make_cagra_fused_jaxpr(b)),
     ]
 
 

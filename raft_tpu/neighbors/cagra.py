@@ -30,6 +30,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import functools
@@ -42,6 +43,7 @@ import numpy as np
 from raft_tpu.core import serialize as ser
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.neighbors.brute_force import fused_ineligible_reason
 from raft_tpu.obs import explain as obs_explain
 from raft_tpu.ops.distance import (
     DistanceType,
@@ -96,6 +98,12 @@ class SearchParams:
     #: reference's half-precision compute_distance teams
     #: (detail/cagra/compute_distance.hpp).
     scan_dtype: Optional[object] = None
+    #: "auto" routes the fused Pallas beam-search engine only where the
+    #: committed PALLAS_PROBE artifact records a ``fused.cagra.fused_wins``
+    #: verdict for this platform (conservative XLA default otherwise);
+    #: "pallas"/"xla" force an engine. Same contract as the other fused
+    #: families (docs/tuning.md fallback matrix).
+    scan_mode: str = "auto"
 
 
 class Index:
@@ -505,6 +513,55 @@ def _search_jit(queries, dataset, scan_data, graph, seed_ids, filter_words,
 search_core = _search_jit
 
 
+def _search_fused_core(queries, dataset, graph, seed_ids,
+                       metric: DistanceType, k: int, itopk: int, width: int,
+                       max_iter: int, ct: int, interpret: bool = False):
+    """Fused-engine traceable core: the whole beam walk inside one Pallas
+    kernel (``ops.pallas_kernels.fused_cagra_topk`` — VMEM-resident beam
+    state, in-kernel gather DMAs), plus the metric epilogue the kernel
+    defers (it minimizes squared L2; L2SqrtExpanded takes the sqrt here,
+    exactly as ``_search_jit`` does on its sliced buffer). Eligibility —
+    L2 metrics, unfiltered, fp32, itopk ≤ 1024 — is the caller's job
+    (``fused_ineligible_reason``); semantics inside that envelope are
+    bit-checked against ``search_core`` (tests/test_pallas_fused.py)."""
+    from raft_tpu.ops import pallas_kernels as pk
+
+    v, i = pk.fused_cagra_topk(queries, dataset, graph, seed_ids, k,
+                               itopk, width, max_iter, ct=ct,
+                               interpret=interpret)
+    if metric == DistanceType.L2SqrtExpanded:
+        v = jnp.sqrt(jnp.maximum(v, 0.0))
+    return v, i
+
+
+_search_fused_jit = jax.jit(
+    _search_fused_core,
+    static_argnames=("metric", "k", "itopk", "width", "max_iter", "ct",
+                     "interpret"),
+)
+
+#: public traceable-core name for the fused path (R004; audited by
+#: graftcheck --jaxpr-audit at the canonical 1M shape, interpret=True)
+search_fused_core = _search_fused_core
+
+
+def resolve_search_plan(params: SearchParams, k: int, size: int):
+    """The resolved beam plan — (itopk, width, max_iter, n_seeds) — shared
+    by both engines' dispatch records so EXPLAIN artifacts are replayable
+    (the ``max_iterations=0`` auto-clip and the seed-pool sizing used to
+    be recomputed inline and never surfaced uniformly)."""
+    itopk = max(int(params.itopk_size), int(k))
+    width = max(int(params.search_width), 1)
+    max_iter = int(params.max_iterations)
+    if max_iter <= 0:
+        # auto heuristic (search_plan.cuh:31-123): enough hops to drain the
+        # itopk buffer, bounded
+        max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    n_rand = max(int(params.num_random_samplings), 1)
+    n_seeds = min(max(itopk, 32) * n_rand, int(size))
+    return itopk, width, max_iter, n_seeds
+
+
 @tracing.range("cagra.search")
 def search(
     index: Index,
@@ -518,9 +575,10 @@ def search(
     """Greedy graph search (reference: cagra::search, cagra.cuh:299 →
     search_single_cta_kernel-inl.cuh). Returns (distances, indices); with
     ``explain=True`` a third element carries the dispatch
-    :class:`raft_tpu.obs.explain.ExplainRecord` (cagra has one engine —
-    pure XLA, no fused kernel yet — so the record exists for parity with
-    the other families and to carry the resolved beam params).
+    :class:`raft_tpu.obs.explain.ExplainRecord` — which engine ran the
+    beam walk (fused Pallas vs XLA) and why, plus the resolved beam plan
+    (itopk/width/max_iter/n_seeds) in both branches so the artifact is
+    replayable.
 
     ``filter`` is an optional :class:`raft_tpu.core.bitset.Bitset` over
     dataset row ids; cleared bits are excluded from results (and from the
@@ -535,20 +593,13 @@ def search(
             f"query dim {queries.shape[1]} != index dim {index.dim}")
     nq = queries.shape[0]
     queries = pad_rows(queries, query_bucket(nq))  # serving batch bucket
-    itopk = max(int(params.itopk_size), k)
-    width = max(int(params.search_width), 1)
-    max_iter = int(params.max_iterations)
-    if max_iter <= 0:
-        # auto heuristic (search_plan.cuh:31-123): enough hops to drain the
-        # itopk buffer, bounded
-        max_iter = int(np.clip(itopk // width + 10, 16, 200))
-    n_rand = max(int(params.num_random_samplings), 1)
     # num_random_samplings multiplies the random seed pool (the reference's
     # random init batches, search_plan.cuh) — the recall lever when the
     # dataset has many well-separated clusters: a kNN graph cannot walk
     # across disconnected components, so a query's component must be
     # seeded. Seeds beyond itopk are fine: they enter through the merge.
-    n_seeds = min(max(itopk, 32) * n_rand, index.size)
+    itopk, width, max_iter, n_seeds = resolve_search_plan(
+        params, k, index.size)
     # deterministic pseudo-random seeds per query (rand_xor_mask analog):
     # a stratified lattice rotated by a per-row draw. Row q's seed set
     # depends only on q and the mask — never on the (padded) batch size —
@@ -574,20 +625,57 @@ def search(
         if index.dataset.dtype != jnp.float32:
             raise ValueError("scan_dtype requires an fp32 dataset")
     scan_data = index.ensure_scan_dataset() if fast_scan else index.dataset
-    rec = obs_explain.record_dispatch(
-        "cagra", "auto", "xla", "only_engine",
-        params={"k": int(k), "nq": nq, "bucket": queries.shape[0],
-                "metric": index.metric.name, "graph_degree":
-                index.graph_degree, "fast_scan": fast_scan},
-        plan={"itopk": itopk, "search_width": width, "max_iter": max_iter,
-              "n_seeds": n_seeds})
-    v, i = _search_jit(
-        queries, index.dataset, scan_data, index.graph, seed_ids,
-        filter.words if filter is not None else jnp.zeros((0,), jnp.uint32),
-        index.metric, int(k), itopk, width, max_iter, filter is not None,
+    from raft_tpu.ops import pallas_kernels as pk
+
+    scan_mode = getattr(params, "scan_mode", "auto")
+    if scan_mode not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"scan_mode={scan_mode!r}: expected 'auto', 'xla' or 'pallas'")
+    # ---- fused Pallas beam-search engine (the VMEM-resident beam carry).
+    # Fallback matrix (docs/tuning.md): L2 metrics, no filter (no in-carry
+    # filter epilogue), no bf16 fast scan, itopk ≤ 1024.
+    use_fused, fused_interp, dreason = pk.fused_dispatch_explained(
+        "cagra", scan_mode)
+    ineligible = fused_ineligible_reason(
+        index.metric, index.dataset.dtype, itopk, filter is not None,
         fast_scan)
+    ex_params = {"k": int(k), "nq": nq, "bucket": queries.shape[0],
+                 "metric": index.metric.name, "graph_degree":
+                 index.graph_degree, "fast_scan": fast_scan}
+    # resolved beam plan recorded identically by BOTH engines — an EXPLAIN
+    # artifact replays without re-deriving the auto-clips
+    ex_plan = {"itopk": itopk, "search_width": width, "max_iter": max_iter,
+               "n_seeds": n_seeds}
+    with contextlib.ExitStack() as stack:
+        cap = stack.enter_context(obs_explain.capture()) if explain else None
+        if use_fused and ineligible is None:
+            ct = pk.plan_fused_cagra_tile(
+                itopk, width, index.graph_degree, index.dim, n_seeds)
+            obs_explain.record_dispatch(
+                "cagra", scan_mode, "pallas", dreason, params=ex_params,
+                plan={**ex_plan, "ct": ct, "interpret": fused_interp,
+                      "predicted_workspace_bytes":
+                      pk.fused_cagra_workspace_bytes(
+                          queries.shape[0], index.size, index.dim,
+                          index.graph_degree, itopk, width, n_seeds,
+                          int(k), ct)})
+            v, i = _search_fused_jit(
+                queries, index.dataset, index.graph, seed_ids,
+                index.metric, int(k), itopk, width, max_iter, ct,
+                fused_interp)
+        else:
+            reason = ineligible if (use_fused and ineligible) else dreason
+            obs_explain.record_dispatch(
+                "cagra", scan_mode, "xla", reason, params=ex_params,
+                plan=ex_plan)
+            v, i = _search_jit(
+                queries, index.dataset, scan_data, index.graph, seed_ids,
+                filter.words if filter is not None
+                else jnp.zeros((0,), jnp.uint32),
+                index.metric, int(k), itopk, width, max_iter,
+                filter is not None, fast_scan)
     if explain:
-        return v[:nq], i[:nq], rec
+        return v[:nq], i[:nq], cap.last
     return v[:nq], i[:nq]
 
 

@@ -61,7 +61,11 @@ REASONS = frozenset({
     "forced",                  # scan_mode explicitly named this engine
     "auto_fused_wins",         # measured PALLAS_PROBE verdict routed fused
     "interpret",               # RAFT_TPU_PALLAS_INTERPRET=1 parity hook
-    "only_engine",             # family has a single engine (cagra)
+    "only_engine",             # family has a single engine (kept in the
+                               # vocabulary for artifact replay; cagra —
+                               # its last emitter — now has the fused
+                               # Pallas beam engine and dispatches like
+                               # the other fused families)
     # fused considered but routed to XLA
     "tpu_absent",              # pallas/auto on a host with no TPU backend
     "no_fused_wins_verdict",   # auto on TPU, probe artifact has no verdict

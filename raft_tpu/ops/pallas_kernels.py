@@ -1032,6 +1032,395 @@ def fused_pq_topk(probes, q_rot, centers_rot, codebooks, cb_norms,
                                  int(k), int(pad_tile), bool(interpret))
 
 
+# ------------------------------------------------ fused cagra beam search
+#
+# The graph-traversal analog of the fused scan+select engines: one grid
+# step per query, the whole beam walk INSIDE the kernel. The itopk beam
+# state (distances, global ids, expanded flags) lives in the fori_loop
+# carry — VMEM/vector registers for the entire traversal — instead of
+# round-tripping through HBM as the XLA path's [nq, itopk + W·D] concat
+# does every hop. Graph and dataset stay HBM-resident (``ANY`` memory
+# space); seed rows are gathered via the scalar-prefetched seed table,
+# and each hop's parent/target rows via in-kernel ``make_async_copy``
+# with data-dependent row indices (the beam's picks exist only on-chip,
+# so unlike the IVF probes they cannot be grid block indices — the
+# prefetch pattern's dynamic-index continuation). Semantics are exactly
+# ``cagra._search_jit``'s: same parent pick, same dedup-before-merge
+# masks, same stable merge order — the XLA fallback stays bit-checked
+# (tests/test_pallas_fused.py pins interpret-mode bit-parity).
+
+
+def _extract_topk_flagged(work, ci, cf, k: int, kp: int):
+    """``_extract_topk`` carrying a per-entry boolean flag (CAGRA's
+    "already expanded as a parent" bit): k rounds of (min, argmin, mask)
+    where the winning lane's id AND flag are pulled out by masked
+    reductions — first-occurrence tie-break, i.e. exactly the order a
+    stable ascending ``lax.sort`` of the same row would produce, which
+    is what keeps the in-kernel merge bit-compatible with the XLA beam
+    body's concat+sort."""
+    tb = work.shape[0]
+    out_col = jax.lax.broadcasted_iota(jnp.int32, (tb, kp), 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
+
+    def body(r, carry):
+        work, vals, idxs, flags = carry
+        a = jnp.argmin(work, axis=1)
+        m = jnp.min(work, axis=1)
+        sel_lane = lane == a[:, None]
+        src = jnp.min(jnp.where(sel_lane, ci, jnp.iinfo(jnp.int32).max),
+                      axis=1)
+        fl = jnp.any(sel_lane & cf, axis=1)
+        # +inf extraction sentinel (see _extract_topk): exhausted rows
+        # emit the -1 null id with a clear flag
+        alive = m != jnp.inf
+        src = jnp.where(alive, src, -1)
+        fl = fl & alive
+        sel = out_col == r
+        vals = jnp.where(sel, m[:, None], vals)
+        idxs = jnp.where(sel, src[:, None], idxs)
+        flags = jnp.where(sel, fl[:, None], flags)
+        work = jnp.where(sel_lane, jnp.inf, work)
+        return work, vals, idxs, flags
+
+    vals0 = jnp.full((tb, kp), jnp.inf, jnp.float32)
+    idxs0 = jnp.full((tb, kp), -1, jnp.int32)
+    flags0 = jnp.zeros((tb, kp), bool)
+    _, vals, idxs, flags = jax.lax.fori_loop(
+        0, k, body, (work, vals0, idxs0, flags0))
+    return vals, idxs, flags
+
+
+def fused_cagra_vmem_bytes(ct: int, dim: int, itopk: int, width: int,
+                           degree: int, n_seeds: int) -> int:
+    """TRUE VMEM live set of one fused cagra grid step: the [ct, dim]
+    candidate-row gather scratch (+ its working copy through the dot),
+    the per-chunk dot/distance/id lanes, the query row, the beam carry
+    (dist/id/flag ×itopk-pad, plus the extraction working set over the
+    [kp + ct] merge concat), the dedup masks ([wd, kp] + [wd, wd]
+    bools), the graph-row scratch, and the seed/target id lanes. The
+    itemized accounting ``plan_fused_cagra_tile`` solves against —
+    public for the obs.costs C001 calibration audit."""
+    kp = _kp(itopk)
+    wd = width * degree
+    return (ct * dim * 8          # gather scratch + f32 working copy
+            + ct * 24             # dots / distances / chunk id lanes
+            + dim * 8             # query row (+ residual temp)
+            + kp * 40             # carry + extraction accumulators
+            + (kp + ct) * 18      # merge concat (d/id/fl, work copy)
+            + wd * (kp + wd)      # dedup membership masks (bool)
+            + wd * 12 + n_seeds * 12   # target/seed id lanes + masks
+            + width * degree * 4)      # graph-row scratch (int32)
+
+
+def plan_fused_cagra_tile(itopk: int, width: int, degree: int, dim: int,
+                          n_seeds: int,
+                          vmem_budget: Optional[int] = None) -> int:
+    """The candidate-chunk tile for ``fused_cagra_topk``: how many
+    gathered rows (seed or expansion targets) stream through the VMEM
+    scratch per merge. Solved from the VMEM budget via
+    ``core.resources.solve_vmem_tiles`` — the chunk rows are the outer
+    axis (8-aligned sublanes), the feature dim the inner — then capped
+    at the widest stream the walk ever scores (max(W·D, n_seeds),
+    rounded up to sublanes): a larger scratch would just sit empty."""
+    from raft_tpu.core.resources import solve_vmem_tiles
+
+    budget = DEFAULT_VMEM_BUDGET if vmem_budget is None else int(vmem_budget)
+    kp = _kp(itopk)
+    wd = width * degree
+    fixed = (dim * 8 + kp * 40 + kp * 18
+             + wd * (kp + wd) + wd * 12 + n_seeds * 12
+             + width * degree * 4)
+    ct, _ = solve_vmem_tiles(
+        budget,
+        cell_bytes=8,
+        outer_bytes=24 + 18,   # id/dist lanes + merge-concat share
+        inner_bytes=0,
+        inner_max=round_up_to(max(dim, 1), 128),
+        fixed_bytes=fixed,
+        outer_cap=256,
+    )
+    cap = round_up_to(max(wd, n_seeds, 8), 8)
+    return max(8, min(int(ct), cap))
+
+
+def fused_cagra_workspace_bytes(nq: int, n: int, dim: int, degree: int,
+                                itopk: int, width: int, n_seeds: int,
+                                k: int, ct: Optional[int] = None) -> int:
+    """HBM-side TEMP workspace of one fused cagra dispatch. Deliberately
+    small: dataset and graph enter the kernel as ``ANY``-memory-space
+    operands and are DMA'd row-by-row in place — they are ARGUMENTS, not
+    staged temporaries, which is the point of the design (every other
+    fused family pays a staged slab copy; the beam walk touches too
+    little of the slab per query to justify one). What remains: the
+    padded seed table twice (scalar-prefetch copy + the VMEM-blocked
+    vector side), the query/norm rows, the pre-slice [nq, kp] val/idx
+    outputs, and one grid step's VMEM block set. Calibrated against the
+    AOT CPU-interpreter compile's ``temp_size_in_bytes`` (C001,
+    graftcheck ``--costs``)."""
+    if ct is None:
+        ct = plan_fused_cagra_tile(itopk, width, degree, dim, n_seeds)
+    kp = _kp(itopk)
+    sp = round_up_to(max(n_seeds, 1), ct)
+    return (nq * (dim * 4 + 4)
+            + 2 * nq * sp * 4
+            + nq * kp * 8
+            + fused_cagra_vmem_bytes(ct, dim, itopk, width, degree,
+                                     n_seeds))
+
+
+def _fused_cagra_kernel(seeds_sref, seeds_ref, q_ref, qn_ref, data_ref,
+                        graph_ref, val_ref, idx_ref, vec_s, g_s, sem, *,
+                        itopk: int, kp: int, width: int, degree: int,
+                        max_iter: int, ct: int, n_seeds: int):
+    """One query's whole beam walk. Carry = (buf_d, buf_ids, buf_fl,
+    done), all [1, kp] rows resident on-chip; HBM is touched only by the
+    per-row gather DMAs and the final [1, kp] result write.
+
+    Dedup against the visited set is two small membership compares over
+    the buffer-RESIDENT ids ([wd, kp] + [wd, wd] bools) — the buffer is
+    dup-free and monotone under the merge so its flags are a complete
+    visited set (see cagra.py) — not the XLA path's full-width
+    [nq, wd, itopk] one-hot compare materialized per hop in HBM.
+
+    Tie-break note: merges extract by first-occurrence argmin, matching
+    the XLA body's stable concat-sort exactly; the SEED init orders
+    equal-distance distinct ids by seed position where
+    ``merge_topk_dedup_flagged`` orders them by id — unobservable unless
+    two distinct rows tie bitwise at the itopk boundary. Duplicate seed
+    ids collapse identically (first copy kept, flags all clear)."""
+    i = pl.program_id(0)
+    wd = width * degree
+    sp = seeds_ref.shape[1]  # seed table padded to a whole number of chunks
+    imax = jnp.iinfo(jnp.int32).max
+    lane_kp = jax.lax.broadcasted_iota(jnp.int32, (1, kp), 1)
+
+    q_col = q_ref[0].reshape(-1, 1)  # [dim, 1]
+    qn = qn_ref[0, 0]
+
+    def gather_rows(get_id, count):
+        """DMA ``count`` dataset rows (row ids from ``get_id(j)``) into
+        the scratch, serially — correctness first; overlap is a measured
+        probe follow-up."""
+        def body(j, carry):
+            row = get_id(j)
+            cp = pltpu.make_async_copy(
+                data_ref.at[pl.ds(row, 1), :],
+                vec_s.at[pl.ds(j, 1), :], sem)
+            cp.start()
+            cp.wait()
+            return carry
+        jax.lax.fori_loop(0, count, body, 0)
+
+    def score_chunk(ids_chunk, n_rows):
+        """[1, ct] minimized squared-L2 of the gathered scratch rows —
+        the exact ``gathered_distances`` arithmetic (HIGHEST-precision
+        dot, fp32 norms, max(…, 0) clamp), invalid ids → +inf."""
+        v = vec_s[...]
+        if n_rows < ct:
+            v = v[:n_rows]
+        dots = jax.lax.dot_general(
+            v, q_col, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)  # [rows, 1]
+        vn = jnp.sum(v * v, axis=-1)
+        d = jnp.maximum(qn + vn - 2.0 * dots[:, 0], 0.0)[None, :]
+        return jnp.where(ids_chunk < 0, jnp.inf, d)
+
+    def merge(carry, cd, ci, cf):
+        bd, bi, bf = carry
+        work = jnp.concatenate([bd, cd], axis=1)
+        wi = jnp.concatenate([bi, ci], axis=1)
+        wf = jnp.concatenate([bf, cf], axis=1)
+        return _extract_topk_flagged(work, wi, wf, itopk, kp)
+
+    # ---- seed phase: dedup-mask the full seed row, then stream chunks
+    # of seed rows through the scratch into the carry (flags all clear —
+    # merge_topk_dedup_flagged's init semantics)
+    sv = seeds_ref[0][None, :]  # [1, sp] (pad lanes are -1)
+    if sp > 1:
+        earlier_s = jnp.tril(jnp.ones((sp, sp), bool), -1)
+        dup_s = jnp.any((sv[0][:, None] == sv[0][None, :]) & earlier_s,
+                        axis=1)[None, :]
+        sv = jnp.where(dup_s, -1, sv)
+    carry = (jnp.full((1, kp), jnp.inf, jnp.float32),
+             jnp.full((1, kp), -1, jnp.int32),
+             jnp.zeros((1, kp), bool))
+    for c in range(sp // ct):
+        base = c * ct
+        nr = min(ct, sp - base)
+        gather_rows(lambda j: jnp.maximum(seeds_sref[i, base + j], 0), nr)
+        ids_c = sv[:, base:base + nr]
+        cd = score_chunk(ids_c, nr)
+        carry = merge(carry, cd, ids_c, jnp.zeros((1, nr), bool))
+
+    # ---- traversal: beam state rides the fori_loop carry; a done query
+    # freezes (bit-compatible with the XLA while_loop's all-done exit,
+    # which also only ever freezes per-query state)
+    wdp = round_up_to(wd, ct)
+    n_tc = wdp // ct
+    lane_wd = jax.lax.broadcasted_iota(jnp.int32, (1, wdp), 1)
+
+    def step(_, state):
+        buf_d, buf_ids, buf_fl, done = state
+        # pickup_next_parents: best `width` unexpanded entries, by
+        # iterated argmin (== lax.top_k's lowest-index-first tie order)
+        cand = jnp.where(buf_fl | (buf_ids < 0), jnp.inf, buf_d)
+        parents, valids = [], []
+        for _w in range(width):
+            a = jnp.argmin(cand).astype(jnp.int32)
+            m = jnp.min(cand)
+            valid_w = jnp.isfinite(m) & ~done
+            pid = jnp.min(jnp.where(lane_kp == a, buf_ids, imax))
+            parents.append(jnp.where(valid_w, pid, -1))
+            valids.append(valid_w)
+            sel = lane_kp == a
+            buf_fl = buf_fl | (sel & valid_w)
+            cand = jnp.where(sel, jnp.inf, cand)
+        newly_done = ~valids[0]
+
+        # expand: DMA the parents' graph rows (clamped like the XLA
+        # gather), mask invalid parents' targets to -1
+        for w, (p, valid_w) in enumerate(zip(parents, valids)):
+            cp = pltpu.make_async_copy(
+                graph_ref.at[pl.ds(jnp.maximum(p, 0), 1), :],
+                g_s.at[pl.ds(w, 1), :], sem)
+            cp.start()
+            cp.wait()
+        raw_t = g_s[...].reshape(1, wd)
+        vmask = jnp.concatenate(
+            [jnp.full((1, degree), v) for v in valids], axis=1)
+        t0 = jnp.where(vmask, raw_t, -1)
+        # visited-set test against the RESIDENT buffer + earlier-target
+        # dedup (parents sharing neighbors), before any distance math
+        in_buf = jnp.any(t0[0][:, None] == buf_ids[0][None, :],
+                         axis=1)[None, :]
+        if wd > 1:
+            earlier = jnp.tril(jnp.ones((wd, wd), bool), -1)
+            dup_t = jnp.any((t0[0][:, None] == t0[0][None, :]) & earlier,
+                            axis=1)[None, :]
+            in_buf = in_buf | dup_t
+        t1 = jnp.where(in_buf, -1, t0)
+        t1p = (jnp.pad(t1, ((0, 0), (0, wdp - wd)), constant_values=-1)
+               if wdp > wd else t1)
+
+        # score + merge, chunk by chunk (streaming top-k == one stable
+        # sort of the full concat — the merge keeps survivor order)
+        merged = (buf_d, buf_ids, buf_fl)
+        for c in range(n_tc):
+            base = c * ct
+
+            def tid(j, base=base):
+                raw = jnp.min(jnp.where(lane_wd == base + j, t1p, imax))
+                return jnp.maximum(raw, 0)
+
+            gather_rows(tid, ct)
+            ids_c = t1p[:, base:base + ct]
+            cd = score_chunk(ids_c, ct)
+            merged = merge(merged, cd, ids_c, jnp.zeros((1, ct), bool))
+
+        keep = done
+        buf_d = jnp.where(keep, buf_d, merged[0])
+        buf_ids = jnp.where(keep, buf_ids, merged[1])
+        buf_fl = jnp.where(keep, buf_fl, merged[2])
+        return buf_d, buf_ids, buf_fl, done | newly_done
+
+    buf_d, buf_ids, _, _ = jax.lax.fori_loop(
+        0, max_iter, step, (*carry, jnp.zeros((), bool)))
+    val_ref[...] = buf_d
+    idx_ref[...] = buf_ids
+
+
+@functools.partial(jax.jit, static_argnames=("k", "itopk", "width",
+                                             "max_iter", "ct", "interpret"))
+def _fused_cagra_pallas(queries, dataset, graph, seed_ids, q_norms,
+                        k: int, itopk: int, width: int, max_iter: int,
+                        ct: int, interpret: bool):
+    nq, dim = queries.shape
+    degree = graph.shape[1]
+    n_seeds = seed_ids.shape[1]
+    kp = _kp(itopk)
+    sp = round_up_to(max(n_seeds, 1), ct)
+    seeds = jnp.pad(seed_ids.astype(jnp.int32),
+                    ((0, 0), (0, sp - n_seeds)), constant_values=-1)
+    qf = queries.astype(jnp.float32)
+    qn = q_norms.astype(jnp.float32).reshape(nq, 1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nq,),
+        in_specs=[
+            # the seed table again, VMEM-blocked: the vector side of the
+            # same scalars the prefetch ref feeds to the gather DMAs
+            pl.BlockSpec((1, sp), lambda i, seeds: (i, 0)),
+            pl.BlockSpec((1, dim), lambda i, seeds: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, seeds: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=(pl.BlockSpec((1, kp), lambda i, seeds: (i, 0)),
+                   pl.BlockSpec((1, kp), lambda i, seeds: (i, 0))),
+        scratch_shapes=[
+            pltpu.VMEM((ct, dim), jnp.float32),
+            pltpu.VMEM((width, degree), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    val, idx = pl.pallas_call(
+        functools.partial(_fused_cagra_kernel, itopk=itopk, kp=kp,
+                          width=width, degree=degree, max_iter=max_iter,
+                          ct=ct, n_seeds=n_seeds),
+        out_shape=(jax.ShapeDtypeStruct((nq, kp), jnp.float32),
+                   jax.ShapeDtypeStruct((nq, kp), jnp.int32)),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(seeds, seeds, qf, qn, dataset, graph)
+    return val[:, :k], idx[:, :k]
+
+
+def fused_cagra_topk(queries, dataset, graph, seed_ids, k: int,
+                     itopk: int, width: int = 1, max_iter: int = 0,
+                     ct: Optional[int] = None,
+                     vmem_budget: Optional[int] = None,
+                     interpret: bool = False):
+    """Fused CAGRA beam search + top-k: the whole greedy graph walk runs
+    inside one Pallas kernel per query, beam state VMEM-resident across
+    iterations. Returns ``(distances [nq, k], ids [nq, k])`` ascending
+    squared-L2 (the minimized quantity — the caller applies the
+    L2SqrtExpanded epilogue), ids -1 where the walk surfaced fewer than
+    k nodes.
+
+    Semantics match ``cagra.search_core`` at the same resolved
+    ``(itopk, width, max_iter)`` bit-for-bit (L2 metrics, unfiltered,
+    fp32): same seed dedup, parent pick, visited-set masks, and stable
+    merge order. ``max_iter=0`` applies the search-plan auto heuristic.
+    ``ct`` is the candidate-chunk tile (default: the VMEM-budget solve,
+    ``plan_fused_cagra_tile``); ``interpret=True`` runs the Mosaic
+    interpreter (CPU CI)."""
+    queries = jnp.asarray(queries)
+    dataset = jnp.asarray(dataset)
+    graph = jnp.asarray(graph)
+    seed_ids = jnp.asarray(seed_ids)
+    itopk = max(int(itopk), int(k))
+    if itopk > 1024:
+        raise ValueError(
+            f"fused_cagra_topk is a small-beam kernel (itopk={itopk} > "
+            "1024); use the XLA engine")
+    width = max(int(width), 1)
+    max_iter = int(max_iter)
+    if max_iter <= 0:
+        import numpy as np
+        max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    degree = graph.shape[1]
+    n_seeds = seed_ids.shape[1]
+    if ct is None:
+        ct = plan_fused_cagra_tile(itopk, width, degree, queries.shape[1],
+                                   n_seeds, vmem_budget)
+    q_norms = jnp.sum(queries.astype(jnp.float32) ** 2, -1)
+    return _fused_cagra_pallas(queries, dataset, graph, seed_ids, q_norms,
+                               int(k), itopk, width, max_iter, int(ct),
+                               bool(interpret))
+
+
 # ------------------------------------------------- cross-chip ring shift
 #
 # The RDMA leg of the sharded ring top-k merge (parallel/comms.py

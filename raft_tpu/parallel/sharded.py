@@ -862,16 +862,12 @@ def search_cagra(
     shard_rows = jnp.asarray(
         np.diff(index.bounds).astype(np.int32))  # valid rows per shard
     base = jnp.asarray(index.bounds[:-1].astype(np.int32))
-    itopk = max(int(params.itopk_size), k)
-    width = max(int(params.search_width), 1)
-    max_iter = int(params.max_iterations)
-    if max_iter <= 0:
-        max_iter = int(np.clip(itopk // width + 10, 16, 200))
+    # same resolved beam plan as the single-host engine (seeds scale with
+    # num_random_samplings and may exceed the buffer — they enter through
+    # the merge), sized to the per-shard row count
+    itopk, width, max_iter, n_seeds = cagra.resolve_search_plan(
+        params, k, int(index.datasets.shape[1]))
     degree = index.graphs.shape[2]
-    # see cagra.search: seeds scale with num_random_samplings and may
-    # exceed the buffer (they enter through the merge)
-    n_seeds = min(max(itopk, 32) * max(int(params.num_random_samplings), 1),
-                  index.datasets.shape[1])
     key = jax.random.fold_in(
         jax.random.key(params.rand_xor_mask & 0x7FFFFFFF), nq)
     empty = jnp.zeros((0,), jnp.uint32)

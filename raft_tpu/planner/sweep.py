@@ -57,8 +57,18 @@ def default_grid(family: str, mini: bool = False) -> List[Dict[str, object]]:
             combos = ((32, 1), (64, 4))
         else:
             combos = ((32, 1), (64, 1), (64, 4), (128, 4))
-        return [{"itopk_size": int(it), "search_width": int(w)}
-                for it, w in combos]
+        # scan_mode is a sweepable knob since the fused Pallas beam
+        # engine landed: "auto" follows the committed probe verdict,
+        # "pallas" forces the fused walk — sweeping both grows committed
+        # Pareto frontiers fused operating points wherever the kernel
+        # wins, and keeps an XLA-routed point for replay parity. On
+        # hosts with no TPU the forced point measures the silent XLA
+        # fallback (identical results, ~identical ms) and the frontier
+        # prune discards the duplicate.
+        modes = ("auto",) if mini else ("auto", "pallas")
+        return [{"itopk_size": int(it), "search_width": int(w),
+                 "scan_mode": mode}
+                for it, w in combos for mode in modes]
     raise ValueError(f"unknown family {family!r}; expected one of "
                      f"{FAMILIES}")
 

@@ -179,6 +179,41 @@ def test_adaptive_reasons_are_a_subset_of_explain_vocabulary():
     assert ADAPTIVE_REASONS <= obs_explain.REASONS
 
 
+def test_choose_stays_pure_over_scan_mode_widened_cagra_grid():
+    """The fused beam engine widened the cagra sweep grid with a
+    ``scan_mode`` knob: frontiers can now carry both an XLA-routed and a
+    Pallas-forced point at the same (itopk, width). The chooser must
+    treat those as ordinary operating points — pure given (points,
+    budget, floor, scale), closed reasons — or the committed artifact's
+    replay would depend on dict order."""
+    from raft_tpu.planner import sweep as planner_sweep
+
+    grid = planner_sweep.default_grid("cagra")
+    modes = {g["scan_mode"] for g in grid}
+    assert modes == {"auto", "pallas"}
+    # both modes appear at every (itopk, width) combo
+    combos = {(g["itopk_size"], g["search_width"]) for g in grid}
+    assert len(grid) == len(combos) * len(modes)
+    # a frontier built over the widened grid: the forced-pallas twin of
+    # each point is a hair faster at equal recall (the fused-wins case)
+    pts = []
+    for i, g in enumerate(sorted(grid, key=json.dumps)):
+        fast = g["scan_mode"] == "pallas"
+        pts.append(_pt(0.90 + 0.02 * (i // 2), 200.0 + 100.0 * i,
+                       20.0 - 2.0 * i - (0.5 if fast else 0.0), g))
+    frontier = pareto_prune(pts)
+    assert frontier  # the widened grid still prunes to a real frontier
+    for budget in (None, 0.0, 3.0, 15.0, 1e6):
+        first = choose_operating_point(frontier, budget,
+                                       recall_floor=0.9, scale=1.1)
+        for _ in range(3):
+            assert choose_operating_point(
+                frontier, budget, recall_floor=0.9, scale=1.1) == first
+        assert first[1] in ADAPTIVE_REASONS
+        if first[0] is not None:
+            assert first[0].params["scan_mode"] in ("auto", "pallas")
+
+
 # --------------------------------------------------------- curve summaries
 def test_hypervolume_staircase_area():
     pts = [_pt(1.0, 10.0, 1.0), _pt(0.5, 100.0, 1.0)]

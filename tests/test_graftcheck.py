@@ -148,7 +148,7 @@ def test_r007_repo_dispatch_sites_are_all_attributed():
                 seen_dispatch += n
                 seen_by_prefix[prefix] = seen_by_prefix.get(prefix, 0) + n
     assert findings == [], [f.format() for f in findings]
-    assert seen_dispatch >= 3  # brute_force + ivf_flat + ivf_pq
+    assert seen_dispatch >= 4  # brute_force + ivf_flat + ivf_pq + cagra
     # the sharded search entry points (knn / cagra / ivf_pq / ivf_flat)
     # each plan their merge schedule through plan_sharded_search
     assert seen_by_prefix.get("raft_tpu.parallel", 0) >= 3
@@ -254,7 +254,7 @@ def test_audit_detects_pre_tiling_unbounded_variant():
 def test_audit_default_entries_all_within_budget():
     from raft_tpu.analysis import jaxpr_audit as ja
     results, findings = ja.run_audit()
-    assert len(results) == 11
+    assert len(results) == 12
     assert findings == [], [f.format() for f in findings]
     assert all(r.ok for r in results)
 

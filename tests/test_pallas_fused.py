@@ -443,6 +443,261 @@ def test_fused_ivf_dispatch_merge_is_pad_exempt(monkeypatch):
     )
 
 
+# --------------------------------------- fused cagra beam search (VMEM beam)
+
+def _np_beam_walk(q, db, graph, seeds, k, itopk, width, max_iter):
+    """Greedy beam walk, one query, squared L2 — the kernel's documented
+    semantics in plain numpy: first-occurrence seed/target dedup, ``width``
+    cheapest-unexpanded parents per hop, stable ascending merges, fixed
+    iteration budget. float64 scoring so the reference's tie/order
+    decisions never depend on fp32 rounding."""
+    def d2(ids):
+        diff = db[ids].astype(np.float64) - q.astype(np.float64)
+        return (diff * diff).sum(-1)
+
+    seen = []
+    for s in seeds:
+        s = int(s)
+        if s >= 0 and s not in seen:
+            seen.append(s)
+    buf_ids = np.array(seen, np.int64)
+    buf_d = d2(buf_ids)
+    order = np.argsort(buf_d, kind="stable")[:itopk]
+    buf_ids, buf_d = buf_ids[order], buf_d[order]
+    flags = np.zeros(len(buf_ids), bool)
+    for _ in range(max_iter):
+        unexp = np.nonzero(~flags)[0]
+        if unexp.size == 0:
+            break
+        parents = unexp[:width]
+        flags[parents] = True
+        targets = []
+        for p in parents:
+            for t in graph[buf_ids[p]]:
+                t = int(t)
+                if t >= 0 and t not in targets and t not in buf_ids:
+                    targets.append(t)
+        if not targets:
+            continue
+        t_ids = np.array(targets, np.int64)
+        all_ids = np.concatenate([buf_ids, t_ids])
+        all_d = np.concatenate([buf_d, d2(t_ids)])
+        all_f = np.concatenate([flags, np.zeros(len(t_ids), bool)])
+        order = np.argsort(all_d, kind="stable")[:itopk]
+        buf_ids, buf_d, flags = all_ids[order], all_d[order], all_f[order]
+    out_d = np.full(k, np.inf)
+    out_i = np.full(k, -1, np.int64)
+    m = min(k, len(buf_ids))
+    out_d[:m], out_i[:m] = buf_d[:m], buf_ids[:m]
+    return out_d, out_i
+
+
+# (seed, n, dim, degree, nq, k, itopk, width, n_seeds, ct) — spans the
+# tile boundaries: width*degree below/at/above one ct chunk, a ragged
+# last graph tile (wd=12 padded to 16), and multi-chunk seed streams.
+_CAGRA_COMBOS = [
+    (0, 500, 24, 8, 4, 5, 16, 1, 20, 16),
+    (2, 300, 24, 6, 3, 4, 16, 2, 20, 16),   # ragged: wd=12 < chunk 16
+    (1, 600, 32, 16, 2, 8, 64, 4, 64, 32),  # wd=64: two chunks per hop
+]
+
+
+def _cagra_case(seed, n, dim, degree, nq, n_seeds):
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((nq, dim)).astype(np.float32)
+    graph = rng.integers(0, n, (n, degree)).astype(np.int32)
+    graph[5, :3] = -1  # invalid edges must be skipped, not scored
+    seeds = rng.integers(0, n, (nq, n_seeds)).astype(np.int32)
+    seeds[:, 1] = seeds[:, 0]  # duplicate seed ids dedup to one entry
+    return data, q, graph, seeds
+
+
+@pytest.mark.parametrize(
+    "seed,n,dim,degree,nq,k,itopk,width,n_seeds,ct", _CAGRA_COMBOS)
+def test_fused_cagra_matches_numpy_beam_walk(seed, n, dim, degree, nq, k,
+                                             itopk, width, n_seeds, ct):
+    data, q, graph, seeds = _cagra_case(seed, n, dim, degree, nq, n_seeds)
+    fd, fi = pk.fused_cagra_topk(q, data, graph, seeds, k, itopk, width,
+                                 max_iter=12, ct=ct, interpret=True)
+    fd, fi = np.asarray(fd), np.asarray(fi)
+    for r in range(nq):
+        rd, ri = _np_beam_walk(q[r], data, graph, seeds[r], k, itopk,
+                               width, 12)
+        np.testing.assert_array_equal(fi[r], ri)
+        finite = np.isfinite(rd)
+        np.testing.assert_allclose(fd[r][finite], rd[finite],
+                                   rtol=1e-5, atol=1e-5)
+        assert np.all(fd[r][~finite] == np.inf)
+
+
+@pytest.mark.parametrize(
+    "seed,n,dim,degree,nq,k,itopk,width,n_seeds,ct",
+    [_CAGRA_COMBOS[0], _CAGRA_COMBOS[2]])
+def test_fused_cagra_bit_parity_vs_xla_core(seed, n, dim, degree, nq, k,
+                                            itopk, width, n_seeds, ct):
+    """Interpret-mode fused core vs ``_search_jit``, BITWISE — same
+    dot-accumulate order, same stable merge order, same done-freeze exit.
+    Pinned at fixed seeds on combos where XLA:CPU's gemv blocking agrees
+    with the kernel's whole-chunk dot (other shapes drift 1 ULP in XLA's
+    fused einsum, not in the kernel — see the numpy-reference test)."""
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.ops.distance import DistanceType
+
+    data, q, graph, seeds = _cagra_case(seed, n, dim, degree, nq, n_seeds)
+    fw = jnp.zeros((1,), jnp.uint32)
+    xd, xi = cagra.search_core(
+        q, data, data, jnp.asarray(graph), jnp.asarray(seeds), fw,
+        DistanceType.L2Expanded, k, itopk, width, 12, False, False)
+    fd, fi = pk.fused_cagra_topk(q, data, graph, seeds, k, itopk, width,
+                                 max_iter=12, ct=ct, interpret=True)
+    np.testing.assert_array_equal(np.asarray(fd), np.asarray(xd))
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(xi))
+
+
+def test_plan_fused_cagra_tile_budget_and_alignment():
+    for budget in (256 << 10, 1 << 20, 4 << 20, 16 << 20):
+        ct = pk.plan_fused_cagra_tile(64, 4, 32, 128, 128,
+                                      vmem_budget=budget)
+        assert ct >= 8 and ct % 8 == 0
+        assert pk.fused_cagra_vmem_bytes(ct, 128, 64, 4, 32, 128) <= budget
+    # monotone non-decreasing in budget
+    cts = [pk.plan_fused_cagra_tile(64, 4, 32, 128, 128, vmem_budget=b)
+           for b in (256 << 10, 1 << 20, 16 << 20)]
+    assert cts == sorted(cts)
+
+
+def test_plan_fused_cagra_tile_caps_at_widest_stream():
+    # the widest stream the walk scores is max(width*degree, n_seeds):
+    # a bigger scratch would sit empty, so the plan must not exceed its
+    # 8-aligned round-up even under a huge budget
+    ct = pk.plan_fused_cagra_tile(64, 1, 8, 32, 12, vmem_budget=1 << 30)
+    assert ct == 16  # round_up(max(8, 12, 8), 8)
+    assert pk.plan_fused_cagra_tile(
+        64, 4, 64, 32, 8, vmem_budget=1 << 30) == 256
+
+
+def test_fused_cagra_workspace_excludes_any_space_operands():
+    # dataset/graph are ANY-space ARGUMENTS, not staged temps: workspace
+    # must not scale with n (the design point of the fused walk)
+    small = pk.fused_cagra_workspace_bytes(64, 10_000, 128, 32, 64, 1,
+                                           64, 10)
+    large = pk.fused_cagra_workspace_bytes(64, 10_000_000, 128, 32, 64, 1,
+                                           64, 10)
+    assert small == large > 0
+
+
+def test_fused_cagra_rejects_large_itopk(rng):
+    data, q, graph, seeds = _cagra_case(0, 300, 16, 8, 2, 16)
+    with pytest.raises(ValueError, match="itopk"):
+        pk.fused_cagra_topk(q, data, graph, seeds, 10, itopk=2048)
+
+
+def test_cagra_dispatch_fallback_matrix(monkeypatch):
+    """scan_mode="pallas" + interpret opt-in routes the fused engine only
+    inside the eligibility envelope; everything else must fall back to
+    XLA with the matrix's closed reason (docs/tuning.md)."""
+    from raft_tpu.core.bitset import Bitset
+    from raft_tpu.neighbors import cagra
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(11)
+    n, dim = 400, 16
+    data = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((3, dim)).astype(np.float32)
+    graph = jnp.asarray(rng.integers(0, n, (n, 8)).astype(np.int32))
+    idx = cagra.Index(cagra.IndexParams(graph_degree=8),
+                      jnp.asarray(data), graph)
+    pal = dict(itopk_size=16, scan_mode="pallas")
+
+    _, _, rec = cagra.search(idx, q, 5, cagra.SearchParams(**pal),
+                             explain=True)
+    assert (rec.engine, rec.reason) == ("pallas", "interpret")
+
+    ip = cagra.Index(
+        cagra.IndexParams(graph_degree=8,
+                          metric=cagra.DistanceType.InnerProduct),
+        jnp.asarray(data), graph)
+    _, _, rec = cagra.search(ip, q, 5, cagra.SearchParams(**pal),
+                             explain=True)
+    assert (rec.engine, rec.reason) == ("xla", "non_l2")
+
+    flt = Bitset.create(n)
+    _, _, rec = cagra.search(idx, q, 5, cagra.SearchParams(**pal),
+                             filter=flt, explain=True)
+    assert (rec.engine, rec.reason) == ("xla", "filtered")
+
+    # itopk beyond the kernel's 1024 buffer cap (dataset must be larger
+    # than itopk or the XLA fallback's own seed top-k can't run either)
+    big_n = 1200
+    big = cagra.Index(
+        cagra.IndexParams(graph_degree=8),
+        jnp.asarray(rng.standard_normal((big_n, dim)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, big_n, (big_n, 8)).astype(np.int32)))
+    _, _, rec = cagra.search(
+        big, q, 5, cagra.SearchParams(itopk_size=1056, scan_mode="pallas"),
+        explain=True)
+    assert (rec.engine, rec.reason) == ("xla", "k_gt_1024")
+
+    _, _, rec = cagra.search(
+        idx, q, 5, cagra.SearchParams(itopk_size=16, scan_dtype="bfloat16",
+                                      scan_mode="pallas"), explain=True)
+    assert (rec.engine, rec.reason) == ("xla", "fast_scan")
+
+    # TPU absent, no interpret opt-in: auto stays on XLA
+    monkeypatch.delenv("RAFT_TPU_PALLAS_INTERPRET")
+    _, _, rec = cagra.search(idx, q, 5,
+                             cagra.SearchParams(itopk_size=16),
+                             explain=True)
+    assert (rec.engine, rec.reason) == ("xla", "tpu_absent")
+
+
+def test_cagra_public_api_interpret_bit_parity(monkeypatch):
+    # the whole public path — seed lattice, padding, epilogue — must be
+    # bit-identical between engines when the fused core runs interpret
+    from raft_tpu.neighbors import cagra
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(3)
+    n, dim = 800, 32
+    data = rng.standard_normal((n, dim)).astype(np.float32)
+    q = rng.standard_normal((5, dim)).astype(np.float32)
+    idx = cagra.Index(cagra.IndexParams(graph_degree=8), jnp.asarray(data),
+                      jnp.asarray(rng.integers(0, n, (n, 8)).astype(
+                          np.int32)))
+    for metric in (cagra.DistanceType.L2Expanded,
+                   cagra.DistanceType.L2SqrtExpanded):
+        mi = cagra.Index(cagra.IndexParams(graph_degree=8, metric=metric),
+                         idx.dataset, idx.graph)
+        vx, ix = cagra.search(mi, q, 5, cagra.SearchParams(
+            itopk_size=32, search_width=2, scan_mode="xla"))
+        vp, ip = cagra.search(mi, q, 5, cagra.SearchParams(
+            itopk_size=32, search_width=2, scan_mode="pallas"))
+        np.testing.assert_array_equal(np.asarray(vx), np.asarray(vp))
+        np.testing.assert_array_equal(np.asarray(ix), np.asarray(ip))
+
+
+def test_cagra_fused_recall_floor(monkeypatch):
+    """Recall ≥0.95 through the fused engine on a real built graph — the
+    walk must actually navigate, not just agree with itself."""
+    from raft_tpu.neighbors import brute_force as bf
+    from raft_tpu.neighbors import cagra
+    from raft_tpu.stats import neighborhood_recall
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(7)
+    db = rng.standard_normal((3000, 32)).astype(np.float32)
+    q = rng.standard_normal((32, 32)).astype(np.float32)
+    _, gt = bf.knn(q, db, k=10, metric="sqeuclidean")
+    idx = cagra.build(db, cagra.IndexParams(
+        intermediate_graph_degree=48, graph_degree=24,
+        build_algo=cagra.BuildAlgo.NN_DESCENT, nn_descent_niter=12))
+    _, i = cagra.search(idx, q, 10, cagra.SearchParams(
+        itopk_size=64, search_width=2, scan_mode="pallas"))
+    recall = float(neighborhood_recall(np.asarray(i), np.asarray(gt)))
+    assert recall >= 0.95, f"fused recall {recall}"
+
+
 # ------------------------------------------------------------- heavy shapes
 
 @pytest.mark.slow

@@ -19,6 +19,7 @@ FULL = {"fused": {
     "ivf_pq": {"fused_wins": True},
     "ivf_scan": {"fused_wins": False},
     "l2_argmin": {"fused_wins": True},
+    "cagra": {"fused_wins": True, "pallas_ms": 5.0, "xla_ms": 9.0},
     "merge_ring": {"fused_wins": True, "ring_ms": 1.0, "tree_ms": 2.0},
 }}
 
@@ -48,6 +49,16 @@ def test_missing_and_errored_rows_are_flagged():
     got = pallas_probe.missing_verdicts(art, on_tpu=True,
                                         mergeable_mesh=True)
     assert got == ["ivf_pq", "l2_argmin", "merge_ring"]
+
+
+def test_probe_missing_cagra_row_is_incomplete():
+    """Schema v3: the fused beam-search engine is a routing family — an
+    artifact without its row (e.g. a queue window that died before the
+    cagrafuse step) must not pass --require-verdicts."""
+    art = {"fused": {k: v for k, v in FULL["fused"].items()
+                     if k != "cagra"}}
+    assert pallas_probe.missing_verdicts(
+        art, on_tpu=True, mergeable_mesh=True) == ["cagra"]
 
 
 def test_off_tpu_host_can_never_mint_verdicts():
