@@ -20,6 +20,18 @@ Two tiers:
   untyped raises, futures left unsettled on some CFG path, swallowed
   exceptions, unreclaimed self-held resources, unbudgeted blocking
   calls. See :mod:`raft_tpu.analysis.flow`.
+* **Tier K** (``--kernels``): Pallas/Mosaic kernel-discipline rules
+  K001–K005 over every module importing ``jax.experimental.pallas`` —
+  DMA start/wait pairing and semaphore balance, VMEM accountant
+  presence plus an interpret-mode abstract-eval live-set sweep at
+  planner-domain shapes, (8, 128) tile alignment and revisited-block
+  first-visit init, interpret-divergence hazards, loop-carry arity.
+  See :mod:`raft_tpu.analysis.kernels`.
+* **Artifacts** (``--artifacts``): rule A001 — every committed
+  root-level JSON artifact must load under the loader that consumes it
+  (select-k crossover tables, pad rules, pallas-probe verdicts against
+  ``REQUIRED_VERDICT_FAMILIES``, pareto frontiers, the graftcheck
+  baseline itself). See :mod:`raft_tpu.analysis.artifacts`.
 
 Findings are keyed ``(rule, file, qualname)`` so a committed baseline
 survives line churn; see :mod:`raft_tpu.analysis.findings`.
@@ -30,6 +42,7 @@ from __future__ import annotations
 import os
 from typing import Iterable, List, Optional, Tuple
 
+from raft_tpu.analysis.artifacts import run_artifacts
 from raft_tpu.analysis.astutils import ModuleInfo
 from raft_tpu.analysis.concurrency import THREAD_SCAN_DIRS, run_threads
 from raft_tpu.analysis.findings import (PLACEHOLDER_JUSTIFICATION, Finding,
@@ -37,6 +50,9 @@ from raft_tpu.analysis.findings import (PLACEHOLDER_JUSTIFICATION, Finding,
                                         split_by_baseline, unjustified_keys)
 from raft_tpu.analysis.flow import (FLOW_RULES, FLOW_SCAN_DIRS,
                                     FLOW_SCAN_FILES, flow_stats, run_flow)
+from raft_tpu.analysis.kernels import (KERNEL_RULES, KERNEL_SCAN_DIRS,
+                                       kernel_stats, kernel_vmem_audit,
+                                       run_kernels)
 from raft_tpu.analysis.layering import check_layering
 from raft_tpu.analysis.rules_ast import AST_RULES
 
@@ -46,8 +62,10 @@ __all__ = [
     "unjustified_keys", "PLACEHOLDER_JUSTIFICATION",
     "collect_modules", "run_tier_a", "run_threads",
     "run_flow", "flow_stats", "FLOW_RULES",
+    "run_kernels", "kernel_stats", "kernel_vmem_audit", "KERNEL_RULES",
+    "run_artifacts",
     "DEFAULT_SCAN_DIRS", "THREAD_SCAN_DIRS",
-    "FLOW_SCAN_DIRS", "FLOW_SCAN_FILES",
+    "FLOW_SCAN_DIRS", "FLOW_SCAN_FILES", "KERNEL_SCAN_DIRS",
 ]
 
 #: directories scanned by default, relative to the repo root.

@@ -1,0 +1,12 @@
+"""K001 bad twin: async copy started, .wait() only on one branch."""
+
+from jax.experimental import pallas as pl  # noqa: F401
+from jax.experimental.pallas import tpu as pltpu
+
+
+def leaky_kernel(src_ref, dst_ref, sem, flag):
+    cp = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    cp.start()
+    if flag:
+        cp.wait()
+    dst_ref[0, 0] = dst_ref[0, 0] + 1.0

@@ -1,0 +1,37 @@
+"""K001 clean twin: every start paired on every path, semaphores
+balanced, plus the legal descriptor-wait and loop-body idioms."""
+
+import jax
+from jax.experimental import pallas as pl  # noqa: F401
+from jax.experimental.pallas import tpu as pltpu
+
+
+def paired_kernel(src_ref, dst_ref, sem, flag):
+    cp = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    cp.start()
+    if flag:
+        dst_ref[0, 0] = 0.0
+    cp.wait()
+
+
+def loop_kernel(src_ref, dst_ref, sem, n):
+    def body(i, carry):
+        cp = pltpu.make_async_copy(src_ref.at[i], dst_ref.at[i], sem)
+        cp.start()
+        cp.wait()
+        return carry
+
+    return jax.lax.fori_loop(0, n, body, 0)
+
+
+def await_elsewhere(src_ref, dst_ref, sem):
+    # the copy was started by a neighbor device; waiting on a fresh
+    # descriptor for the same (src, dst, sem) triple is the idiom
+    pltpu.make_async_copy(src_ref, dst_ref, sem).wait()
+
+
+def barrier_kernel(left, right):
+    bar = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(bar, device_id=left)
+    pltpu.semaphore_signal(bar, device_id=right)
+    pltpu.semaphore_wait(bar, 2)

@@ -1,0 +1,18 @@
+"""K002 bad twin: VMEM-blocked pallas_call, no byte accounting."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def doubled(x):
+    return pl.pallas_call(
+        _double_kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

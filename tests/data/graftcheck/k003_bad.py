@@ -1,0 +1,20 @@
+"""K003 bad twin: the output block's index map ignores grid axis 1
+(the block stays VMEM-resident across it) but the kernel accumulates
+without a first-visit init."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _acc_kernel(x_ref, o_ref):
+    o_ref[...] = o_ref[...] + x_ref[...]
+
+
+def reduce_cols(x):
+    return pl.pallas_call(
+        _acc_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), x.dtype),
+    )(x)

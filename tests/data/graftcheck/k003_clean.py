@@ -1,0 +1,31 @@
+"""K003 clean twin: same revisited output block, properly initialized
+on the first visit of the ignored axis."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def reduce_vmem_bytes(rows, cols):
+    """Live set: one input block + the resident output block."""
+    return 2 * rows * cols * 4
+
+
+def _acc_kernel(x_ref, o_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] = o_ref[...] + x_ref[...]
+
+
+def reduce_cols(x):
+    return pl.pallas_call(
+        _acc_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), x.dtype),
+    )(x)

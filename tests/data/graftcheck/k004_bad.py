@@ -1,0 +1,9 @@
+"""K004 bad twin: behavior forks on the interpret flag."""
+
+from jax.experimental import pallas as pl  # noqa: F401
+
+
+def dispatch(x, interpret=False):
+    if interpret:
+        return x
+    return x * 2

@@ -1,0 +1,25 @@
+"""K004 clean twin: the interpret flag is passed through, never
+branched on — identical behavior either way."""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def run_vmem_bytes(rows, cols):
+    """Live set: the input block plus the output block."""
+    return 2 * rows * cols * 4
+
+
+def _noop_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def run(x, interpret=False):
+    return pl.pallas_call(
+        _noop_kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=bool(interpret),
+    )(x)

@@ -1,0 +1,13 @@
+"""K005 bad twin: the loop body returns one more carry element than
+the init tuple provides."""
+
+import jax
+from jax.experimental import pallas as pl  # noqa: F401
+
+
+def scan_rows(x):
+    def body(i, carry):
+        acc, best = carry
+        return (acc + x[i], best, i)
+
+    return jax.lax.fori_loop(0, 4, body, (0.0, 0.0))

@@ -1,0 +1,20 @@
+"""K005 clean twin: carry arity matches across both loop forms."""
+
+import jax
+from jax.experimental import pallas as pl  # noqa: F401
+
+
+def scan_rows(x):
+    def body(i, carry):
+        acc, best = carry
+        return (acc + x[i], best)
+
+    return jax.lax.fori_loop(0, 4, body, (0.0, 0.0))
+
+
+def drain(x):
+    return jax.lax.while_loop(
+        lambda carry: carry[0] < 8,
+        lambda carry: (carry[0] + 1, carry[1] * 2),
+        (0, x),
+    )

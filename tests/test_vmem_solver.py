@@ -1,0 +1,121 @@
+"""Adversarial coverage for ``core.resources.solve_vmem_tiles`` — the
+solver every fused-kernel tile planner (and graftcheck Tier K's VMEM
+sweep) leans on. The invariants pinned here:
+
+* alignment: ``outer`` is always an 8-multiple in [8, outer_cap],
+  ``inner`` a 128-multiple (or the full rounded extent);
+* the (8, 128) floor: degenerate budgets (zero, negative, fixed term
+  swallowing everything) degrade to exactly one aligned cell rather
+  than crashing or returning zero-sized tiles — the kernel still runs,
+  the budget becomes a target;
+* budget honesty: whenever the solver returns anything *above* the
+  floor, the affine cost model it advertises is actually satisfied;
+* non-divisor extents: ``inner_max`` is rounded UP to lane alignment,
+  never truncated to zero.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.resources import solve_vmem_tiles
+
+
+def _cost(o, i, cell, outer_b, inner_b):
+    return o * outer_b + i * inner_b + o * i * cell
+
+
+# --------------------------------------------------- degenerate budgets
+
+@pytest.mark.parametrize("budget", [0, -1, -(1 << 40), 1])
+def test_degenerate_budget_degrades_to_aligned_floor(budget):
+    assert solve_vmem_tiles(budget, cell_bytes=12, outer_bytes=512,
+                            inner_bytes=516, inner_max=4096) == (8, 128)
+
+
+def test_fixed_bytes_swallowing_the_budget_degrades_not_crashes():
+    out = solve_vmem_tiles(12 << 20, cell_bytes=12, outer_bytes=512,
+                           inner_bytes=516, inner_max=4096,
+                           fixed_bytes=13 << 20)
+    assert out == (8, 128)
+
+
+def test_single_aligned_cell_over_budget_still_returns_the_floor():
+    # one (8, 128) cell costs more than the whole budget: the solver
+    # must still hand back the floor, never (0, anything)
+    out = solve_vmem_tiles(1024, cell_bytes=1 << 20, outer_bytes=0,
+                           inner_bytes=0, inner_max=128)
+    assert out == (8, 128)
+
+
+# ----------------------------------------------- non-divisor inner extents
+
+@pytest.mark.parametrize("inner_max,expect", [
+    (1, 128), (100, 128), (129, 256), (1000, 1024), (4096, 4096),
+])
+def test_inner_max_rounds_up_to_lane_alignment(inner_max, expect):
+    outer, inner = solve_vmem_tiles(1 << 30, cell_bytes=4, outer_bytes=4,
+                                    inner_bytes=4, inner_max=inner_max)
+    assert inner == expect
+    assert outer % 8 == 0 and outer >= 8
+
+
+def test_zero_inner_max_is_clamped_to_one_cell():
+    outer, inner = solve_vmem_tiles(1 << 30, cell_bytes=4, outer_bytes=4,
+                                    inner_bytes=4, inner_max=0)
+    assert inner == 128
+
+
+# ------------------------------------------------------- budget honesty
+
+def test_full_extent_solution_fits_the_budget():
+    budget = 12 << 20
+    cell, outer_b, inner_b, inner_max = 12, 544, 516, 2048
+    outer, inner = solve_vmem_tiles(budget, cell, outer_b, inner_b,
+                                    inner_max)
+    assert inner == inner_max  # already lane-aligned: full-extent branch
+    assert outer == 256  # generous budget: outer rides up to the cap
+    assert _cost(outer, inner, cell, outer_b, inner_b) <= budget
+
+
+def test_inner_tiled_solution_fits_the_budget():
+    # force the tiled branch: full extent too wide for 8 outer rows
+    budget = 1 << 20
+    cell, outer_b, inner_b, inner_max = 64, 1024, 2048, 1 << 16
+    outer, inner = solve_vmem_tiles(budget, cell, outer_b, inner_b,
+                                    inner_max)
+    assert outer == 8 and inner % 128 == 0
+    assert _cost(outer, inner, cell, outer_b, inner_b) <= budget
+
+
+def test_outer_cap_is_honored():
+    outer, _ = solve_vmem_tiles(1 << 40, cell_bytes=1, outer_bytes=1,
+                                inner_bytes=1, inner_max=128,
+                                outer_cap=64)
+    assert outer == 64
+
+
+# ------------------------------------------------- randomized invariants
+
+def test_randomized_alignment_and_budget_invariants():
+    rng = np.random.default_rng(0xA11)
+    for _ in range(500):
+        budget = int(rng.integers(-(1 << 20), 1 << 26))
+        cell = int(rng.integers(0, 1 << 12))
+        outer_b = int(rng.integers(0, 1 << 14))
+        inner_b = int(rng.integers(0, 1 << 14))
+        inner_max = int(rng.integers(0, 1 << 16))
+        fixed = int(rng.integers(0, 1 << 24))
+        outer, inner = solve_vmem_tiles(budget, cell, outer_b, inner_b,
+                                        inner_max, fixed_bytes=fixed)
+        args = (budget, cell, outer_b, inner_b, inner_max, fixed)
+        # alignment invariants hold unconditionally
+        assert outer % 8 == 0 and 8 <= outer <= 256, args
+        assert inner % 128 == 0 and inner >= 128, args
+        assert inner <= max(inner_max + (-inner_max) % 128, 128), args
+        # above the floor, the advertised cost model is satisfied
+        if (outer, inner) != (8, 128):
+            have = max(budget - fixed, 1)
+            assert _cost(outer, inner, cell, outer_b, inner_b) <= have, args
+        # pure: same inputs, same answer
+        assert solve_vmem_tiles(budget, cell, outer_b, inner_b, inner_max,
+                                fixed_bytes=fixed) == (outer, inner), args

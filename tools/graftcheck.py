@@ -17,6 +17,8 @@ Typical use::
     python tools/graftcheck.py --threads          # + concurrency T001-T004
     python tools/graftcheck.py --threads --dot lock_order.dot
     python tools/graftcheck.py --flow             # + flow rules F001-F005
+    python tools/graftcheck.py --kernels          # + kernel rules K001-K005
+    python tools/graftcheck.py --artifacts        # + artifact gate A001
     python tools/graftcheck.py --json out.json    # machine-readable dump
     python tools/graftcheck.py --update-baseline  # re-record the baseline
 """
@@ -68,6 +70,22 @@ def main(argv=None) -> int:
                     help="also run the Tier-F typed-failure & resource-"
                          "lifecycle flow rules F001-F005 over the request "
                          "path (serving/, obs/, host_p2p; pure AST)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also run the Tier-K Pallas kernel-discipline "
+                         "rules K001-K005 (DMA pairing, VMEM accounting, "
+                         "tile alignment, interpret divergence, loop "
+                         "carries) plus the interpret-mode VMEM live-set "
+                         "sweep (imports JAX; traces only, executes "
+                         "nothing)")
+    ap.add_argument("--no-kernel-sweep", action="store_true",
+                    help="with --kernels: static rules only, skip the "
+                         "abstract-eval VMEM sweep (sub-second, no JAX "
+                         "import)")
+    ap.add_argument("--artifacts", action="store_true",
+                    help="also validate every committed root-level JSON "
+                         "artifact under the loader that consumes it "
+                         "(rule A001; reports — does not fail — the "
+                         "known-stale pre-v3 pallas probe)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="write a machine-readable findings dump (rule, "
                          "file, line, qualname, message, baselined flag); "
@@ -90,6 +108,8 @@ def main(argv=None) -> int:
 
     if args.dot is not None and not args.threads:
         ap.error("--dot requires --threads")
+    if args.no_kernel_sweep and not args.kernels:
+        ap.error("--no-kernel-sweep requires --kernels")
 
     findings = run_tier_a(args.root)
 
@@ -118,6 +138,39 @@ def main(argv=None) -> int:
                   f"{s['raise_sites']} raise sites, "
                   f"{s['settle_owners']} settle owners, "
                   f"{s['resources']} reclaimable resources")
+
+    if args.kernels:
+        from raft_tpu.analysis import (kernel_stats, kernel_vmem_audit,
+                                       run_kernels)
+        findings.extend(run_kernels(args.root, sweep=False))
+        if not args.quiet:
+            s = kernel_stats(args.root)
+            print(f"  [kernels] {s['modules']} pallas module(s): "
+                  f"{s['pallas_calls']} pallas_call sites, "
+                  f"{s['fused_kernels']} fused kernels, "
+                  f"{s['dma_sites']} DMA/semaphore sites")
+        if not args.no_kernel_sweep:
+            results, sweep_findings = kernel_vmem_audit()
+            findings.extend(sweep_findings)
+            if not args.quiet:
+                for r in results:
+                    state = "OK  " if r.ok else "FAIL"
+                    acc = ("-" if r.accountant_bytes is None
+                           else f"{r.accountant_bytes / 2**20:.2f} MiB")
+                    ratio = "-" if r.ratio is None else f"{r.ratio:.2f}x"
+                    print(f"  [kernels] {state} {r.family}@{r.point}: "
+                          f"{r.tiles}, live set "
+                          f"{r.measured_bytes / 2**20:.2f} MiB, "
+                          f"accounted {acc} ({ratio})"
+                          + (f" — {r.note}" if r.note else ""))
+
+    if args.artifacts:
+        from raft_tpu.analysis import run_artifacts
+        artifact_findings, report = run_artifacts(args.root)
+        findings.extend(artifact_findings)
+        if not args.quiet:
+            for line in report:
+                print(f"  [artifacts] {line}")
 
     if args.jaxpr_audit:
         from raft_tpu.analysis import jaxpr_audit

@@ -88,6 +88,16 @@ run_step cagra  /tmp/q5_cagra.done  timeout 3600 \
 # fresh one with the noise-aware gate — non-fatal, like benchgate: a
 # crossover shift is a finding for the wrap-up commit, not a reason to
 # starve the queue.
+# Tier-K pre-flight (graftcheck --kernels): the static kernel-
+# discipline rules K001-K005 plus the interpret-mode VMEM live-set
+# sweep — seconds on the host, zero chip time. The pallas verdict
+# steps below are gated on its marker: a window must never burn its
+# slice compiling a kernel with a statically-detectable DMA-pairing,
+# VMEM-budget, or loop-carry bug (rc!=0 leaves no marker, so the
+# pallas steps wait until the finding is fixed or baselined).
+run_step kernelcheck /tmp/q5_kernelcheck.done timeout 600 \
+  python tools/graftcheck.py --kernels -q
+[ -f /tmp/q5_kernelcheck.done ] && \
 run_step pallasbase /tmp/q5_pallasbase.done \
   cp PALLAS_PROBE_tpu.json /tmp/q_pallas_baseline.json
 # schema v3 split: the main probe measures everything except cagra (its
@@ -97,8 +107,10 @@ run_step pallasbase /tmp/q5_pallasbase.done \
 # artifact (all six scan families + merge_ring where measurable). A
 # dying window mid-cagrafuse leaves the other rows committed-ready; the
 # step resumes without re-measuring them.
+[ -f /tmp/q5_kernelcheck.done ] && \
 run_step pallas2 /tmp/q5_pallas2.done timeout 3600 \
   python tools/pallas_probe.py --skip cagra
+[ -f /tmp/q5_kernelcheck.done ] && \
 run_step cagrafuse /tmp/q5_cagrafuse.done timeout 7200 \
   python tools/pallas_probe.py --only cagra --require-verdicts
 run_step pallasgate /tmp/q5_pallasgate.done timeout 600 \
