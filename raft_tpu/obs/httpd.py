@@ -28,7 +28,9 @@ Routes:
   typically ``SLOMonitor.report`` — per-SLO burn rates, budget
   remaining, and fast-burn flags as JSON); 404 when no ``slo_fn`` is
   wired.
-- anything else → 404.
+- anything else → offered to ``text_route_fn`` (dynamic text routes —
+  the fleet serves remote replicas' own scrape text at
+  ``/metrics/replica/<name>`` through this), else 404.
 """
 
 from __future__ import annotations
@@ -55,12 +57,28 @@ class MetricsServer:
                  registry: Optional[_metrics.Registry] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
                  bundle_fn: Optional[Callable[[], dict]] = None,
-                 slo_fn: Optional[Callable[[], dict]] = None) -> None:
+                 slo_fn: Optional[Callable[[], dict]] = None,
+                 extra_text_fn: Optional[Callable[[], str]] = None,
+                 text_route_fn: Optional[
+                     Callable[[str], Optional[str]]] = None) -> None:
         self._registry = registry if registry is not None else \
             _metrics.REGISTRY
         self._health_fn = health_fn
         self._bundle_fn = bundle_fn
         self._slo_fn = slo_fn
+        # appended verbatim to the /metrics body: the fleet's one-target
+        # aggregation pulls foreign families (host_p2p transport
+        # counters on the global registry, remote replicas' own scrape
+        # text) through here; a raising fn is counted + silenced like
+        # every other telemetry path
+        self._extra_text_fn = extra_text_fn
+        # dynamic text routes: called with any otherwise-unmatched GET
+        # path; a str return is served as Prometheus text, None falls
+        # through to 404. The fleet's one-target aggregation serves each
+        # remote replica's own scrape at /metrics/replica/<name> through
+        # here (a path registry would go stale as the autoscaler churns
+        # membership; a callable resolves against live membership).
+        self._text_route_fn = text_route_fn
         self._requested = (host, int(port))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -101,6 +119,20 @@ class MetricsServer:
                 try:
                     if path == "/metrics":
                         text = server._registry.to_prometheus_text()
+                        if server._extra_text_fn is not None:
+                            try:
+                                extra = server._extra_text_fn()
+                            except Exception as e:
+                                extra = ""
+                                server._registry.counter(
+                                    "raft_tpu_http_errors_total",
+                                    "Handler failures by path and "
+                                    "exception type.",
+                                    ("path", "error")).labels(
+                                        "/metrics[extra]",
+                                        type(e).__name__).inc()
+                            if extra:
+                                text = text.rstrip("\n") + "\n" + extra
                         self._send(200,
                                    "text/plain; version=0.0.4; "
                                    "charset=utf-8", text.encode())
@@ -131,7 +163,16 @@ class MetricsServer:
                                                    default=str)
                                         + "\n").encode())
                     else:
-                        self._send(404, "text/plain", b"not found\n")
+                        body = (server._text_route_fn(path)
+                                if server._text_route_fn is not None
+                                else None)
+                        if body is None:
+                            self._send(404, "text/plain", b"not found\n")
+                        else:
+                            self._send(200,
+                                       "text/plain; version=0.0.4; "
+                                       "charset=utf-8",
+                                       str(body).encode())
                 except BrokenPipeError:
                     # scraper hung up mid-response; count it so a flaky
                     # collector shows up on the dashboard it scrapes

@@ -401,12 +401,18 @@ class Registry:
 
     # ----------------------------------------------------- exposition
 
-    def to_prometheus_text(self) -> str:
+    def to_prometheus_text(self, prefix: Optional[str] = None) -> str:
         """Prometheus text exposition format 0.0.4. Counters follow the
         ``_total`` suffix convention at registration time (families are
-        emitted under their registered names verbatim)."""
+        emitted under their registered names verbatim). ``prefix``
+        restricts the dump to families whose name starts with it — the
+        fleet's one-target aggregation uses this to append just the
+        ``raft_tpu_p2p_*`` transport families from the global registry
+        onto a private-registry scrape without duplicating the rest."""
         out: List[str] = []
         for fam in self.collect():
+            if prefix is not None and not fam.name.startswith(prefix):
+                continue
             children = fam.collect()
             if not children:
                 continue

@@ -23,6 +23,20 @@ are received in the order they were posted.
 Message framing: [i32 magic][i32 src][i32 tag][u64 nbytes][type byte]
 [payload]. ndarray payloads carry a dtype/shape header (npy) so they
 reconstruct on the receiving side; raw ``bytes`` pass through untouched.
+
+Request/response support (the serving remote-replica proxy rides this):
+``correlation_id()`` allocates tags from a reserved range
+(``>= _CORR_BASE``) so an RPC reply can be matched to exactly one
+outstanding request without colliding with user tags; ``discard()``
+drops an abandoned correlation's state so late replies cannot
+accumulate in the inbox. ``announce_drain(dest)`` sends a control frame
+that tells the peer "nothing more is coming from me — this is a clean
+goodbye": the receiver fails that source's pending irecvs with the
+typed :class:`PeerDrained` (not a presumed death), suppresses the
+peer-death grace timer for the EOF that follows, and fails later
+irecvs from that source immediately instead of waiting out the
+timeout. A new delivery from the source (a restarted process) clears
+the drained verdict.
 """
 
 from __future__ import annotations
@@ -30,6 +44,7 @@ from __future__ import annotations
 import collections
 import errno
 import io
+import itertools
 import os
 import queue
 import random
@@ -47,6 +62,15 @@ from raft_tpu.obs import metrics as obs_metrics
 
 _MAGIC = 0x52465450  # "RFTP"
 _HDR = struct.Struct("<iiiQ")
+
+#: control-frame tag: graceful drain announcement (never delivered to an
+#: irecv — intercepted in _deliver)
+_DRAIN_TAG = -2
+
+#: correlation tags live at and above this value; user tags should stay
+#: below it (the allocator wraps inside [_CORR_BASE, _CORR_LIMIT))
+_CORR_BASE = 1 << 20
+_CORR_LIMIT = 1 << 30
 
 # fabric counters (docs/observability.md), labeled by the REMOTE rank:
 # `peer` is the destination for send-side families, the source for
@@ -84,6 +108,13 @@ class _EndpointClosed(ConnectionError):
     ECONNRESET, ...) to ConnectionRefused/ResetError — ConnectionError
     subclasses — so `except ConnectionError` would also swallow ordinary
     refused connects."""
+
+
+class PeerDrained(ConnectionError):
+    """The peer announced a graceful drain (``announce_drain``): nothing
+    more will arrive from it, by design. A typed, *clean* verdict — the
+    serving proxy maps it to a retry-on-sibling, distinct from the
+    presumed-death ConnectionError the grace timer raises."""
 
 
 class Request:
@@ -243,11 +274,24 @@ class HostP2P:
         # time — any later delivery proves the peer (or its retry) is
         # alive and voids the death verdict
         self._peer_gen: dict = {}  # guarded_by: _match_lock
+        # sources that announced a graceful drain (module docstring):
+        # their EOF is clean and their pending irecvs fail PeerDrained
+        self._drained: set = set()  # guarded_by: _match_lock
         # per-destination sender worker: one persistent connection, FIFO
         self._send_queues: dict = {}  # guarded_by: _send_lock
         self._send_lock = threading.Lock()
         # dest -> live outbound socket (test hook _sever_send cuts it)
         self._active_send: dict = {}  # guarded_by: _send_lock
+        # dest -> poisoning error; reset_stream() clears it so a healed
+        # link can carry traffic again (the caller acknowledges the gap)
+        self._poison: dict = {}  # guarded_by: _send_lock
+        # injected-fault state (testing.faults.partition_hosts /
+        # delay_link): replaced wholesale under _send_lock; hot-path
+        # reads are lock-free attribute loads of the immutable values
+        self._partitioned: frozenset = frozenset()
+        self._link_delay: dict = {}
+        # correlation-tag allocator (itertools.count is C-atomic)
+        self._corr = itertools.count()
         # live accepted connections (see close())
         self._conns: set = set()  # guarded_by: _conns_lock
         self._conns_lock = threading.Lock()
@@ -314,12 +358,23 @@ class HostP2P:
             with self._conns_lock:
                 self._conns.discard(conn)
             if (abnormal and last_src is not None
-                    and not self._closed.is_set()):
+                    and not self._closed.is_set()
+                    and not self._is_drained(last_src)):
                 self._schedule_peer_check(last_src)
 
+    def _is_drained(self, src: int) -> bool:
+        with self._match_lock:
+            return src in self._drained
+
     def _deliver(self, src: int, tag: int, payload):
+        if src in self._partitioned:
+            return  # injected partition: inbound half of the cut
+        if tag == _DRAIN_TAG:
+            self._handle_drain(src)
+            return
         with self._match_lock:
             self._peer_gen[src] = self._peer_gen.get(src, 0) + 1
+            self._drained.discard(src)  # delivering again — alive
             waiting = self._waiting.get((src, tag))
             while waiting:
                 req = waiting.popleft()
@@ -328,6 +383,19 @@ class HostP2P:
                     return
             self._inbox.setdefault((src, tag),
                                    collections.deque()).append(payload)
+
+    def _handle_drain(self, src: int) -> None:
+        """Graceful-drain control frame: fail this source's pending
+        irecvs with the typed :class:`PeerDrained`, void any in-flight
+        death verdict (the goodbye proves the peer was alive), and
+        remember the drain so the EOF that follows is clean."""
+        with self._match_lock:
+            self._peer_gen[src] = self._peer_gen.get(src, 0) + 1
+            self._drained.add(src)
+            self._fail_src_locked(src, PeerDrained(
+                f"peer rank {src} announced a graceful drain"))
+        logger.info("host_p2p rank %d: peer rank %d drained gracefully",
+                    self.rank, src)
 
     # ----------------------------------------------------------- peer death
     def _schedule_peer_check(self, src: int) -> None:
@@ -408,10 +476,43 @@ class HostP2P:
             elif self._closed.is_set():  # raced with close(): fail bounded
                 req._finish(error=ConnectionError(
                     "HostP2P closed with receive outstanding"))
+            elif source in self._drained:
+                # the peer said goodbye: its message can never arrive —
+                # fail now, typed, instead of waiting out the timeout
+                req._finish(error=PeerDrained(
+                    f"peer rank {source} announced a graceful drain"))
             else:
                 self._waiting.setdefault(
                     (source, tag), collections.deque()).append(req)
         return req
+
+    def discard(self, source: int, tag: int) -> int:
+        """Drop any unclaimed inbox messages and cancelled waiters for
+        ``(source, tag)`` — the cleanup half of the correlation-id
+        protocol: an RPC client that abandons a request (deadline spent,
+        replica written off) calls this so a late reply cannot sit in
+        the inbox forever. Returns the number of messages dropped."""
+        with self._match_lock:
+            box = self._inbox.pop((source, tag), None)
+            waiting = self._waiting.get((source, tag))
+            if waiting is not None:
+                live = collections.deque(
+                    r for r in waiting if not r._cancelled)
+                if live:
+                    self._waiting[(source, tag)] = live
+                else:
+                    self._waiting.pop((source, tag), None)
+        return len(box) if box else 0
+
+    def correlation_id(self) -> int:
+        """Allocate a fresh tag from the reserved correlation range —
+        the request/response matching primitive: the requester posts
+        ``irecv(source=peer, tag=cid)`` before sending, the responder
+        echoes the cid as the reply tag, and the reply can match
+        nothing else. Wraps inside [2**20, 2**30); user tags should
+        stay below the base."""
+        span = _CORR_LIMIT - _CORR_BASE
+        return _CORR_BASE + (next(self._corr) % span)
 
     # ---------------------------------------------------------------- send
     def _sender_for(self, dest: int) -> "queue.Queue":
@@ -434,6 +535,9 @@ class HostP2P:
         ``timeout`` after close() returned. Sockets register in ``_conns``
         so close() reaps them. Like socket.create_connection, every
         getaddrinfo result (v4 and v6) is tried before giving up."""
+        if dest in self._partitioned:
+            raise OSError(errno.EHOSTUNREACH,
+                          f"rank {dest} partitioned (injected fault)")
         host, port = self.peers[dest]
         last_err: Optional[BaseException] = None
         for family, stype, proto, _, addr in socket.getaddrinfo(
@@ -546,6 +650,45 @@ class HostP2P:
             pass
         return True
 
+    def _partition(self, rank: int) -> None:
+        """Fault-injection hook (testing.faults.partition_hosts): drop the
+        link to/from ``rank`` persistently — outbound connects refuse
+        (EHOSTUNREACH), inbound frames are discarded — until
+        :meth:`_heal`. Also cuts the live outbound socket so an
+        in-flight send fails like a real partition onset."""
+        with self._send_lock:
+            self._partitioned = self._partitioned | {rank}
+        self._sever_send(rank)
+
+    def _heal(self, rank: int) -> None:
+        """Undo :meth:`_partition` and clear the send-stream poison so
+        traffic can flow again (see :meth:`reset_stream`)."""
+        with self._send_lock:
+            self._partitioned = self._partitioned - {rank}
+        self.reset_stream(rank)
+
+    def _set_link_delay(self, dest: int, delay_s: Optional[float]) -> None:
+        """Fault-injection hook (testing.faults.delay_link): sleep
+        ``delay_s`` before each frame to ``dest`` (None clears)."""
+        with self._send_lock:
+            d = dict(self._link_delay)
+            if delay_s is None:
+                d.pop(dest, None)
+            else:
+                d[dest] = float(delay_s)
+            self._link_delay = d
+
+    def reset_stream(self, dest: int) -> bool:
+        """Clear the poison on the send stream to ``dest`` so the next
+        send attempts a fresh connection. Poisoning exists to keep the
+        non-overtaking stream gap-free — resetting it is the caller
+        EXPLICITLY acknowledging that messages may have been lost in the
+        gap (safe for the correlation-id RPC layer, which tracks every
+        request individually and re-sends whole requests). Returns True
+        when a poison was cleared."""
+        with self._send_lock:
+            return self._poison.pop(dest, None) is not None
+
     def _send_loop(self, dest: int, q: "queue.Queue"):
         """All sends to ``dest`` go through one connection in post order —
         the non-overtaking half of the contract. A transient failure is
@@ -554,25 +697,37 @@ class HostP2P:
         after ``retries`` are exhausted does the failure POISON the
         stream: every later request to this destination fails with the
         original error, so the receiver can never observe a gap (message i
-        lost, i+1 delivered)."""
+        lost, i+1 delivered). :meth:`reset_stream` clears the poison for
+        callers (the RPC layer, a healed partition) that accept the
+        gap explicitly."""
         sock = None
-        poison: Optional[BaseException] = None
         while not self._closed.is_set():
             try:
                 item = q.get(timeout=0.25)
             except queue.Empty:
                 continue
             req, tag, ty, raw = item
+            with self._send_lock:
+                poison = self._poison.get(dest)
             if poison is not None:
-                req._finish(error=ConnectionError(
+                err = ConnectionError(
                     f"send stream to rank {dest} poisoned by earlier "
-                    f"failure: {poison!r}"))
+                    f"failure: {poison!r}")
+                err.__cause__ = poison  # keep the class for isinstance
+                req._finish(error=err)
                 continue
             attempt = 0
             slept_s = 0.0  # cumulative backoff this frame (logged below)
             nbytes = _HDR.size + 1 + len(raw)
             while True:
                 try:
+                    delay_s = self._link_delay.get(dest)
+                    if delay_s and self._closed.wait(delay_s):
+                        raise _EndpointClosed("HostP2P closed")
+                    if dest in self._partitioned:
+                        raise OSError(
+                            errno.EHOSTUNREACH,
+                            f"rank {dest} partitioned (injected fault)")
                     if sock is None:
                         sock = self._connect(dest)
                         self._set_active_send(dest, sock)
@@ -586,7 +741,8 @@ class HostP2P:
                     break
                 except _EndpointClosed as e:  # closed endpoint: terminal
                     req._finish(error=e)
-                    poison = e
+                    with self._send_lock:
+                        self._poison[dest] = e
                     break
                 except BaseException as e:  # surfaced at wait()
                     if sock is not None:
@@ -596,7 +752,8 @@ class HostP2P:
                     attempt += 1
                     if attempt > self.retries or self._closed.is_set():
                         req._finish(error=e)
-                        poison = e
+                        with self._send_lock:
+                            self._poison[dest] = e
                         _STREAMS_POISONED.labels(dest).inc()
                         logger.error(
                             "host_p2p rank %d: send to rank %d failed "
@@ -617,7 +774,8 @@ class HostP2P:
                     # backoff observes _closed so close() stays bounded
                     if self._closed.wait(delay):
                         req._finish(error=e)
-                        poison = e
+                        with self._send_lock:
+                            self._poison[dest] = e
                         break
         self._set_active_send(dest, None)
         if sock is not None:
@@ -644,6 +802,15 @@ class HostP2P:
             _drain_queue(q, ConnectionError(
                 "HostP2P closed before send completed"))
         return req
+
+    def announce_drain(self, dest: int) -> Request:
+        """Send the graceful-drain control frame to ``dest`` (module
+        docstring): it rides the ordered send stream, so everything
+        posted before it is delivered first, then the peer fails its
+        pending irecvs from this rank with :class:`PeerDrained` and
+        treats the connection EOF that follows as clean. Call before
+        :meth:`close` for a polite shutdown (a crash simply doesn't)."""
+        return self.isend(b"", dest, tag=_DRAIN_TAG)
 
     # ---------------------------------------------------------------- wait
     @staticmethod

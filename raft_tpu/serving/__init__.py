@@ -42,6 +42,10 @@ exception             base                 retryable  raised when
                                                       queue at capacity
 ``EngineStopped``     ``RuntimeError``     yes        replica stopped —
                                                       the fleet case
+``ReplicaStarting``   ``Overloaded``       yes        remote replica's
+                                                      transport refused:
+                                                      process still
+                                                      spawning
 ``DeadlineExceeded``  ``RuntimeError``     no         the rider's budget
                                                       is spent; no
                                                       sibling un-spends
@@ -63,6 +67,8 @@ injectors in ``raft_tpu.testing.faults``.
 """
 
 from raft_tpu.core.errors import IntegrityError
+from raft_tpu.serving.autoscaler import (AUTOSCALE_REASONS, Autoscaler,
+                                         AutoscalerConfig)
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
                                       EngineStopped, QueueFull, Request)
 from raft_tpu.serving.engine import (BatchFailed, CircuitBreaker,
@@ -70,9 +76,11 @@ from raft_tpu.serving.engine import (BatchFailed, CircuitBreaker,
                                      Overloaded, compile_count,
                                      solo_reference, verify_bit_identity)
 from raft_tpu.serving.fleet import Fleet, FleetConfig, Replica
+from raft_tpu.serving.remote import RemoteReplica
 from raft_tpu.serving.router import (FleetBelowQuorum, NoReplicaAvailable,
-                                     RetriesExhausted, RetryPolicy,
-                                     Router, failure_kind, is_retryable)
+                                     ReplicaStarting, RetriesExhausted,
+                                     RetryPolicy, Router, failure_kind,
+                                     is_retryable)
 from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
                                         cagra_searcher, elastic_searcher,
                                         ivf_flat_searcher,
@@ -80,6 +88,9 @@ from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
 from raft_tpu.serving.stats import ServingStats, percentiles
 
 __all__ = [
+    "AUTOSCALE_REASONS",
+    "Autoscaler",
+    "AutoscalerConfig",
     "Batch",
     "BatchFailed",
     "Batcher",
@@ -96,7 +107,9 @@ __all__ = [
     "NoReplicaAvailable",
     "Overloaded",
     "QueueFull",
+    "RemoteReplica",
     "Replica",
+    "ReplicaStarting",
     "Request",
     "RetriesExhausted",
     "RetryPolicy",

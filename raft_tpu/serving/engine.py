@@ -416,9 +416,10 @@ class Engine:
             "raft_tpu_serving_autoscale_pressure",
             "p99 queue wait / deadline budget — the documented autoscale "
             "signal: sustained > 1.0 means coalescing cannot keep up and "
-            "the replica set should grow.",
+            "the replica set should grow. Windowed: reset_samples() "
+            "re-baselines it, so the ratio falls again when load falls.",
             ("engine",)).labels(label).set_function(
-                lambda: self.stats.queue_wait_p99_s() * 1e3
+                lambda: self.stats.queue_wait_p99_window_s() * 1e3
                 / self.autoscale_budget_ms)
         reg.gauge(
             "raft_tpu_serving_queue_depth",

@@ -77,6 +77,13 @@ _FLEET_EVENTS = ("submitted", "ok", "failed", "cancelled", "stopped",
 #: admin states a replica moves through (writes hold the fleet lock)
 _ADMIN_STATES = ("in_service", "draining")
 
+#: closed vocabulary for raft_tpu_fleet_replica_lifecycle_total —
+#: added/removed are the Fleet's own add_replica/remove_replica;
+#: spawned/retired/spawn_failed are the autoscaler attributing its
+#: actuations (serving/autoscaler.py), 1:1 with kind="autoscale" spans
+_LIFECYCLE_EVENTS = ("added", "removed", "spawned", "retired",
+                     "spawn_failed")
+
 
 @dataclasses.dataclass
 class FleetConfig:
@@ -180,22 +187,34 @@ class _FleetStats:
         self.registry = (registry if registry is not None
                          else obs_metrics.REGISTRY)
         r, f = self.registry, fleet.label
+        self._fleet_label = f
         req = r.counter(
             "raft_tpu_fleet_requests_total",
             "Fleet requests by typed outcome event.", ("fleet", "event"))
         self._req = {ev: req.labels(f, ev) for ev in _FLEET_EVENTS}
-        routed = r.counter(
+        # family refs kept: add_replica() registers children for
+        # replicas that join after construction (autoscale spawns)
+        self._routed_family = r.counter(
             "raft_tpu_fleet_routed_total",
             "Requests accepted by a replica (per attempt).",
             ("fleet", "replica"))
-        retried = r.counter(
+        self._retried_family = r.counter(
             "raft_tpu_fleet_retries_total",
             "Retries scheduled after a typed per-replica failure.",
             ("fleet", "replica", "error"))
         names = [rep.name for rep in fleet.replicas]
-        self._routed = {n: routed.labels(f, n) for n in names}
-        self._retried = {(n, e): retried.labels(f, n, e)
+        self._routed = {n: self._routed_family.labels(f, n)
+                        for n in names}
+        self._retried = {(n, e): self._retried_family.labels(f, n, e)
                          for n in names for e in FAILURE_KINDS}
+        lifecycle = r.counter(
+            "raft_tpu_fleet_replica_lifecycle_total",
+            "Replica membership transitions by closed event vocabulary "
+            "(added/removed by the Fleet, spawned/retired/spawn_failed "
+            "attributed by the autoscaler, 1:1 with its spans).",
+            ("fleet", "event"))
+        self._lifecycle = {ev: lifecycle.labels(f, ev)
+                           for ev in _LIFECYCLE_EVENTS}
         self._swaps = r.counter(
             "raft_tpu_fleet_rolling_swaps_total",
             "Replicas drained + swapped by rolling_swap.",
@@ -209,14 +228,41 @@ class _FleetStats:
             "raft_tpu_fleet_quorum_threshold",
             "Configured quorum floor (rolling_swap refusal line).",
             ("fleet",)).labels(f).set(float(fleet.config.quorum))
-        health = r.gauge(
+        self._health_family = r.gauge(
             "raft_tpu_fleet_replica_health",
             "Replica health: 1 ok, 0.5 degraded, 0 unhealthy.",
             ("fleet", "replica"))
         for rep in fleet.replicas:
-            health.labels(f, rep.name).set_function(
-                lambda rep=rep: _HEALTH_VALUE.get(
-                    rep.engine.health()["status"], 0.0))
+            self._bind_health(rep)
+
+    def _bind_health(self, rep) -> None:
+        self._health_family.labels(self._fleet_label,
+                                   rep.name).set_function(
+            lambda rep=rep: _HEALTH_VALUE.get(
+                rep.engine.health()["status"], 0.0))
+
+    def add_replica(self, rep) -> None:
+        """Register counter children + the health gauge for a replica
+        that joined after construction (idempotent for rejoin-by-name:
+        the registry hands back the existing children, so counts
+        survive a retire/respawn cycle under the same name)."""
+        f = self._fleet_label
+        self._routed.setdefault(
+            rep.name, self._routed_family.labels(f, rep.name))
+        for e in FAILURE_KINDS:
+            self._retried.setdefault(
+                (rep.name, e), self._retried_family.labels(f, rep.name, e))
+        self._bind_health(rep)
+
+    def remove_replica(self, name: str) -> None:
+        """Pin the departed replica's health gauge at 0.0 (its engine
+        reference must not outlive the membership — a scrape of a
+        retired name reads a constant, not a stopped engine)."""
+        self._health_family.labels(self._fleet_label, name).set_function(
+            lambda: 0.0)
+
+    def record_lifecycle(self, event: str) -> None:
+        self._lifecycle[event].inc()
 
     def record_request(self, event: str) -> None:
         self._req[event].inc()
@@ -648,6 +694,69 @@ class Fleet:
             })
         return old
 
+    # ------------------------------------------------- dynamic membership
+    def add_replica(self, engine, name: Optional[str] = None) -> Replica:
+        """Admit one more replica (the autoscaler's scale-up actuator).
+        The engine-like must match the fleet ``dim``; it is started if
+        the fleet is running, registered with the stats family, and
+        placed in rotation atomically (the replicas tuple is replaced
+        wholesale under the fleet lock — the router's lock-free read
+        sees either the old or the new tuple, both valid)."""
+        if name is None:
+            name = f"replica{len(self.replicas)}"
+        dim = int(engine.searcher.dim)
+        if dim != self.dim:
+            raise ValueError(f"replica dim {dim} != fleet dim {self.dim}")
+        with self._lock:
+            if any(r.name == name for r in self.replicas):
+                raise ValueError(f"replica name {name!r} already in fleet")
+        if self._started and not getattr(engine, "_started", False):
+            engine.start()
+        rep = Replica(name, engine)
+        self.stats.add_replica(rep)
+        with self._lock:
+            self.replicas = self.replicas + (rep,)  # guarded_by: _lock
+        self.stats.record_lifecycle("added")
+        return rep
+
+    def remove_replica(self, name: str, drain: bool = True,
+                       drain_timeout_s: Optional[float] = 30.0):
+        """Retire one replica (the autoscaler's scale-down actuator)
+        through the same quorum-checked drain discipline as
+        ``rolling_swap``: refuse (:class:`FleetBelowQuorum`) when the
+        remaining siblings could not hold quorum, take the replica out
+        of rotation, drain its queue, stop its engine, then drop it
+        from the tuple. Returns the removed engine (the caller owns
+        any process teardown)."""
+        target = None
+        for r in self.replicas:
+            if r.name == name:
+                target = r
+                break
+        if target is None:
+            raise KeyError(f"no replica named {name!r}")
+        healthy_rest = sum(
+            1 for r in self.replicas
+            if r is not target and r.admin == "in_service"
+            and r.engine.health()["status"] != "unhealthy")
+        if healthy_rest < self.config.quorum:
+            raise FleetBelowQuorum(
+                f"removing {name} would leave {healthy_rest} healthy "
+                f"replicas < quorum {self.config.quorum}")
+        with self._lock:
+            target.admin = "draining"
+        try:
+            if drain:
+                target.engine.drain(drain_timeout_s)
+            target.engine.stop(drain=drain, timeout=drain_timeout_s)
+        finally:
+            with self._lock:
+                self.replicas = tuple(
+                    r for r in self.replicas if r is not target)
+        self.stats.remove_replica(name)
+        self.stats.record_lifecycle("removed")
+        return target.engine
+
     # ------------------------------------------------------------- health
     def healthy_count(self) -> int:
         """In-service replicas currently ok or degraded — the quorum
@@ -697,9 +806,48 @@ class Fleet:
         (every ``raft_tpu_serving_*`` engine family plus
         ``raft_tpu_fleet_*``) at ``/metrics``, and the aggregated
         :meth:`health` at ``/healthz`` — 200 while quorum holds (status
-        ``"degraded"`` when any replica is), 503 below quorum."""
+        ``"degraded"`` when any replica is), 503 below quorum.
+
+        The host_p2p transport families (``raft_tpu_p2p_*`` — the 8
+        per-peer send/retry/poison/death counters a REMOTE fleet's
+        health story needs) always live on the process-global registry;
+        when the fleet scrapes a private registry they are appended to
+        the same ``/metrics`` body, so cross-host transport health is
+        never invisible behind a registry override.
+
+        Remote replicas' own engine families (which live in OTHER
+        processes' registries) are served at
+        ``/metrics/replica/<name>`` — a passthrough of the replica's
+        ``scrape`` RPC, resolved against live membership so autoscaled
+        replicas appear and retire with the fleet. They are routes, not
+        an inline merge: merging another process's text into
+        ``/metrics`` would duplicate family declarations."""
         if self.metrics_server is None:
+            extra = None
+            if self.stats.registry is not obs_metrics.REGISTRY:
+                extra = (lambda: obs_metrics.REGISTRY
+                         .to_prometheus_text(prefix="raft_tpu_p2p_"))
             self.metrics_server = MetricsServer(
                 port, host, registry=self.stats.registry,
-                health_fn=self.health).start()
+                health_fn=self.health, extra_text_fn=extra,
+                text_route_fn=self._replica_scrape_route).start()
         return self.metrics_server
+
+    def _replica_scrape_route(self, path: str):
+        """``/metrics/replica/<name>`` → that replica's own scrape text
+        fetched over the wire (remote replicas only — a local engine's
+        families are already on the fleet registry at ``/metrics``).
+        None (→ 404) for unknown names, local replicas, and every other
+        path; a dead link raises and surfaces as the handler's counted
+        500, not a silent empty body."""
+        prefix = "/metrics/replica/"
+        if not path.startswith(prefix):
+            return None
+        name = path[len(prefix):]
+        for r in self.replicas:
+            if r.name == name:
+                scrape = getattr(r.engine, "scrape", None)
+                if callable(scrape):
+                    return str(scrape(timeout=5.0))
+                return None
+        return None

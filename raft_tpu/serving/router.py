@@ -43,6 +43,9 @@ exception           retryable  why
 ``QueueFull``       yes        replica-local admission queue at capacity
 ``EngineStopped``   yes        replica death — exactly the case siblings
                                exist for
+``ReplicaStarting`` yes        remote replica still spawning (connect
+                               refused); a sibling serves meanwhile
+                               (subclass of ``Overloaded``)
 ``CancelledError``  yes        a replica stop cancelled the rider pre-launch
 ``DeadlineExceeded``no         the *rider's* budget is spent; no sibling can
                                un-spend it
@@ -73,7 +76,8 @@ from raft_tpu.serving.batcher import (DeadlineExceeded, EngineStopped,
 from raft_tpu.serving.engine import BatchFailed, CircuitOpen, Overloaded
 
 __all__ = ["NoReplicaAvailable", "RetriesExhausted", "FleetBelowQuorum",
-           "RetryPolicy", "Router", "is_retryable", "failure_kind"]
+           "ReplicaStarting", "RetryPolicy", "Router", "is_retryable",
+           "failure_kind"]
 
 
 # ------------------------------------------------------------ typed sheds
@@ -107,6 +111,15 @@ class FleetBelowQuorum(RuntimeError):
     upgrade."""
 
 
+class ReplicaStarting(Overloaded):
+    """A remote replica's transport refused the connection — the process
+    is still spawning (or restarting), its listener not yet bound.
+    Subclasses :class:`~raft_tpu.serving.engine.Overloaded` so the
+    existing retryability table sends the request to a sibling while
+    the newcomer warms up. The ECONNREFUSED (or poisoned-stream wrapper)
+    rides ``__cause__``."""
+
+
 # ------------------------------------------------------- retryability map
 _RETRYABLE = (BatchFailed, Overloaded, QueueFull, EngineStopped,
               CancelledError)
@@ -132,6 +145,8 @@ def failure_kind(exc: BaseException) -> str:
         return "retries_exhausted"
     if isinstance(exc, NoReplicaAvailable):
         return "no_replica"
+    if isinstance(exc, ReplicaStarting):
+        return "replica_starting"
     if isinstance(exc, QueueFull):
         return "queue_full"
     if isinstance(exc, Overloaded):
@@ -152,9 +167,9 @@ def failure_kind(exc: BaseException) -> str:
 #: every label ``failure_kind`` can produce — the fleet pre-touches its
 #: retry counters over this vocabulary so a scrape shows zeros, not holes
 FAILURE_KINDS = ("circuit_open", "retries_exhausted", "no_replica",
-                 "queue_full", "overloaded", "batch_failed",
-                 "engine_stopped", "cancelled", "deadline", "integrity",
-                 "other")
+                 "replica_starting", "queue_full", "overloaded",
+                 "batch_failed", "engine_stopped", "cancelled", "deadline",
+                 "integrity", "other")
 
 
 class RetryPolicy:
@@ -218,8 +233,11 @@ class Router:
         if health is None:
             health = eng.health()
         depth = float(len(eng.batcher))
-        pressure = (eng.stats.queue_wait_p99_s() * 1e3
-                    / eng.autoscale_budget_ms)
+        # windowed when available (same signal the autoscaler reads);
+        # remote stats views only piggyback the cumulative p99
+        read = getattr(eng.stats, "queue_wait_p99_window_s",
+                       eng.stats.queue_wait_p99_s)
+        pressure = read() * 1e3 / eng.autoscale_budget_ms
         s = depth + self.pressure_weight * pressure
         if health["status"] == "degraded":
             s += self.degraded_penalty

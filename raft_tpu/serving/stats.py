@@ -370,10 +370,23 @@ class ServingStats:
         self._base = {k: h.snapshot() for k, h in self._hists.items()}
 
     def queue_wait_p99_s(self) -> float:
-        """Cumulative (not windowed) p99 queue wait in seconds — the
-        autoscale pressure numerator (docs/observability.md). 0.0 until
-        the first completed batch."""
+        """Cumulative (not windowed) p99 queue wait in seconds. 0.0
+        until the first completed batch."""
         return self._hists["queue_wait"].snapshot().quantile(0.99)
+
+    def queue_wait_p99_window_s(self) -> float:
+        """p99 queue wait in seconds over the window since the last
+        ``reset_samples()`` — the autoscale pressure numerator
+        (docs/observability.md). Identical to :meth:`queue_wait_p99_s`
+        until someone re-baselines; after a re-baseline it reflects the
+        CURRENT operating point, which is what lets autoscale pressure
+        fall again when offered load falls (a cumulative p99 is a
+        high-water mark and can only ratchet up). The load driver owns
+        the re-baseline cadence; the autoscaler only reads."""
+        diff = self._hists["queue_wait"].snapshot() - self._base["queue_wait"]
+        if not diff.count:
+            return 0.0
+        return diff.quantile(0.99)
 
     # convenience for tests / artifacts
     def mean_total_ms(self) -> Optional[float]:

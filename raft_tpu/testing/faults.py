@@ -113,6 +113,55 @@ def sever_connection(endpoint, dest: int) -> bool:
     return endpoint._sever_send(dest)
 
 
+def partition_hosts(a, b):
+    """Two-way network partition between endpoint ``a`` and peer ``b``
+    (a :class:`~raft_tpu.parallel.host_p2p.HostP2P` endpoint, or a bare
+    rank int for one-sided partitions — the split-brain shape, where
+    ``a`` cannot reach ``b`` but ``b`` is alive and self-reporting ok).
+
+    Every live connection is cut AND every reconnect attempt fails
+    typed (EHOSTUNREACH) until the returned zero-arg ``heal()`` runs;
+    heal also clears stream poison on both sides so healed links carry
+    traffic again (the breaker-probe re-admission path exercises this,
+    tests/test_remote_fleet.py)."""
+    b_rank = b if isinstance(b, int) else b.rank
+    a._partition(b_rank)
+    two_way = not isinstance(b, int)
+    if two_way:
+        b._partition(a.rank)
+
+    def heal():
+        a._heal(b_rank)
+        if two_way:
+            b._heal(a.rank)
+    return heal
+
+
+def delay_link(endpoint, dest: int, delay_s: float):
+    """Inject ``delay_s`` of extra one-way latency on every frame
+    ``endpoint`` sends to rank ``dest`` (a slow WAN hop / congested
+    link, the gray-failure sibling of :func:`partition_hosts`). Returns
+    a zero-arg restore function."""
+    endpoint._set_link_delay(dest, float(delay_s))
+
+    def restore():
+        endpoint._set_link_delay(dest, None)
+    return restore
+
+
+def kill_host(target) -> None:
+    """Abrupt host death — no drain frame, no goodbye. For a
+    ``subprocess.Popen`` (a replica_main child): SIGKILL. For an
+    in-process :class:`~raft_tpu.parallel.host_p2p.HostP2P` endpoint:
+    close without :meth:`announce_drain`, so peers get the peer-death
+    grace-timer verdict, not the typed clean ``PeerDrained`` — exactly
+    the distinction the fleet's typed accounting must preserve."""
+    if hasattr(target, "kill") and hasattr(target, "pid"):
+        target.kill()
+        return
+    target.close()
+
+
 # ----------------------------------------------------- serving injectors
 
 

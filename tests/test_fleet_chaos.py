@@ -159,28 +159,63 @@ def test_injected_batch_failure_retries_bit_identically(flat_index):
 
 
 # --------------------------------------------- breaker route-around
+class _FakeClock:
+    """Injectable clock for breaker/probe timing (the host_p2p test
+    pattern): timing *decisions* read this, so no amount of real CI
+    load can make a cooldown elapse early or a probe window slip."""
+
+    def __init__(self, t: float = 0.0):
+        self._t = t
+        self._lock = threading.Lock()
+
+    def advance(self, dt: float) -> None:
+        with self._lock:
+            self._t += dt
+
+    def __call__(self) -> float:
+        with self._lock:
+            return self._t
+
+
 def test_breaker_open_routed_around_then_readmitted(flat_index):
     """A breaker-open replica takes no regular traffic, but the router's
     rate-limited probes re-admit it once the half-open probe batch
-    closes the breaker."""
-    fleet = _fleet(flat_index, n=2, probe_interval_s=0.05,
-                   engine_kw={"breaker_cooldown_s": 0.2})
+    closes the breaker.
+
+    Deflaked (PR 16 note): the breaker cooldown and the router's probe
+    interval are huge in REAL time (60 s / 10 s) and driven entirely by
+    a fake clock — under parallel CI load nothing can flip early, and
+    re-admission happens exactly when the test advances time."""
+    fleet = _fleet(flat_index, n=2, probe_interval_s=10.0,
+                   engine_kw={"breaker_cooldown_s": 60.0})
     rng = np.random.default_rng(2)
     with fleet:
-        faults.trip_breaker(fleet, "replica0")
+        clk = _FakeClock()
         r0 = fleet.replicas[0].engine
+        # move ONLY the timing decisions onto the fake clock: the
+        # breaker's cooldown arithmetic and the router's probe
+        # rate-limit. Batching/dispatch keep the real clock (their
+        # waits must actually elapse).
+        r0.breaker.clock = clk
+        fleet.router.clock = clk
+        faults.trip_breaker(fleet, "replica0")
         assert r0.health()["status"] == "unhealthy"
         assert fleet.health()["status"] == "degraded"
         # traffic keeps flowing around the sick replica, typed retries
-        # absorbing any too-early probes (CircuitOpen -> sibling)
+        # absorbing any too-early probes (CircuitOpen -> sibling);
+        # fake time stands still, so the breaker CANNOT close here
         for _ in range(10):
             fleet.search(_q(rng), K, timeout=30)
-        # after the cooldown a probe goes half-open and closes it
-        deadline = time.monotonic() + 15
-        while (r0.health()["status"] != "ok"
-               and time.monotonic() < deadline):
+        assert r0.health()["status"] == "unhealthy", \
+            "breaker closed with no cooldown elapsed"
+        # advance past the cooldown: the next due probe goes half-open
+        # and its completion closes the breaker
+        clk.advance(61.0)
+        for _ in range(30):
             fleet.search(_q(rng), K, timeout=30)
-            time.sleep(0.02)
+            if r0.health()["status"] == "ok":
+                break
+            clk.advance(10.5)  # next probe window
         assert r0.health()["status"] == "ok", "probe never closed breaker"
         assert fleet.health()["status"] == "ok"
         routed_before = int(fleet.stats._routed["replica0"].value)
@@ -378,12 +413,17 @@ def test_typed_failure_hierarchy_and_retryability():
     for name in ("BatchFailed", "Overloaded", "CircuitOpen",
                  "DeadlineExceeded", "IntegrityError", "QueueFull",
                  "EngineStopped", "NoReplicaAvailable",
-                 "RetriesExhausted", "FleetBelowQuorum"):
+                 "RetriesExhausted", "FleetBelowQuorum",
+                 "ReplicaStarting"):
         assert name in serving.__all__, name
         assert hasattr(serving, name), name
     assert issubclass(serving.CircuitOpen, serving.Overloaded)
     assert issubclass(serving.NoReplicaAvailable, serving.Overloaded)
     assert issubclass(serving.RetriesExhausted, serving.Overloaded)
+    assert issubclass(serving.ReplicaStarting, serving.Overloaded)
+    assert serving.is_retryable(serving.ReplicaStarting("x"))
+    assert serving.failure_kind(
+        serving.ReplicaStarting("x")) == "replica_starting"
     assert serving.is_retryable(serving.BatchFailed("x"))
     assert serving.is_retryable(serving.Overloaded("x"))
     assert serving.is_retryable(serving.CircuitOpen("x"))

@@ -346,3 +346,251 @@ def test_fake_clock_drives_waitall_deadline():
         assert raised
     finally:
         ep.close()
+
+
+# ---------------------------------------------- correlation-id RPC surface
+
+def test_correlation_id_reserved_range_and_unique():
+    """correlation_id() allocates from [2**20, 2**30) — above any user
+    tag — and never repeats within a working set (the request/response
+    matching contract for serving.remote)."""
+    import raft_tpu.parallel.host_p2p as hp2p
+
+    ports = _ports(1)
+    ep = HostP2P(0, 1, peers=[("127.0.0.1", ports[0])], timeout=5)
+    try:
+        cids = [ep.correlation_id() for _ in range(4096)]
+        assert all(hp2p._CORR_BASE <= c < hp2p._CORR_LIMIT for c in cids)
+        assert len(set(cids)) == len(cids)
+    finally:
+        ep.close()
+
+
+def test_correlation_id_routes_reply(pair):
+    """The RPC shape: requester posts irecv on a fresh cid BEFORE the
+    send; responder echoes the cid as the reply tag; the reply matches
+    nothing else."""
+    a, b = pair
+    cid = a.correlation_id()
+    decoy = a.irecv(source=1, tag=a.correlation_id())  # different cid
+    reply = a.irecv(source=1, tag=cid)
+    b.isend(b"the-reply", dest=0, tag=cid).wait(30)
+    assert reply.wait(30) == b"the-reply"
+    assert not decoy.done()  # the reply matched only its own cid
+    decoy._cancelled = True
+
+
+def test_discard_drops_buffered_late_reply(pair):
+    """discard() is the abandon half of the RPC protocol: a late reply
+    sitting unclaimed in the inbox is dropped (returns the count), and a
+    fresh irecv on that cid does NOT see the stale payload."""
+    import time as _time
+
+    a, b = pair
+    cid = a.correlation_id()
+    b.isend(b"too-late", dest=0, tag=cid).wait(30)
+    # delivery to a's inbox is async; poll until discard claims it
+    deadline = _time.monotonic() + 10
+    dropped = 0
+    while _time.monotonic() < deadline:
+        dropped = a.discard(1, cid)
+        if dropped:
+            break
+        _time.sleep(0.01)
+    assert dropped == 1
+    r = a.irecv(source=1, tag=cid)
+    with pytest.raises(TimeoutError):
+        r.wait(0.2)  # the stale payload is gone, not re-matched
+
+
+# --------------------------------------------------- graceful drain frames
+
+def test_announce_drain_fails_pending_and_future_irecvs(pair):
+    """The drain control frame fails the peer's pending irecvs with the
+    typed PeerDrained — and new irecvs posted after the goodbye fail the
+    same way (the message can never arrive)."""
+    from raft_tpu.parallel.host_p2p import PeerDrained
+
+    a, b = pair
+    pending = b.irecv(source=0, tag=4)
+    a.announce_drain(1).wait(30)
+    with pytest.raises(PeerDrained):
+        pending.wait(30)
+    late = b.irecv(source=0, tag=5)
+    with pytest.raises(PeerDrained):
+        late.wait(30)
+
+
+def test_drain_cleared_by_new_delivery(pair):
+    """A delivery after the goodbye proves the peer came back: the
+    drained verdict clears and the stream works again (the rejoin path
+    serving.remote's re-admission rides)."""
+    import time as _time
+
+    from raft_tpu.parallel.host_p2p import PeerDrained
+
+    a, b = pair
+    a.announce_drain(1).wait(30)
+    with pytest.raises(PeerDrained):
+        b.irecv(source=0, tag=1).wait(30)
+    # the drained sender keeps sending — delivery voids the verdict
+    a.isend(b"back", dest=1, tag=9).wait(30)
+    deadline = _time.monotonic() + 10
+    got = None
+    while _time.monotonic() < deadline:
+        # inbox is matched before the drained verdict, so once the
+        # frame lands this irecv returns it (and delivery itself
+        # already cleared _drained for the NEXT irecv)
+        r = b.irecv(source=0, tag=9)
+        try:
+            got = r.wait(0.5)
+            break
+        except (PeerDrained, TimeoutError):
+            _time.sleep(0.01)
+    assert got == b"back"
+
+
+def test_drain_vs_kill_distinct_verdicts():
+    """The typed accounting the fleet depends on: a graceful goodbye is
+    a PROMPT typed PeerDrained; an abrupt death (kill_host — close with
+    NO drain frame, a clean EOF at a frame boundary) must never forge
+    one — the receiver keeps waiting its bounded timeout and the
+    higher layers (RPC deadlines, the grace timer for mid-frame cuts)
+    own the verdict. The two must stay distinguishable."""
+    from raft_tpu.parallel.host_p2p import PeerDrained
+    from raft_tpu.testing import faults
+
+    ports = _ports(4)
+    peers = [("127.0.0.1", p) for p in ports[:2]]
+    a = HostP2P(0, 2, peers=peers, timeout=30, peer_grace=0.3)
+    b = HostP2P(1, 2, peers=peers, timeout=30, peer_grace=0.3)
+    try:
+        # establish the a->b stream so the EOF is observed, then drain
+        a.isend(b"hi", dest=1).wait(30)
+        r = b.irecv(source=0, tag=2)
+        a.announce_drain(1).wait(30)
+        with pytest.raises(PeerDrained):
+            r.wait(30)
+    finally:
+        a.close()
+        b.close()
+    peers2 = [("127.0.0.1", p) for p in ports[2:]]
+    c = HostP2P(0, 2, peers=peers2, timeout=30, peer_grace=0.3)
+    d = HostP2P(1, 2, peers=peers2, timeout=30, peer_grace=0.3)
+    try:
+        c.isend(b"hi", dest=1).wait(30)
+        assert d.irecv(source=0).wait(30) == b"hi"
+        r = d.irecv(source=0, tag=2)
+        faults.kill_host(c)  # no goodbye: nothing typed may be forged
+        with pytest.raises(TimeoutError) as ei:
+            r.wait(1.0)  # bounded — and NOT PeerDrained
+        assert not isinstance(ei.value, (PeerDrained, ConnectionError))
+    finally:
+        c.close()
+        d.close()
+
+
+# ------------------------------------------------- mid-handshake peer death
+
+def test_peer_death_mid_handshake_fails_wait_typed(monkeypatch):
+    """ISSUE 18 satellite: a peer that dies DURING the TCP handshake (SYN
+    accepted, then RST before the connect completes) must fail the send's
+    wait() typed and bounded — the _handshake path had no fault-injection
+    twin (sever_connection only cuts established streams). The handshake
+    is forced to report ECONNRESET via SO_ERROR exactly where a real
+    mid-handshake RST surfaces."""
+    import errno
+
+    import raft_tpu.parallel.host_p2p as hp2p
+
+    monkeypatch.setattr(
+        socket.socket, "connect_ex",
+        lambda self, addr: errno.EINPROGRESS)
+    monkeypatch.setattr(
+        hp2p.HostP2P, "_wait_writable", lambda self, sock: True)
+    real_getsockopt = socket.socket.getsockopt
+
+    def dying_getsockopt(self, level, optname, *args):
+        if level == socket.SOL_SOCKET and optname == socket.SO_ERROR:
+            return errno.ECONNRESET
+        return real_getsockopt(self, level, optname, *args)
+
+    monkeypatch.setattr(socket.socket, "getsockopt", dying_getsockopt)
+    ports = _ports(2)
+    peers = [("127.0.0.1", p) for p in ports]
+    a = HostP2P(0, 2, peers=peers, timeout=30, retries=1,
+                retry_backoff=0.01, retry_backoff_max=0.02)
+    try:
+        req = a.isend(b"never-lands", dest=1)
+        with pytest.raises(OSError):
+            req.wait(10)  # typed and bounded, not a hang
+        # the failure poisons the stream like any exhausted-retries send
+        with pytest.raises(ConnectionError, match="poisoned"):
+            a.isend(b"after", dest=1).wait(10)
+    finally:
+        a.close()
+
+
+def test_peer_death_mid_handshake_fails_waitall_typed(monkeypatch):
+    """Same injected mid-handshake RST, via the batch path: waitall over
+    a mixed batch raises the send's typed OSError within one deadline."""
+    import errno
+
+    import raft_tpu.parallel.host_p2p as hp2p
+
+    monkeypatch.setattr(
+        socket.socket, "connect_ex",
+        lambda self, addr: errno.EINPROGRESS)
+    monkeypatch.setattr(
+        hp2p.HostP2P, "_wait_writable", lambda self, sock: True)
+    real_getsockopt = socket.socket.getsockopt
+
+    def dying_getsockopt(self, level, optname, *args):
+        if level == socket.SOL_SOCKET and optname == socket.SO_ERROR:
+            return errno.ECONNRESET
+        return real_getsockopt(self, level, optname, *args)
+
+    monkeypatch.setattr(socket.socket, "getsockopt", dying_getsockopt)
+    ports = _ports(2)
+    peers = [("127.0.0.1", p) for p in ports]
+    a = HostP2P(0, 2, peers=peers, timeout=30, retries=1,
+                retry_backoff=0.01, retry_backoff_max=0.02)
+    try:
+        reqs = [a.isend(b"x", dest=1), a.isend(b"y", dest=1)]
+        with pytest.raises(OSError):
+            HostP2P.waitall(reqs, timeout=10)
+    finally:
+        a.close()
+
+
+# ------------------------------------------------- partition / heal / reset
+
+def test_partition_refuses_typed_and_heal_restores(pair):
+    """faults.partition_hosts: outbound connects to a partitioned rank
+    fail typed (EHOSTUNREACH rides the cause chain into the poisoned
+    stream), and heal() + reset_stream carries traffic again — the
+    transport half of the fleet's re-admission story."""
+    import errno
+
+    from raft_tpu.testing import faults
+
+    a, b = pair
+    a.isend(b"pre", dest=1).wait(30)
+    assert b.irecv(source=0).wait(30) == b"pre"
+    heal = faults.partition_hosts(a, 1)  # one-sided: the split-brain cut
+    with pytest.raises(OSError) as ei:
+        a.isend(b"lost", dest=1).wait(30)
+    causes, seen = [], ei.value
+    while seen is not None:
+        causes.append(seen)
+        seen = seen.__cause__
+    assert any(getattr(c, "errno", None) == errno.EHOSTUNREACH
+               for c in causes), causes
+    # while partitioned the stream stays poisoned even after reset: the
+    # reconnect refuses again (reset_stream is not a bypass)
+    a.reset_stream(1)
+    with pytest.raises(OSError):
+        a.isend(b"still-lost", dest=1).wait(30)
+    heal()  # clears the partition AND the poison on both sides
+    a.isend(b"healed", dest=1, tag=8).wait(30)
+    assert b.irecv(source=0, tag=8).wait(30) == b"healed"

@@ -1,0 +1,779 @@
+"""Cross-host fleet tests: remote replicas over host_p2p, the closed
+autoscale loop, and partition/split-brain chaos (docs/serving.md
+"Remote fleet").
+
+The invariants pinned here are the ISSUE 18 acceptance criteria:
+
+- the wire codec round-trips headers + arrays bit-for-bit, and the
+  typed-error table reconstructs the SAME exception classes on the
+  proxy side (closed vocabulary, unknown kinds degrade to the typed
+  retryable ``BatchFailed`` — never untyped);
+- every transport failure classifies into the closed kind vocabulary
+  by isinstance over the exception CHAIN and maps into the fleet's
+  retryability table (refused → ``ReplicaStarting``, drained →
+  ``EngineStopped``, anything else → ``BatchFailed`` with the original
+  error on ``__cause__``);
+- a loopback RemoteReplica serves results bit-identical to its engine,
+  the rider's deadline rides the wire and is enforced remotely, health
+  piggybacks on every reply, and the replica's own metrics text comes
+  back through the ``scrape`` op (one-target aggregation);
+- under a network partition the router routes EVERY request to the
+  surviving sibling with zero untyped failures, the proxy's link
+  verdict — not the replica's self-report — takes the severed replica
+  out of quorum (split-brain authority rule), and the heal re-admits
+  it through the existing breaker-probe path;
+- the autoscaler's hysteresis law: scale-up only after a full
+  sustained window (or immediately on fast-burn), scale-down only
+  after the full cooldown, blocked decisions emit typed reasons, and
+  lifecycle counters reconcile 1:1 with ``kind="autoscale"`` spans;
+- a real ``replica_main`` child killed with SIGKILL mid-load yields
+  exact typed accounting: ``submitted == sum(outcomes)`` and one
+  ``kind="fleet"`` span per request (the CI faults-job smoke);
+- the partition/heal race windows hold across >= 100 amplified
+  interleave seeds (slow tier).
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import serving
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs.spans import ListSink
+from raft_tpu.parallel.host_p2p import HostP2P, PeerDrained
+from raft_tpu.serving import remote
+from raft_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+from raft_tpu.serving.engine import Engine, EngineConfig
+from raft_tpu.serving.remote import (RemoteReplica, classify_transport,
+                                     decode_error, decode_message,
+                                     encode_error, encode_message,
+                                     map_transport_error)
+from raft_tpu.serving.replica_main import _ReplicaServer, build_searcher
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.fast
+
+DIM = 8
+K = 5
+
+
+def _ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spec(seed=1, rows=256):
+    return {"family": "brute_force", "dim": DIM, "rows": rows,
+            "seed": seed}
+
+
+def _reconcile(fleet):
+    oc = fleet.stats.outcome_counts()
+    resolved = sum(v for k, v in oc.items() if k != "submitted")
+    assert oc["submitted"] == resolved, f"silent loss: {oc}"
+    return oc
+
+
+# ------------------------------------------------------------- wire codec
+def test_codec_roundtrip_header_and_arrays():
+    d = np.arange(10, dtype=np.float32).reshape(2, 5)
+    i = np.arange(10, dtype=np.int64).reshape(2, 5) * 7
+    hdr = {"op": "search", "k": 5, "cid": 1 << 21, "nested": {"a": 1}}
+    header, arrays = decode_message(encode_message(hdr, d, i))
+    assert header["op"] == "search" and header["k"] == 5
+    assert header["cid"] == 1 << 21 and header["nested"] == {"a": 1}
+    assert len(arrays) == 2
+    np.testing.assert_array_equal(arrays[0], d)
+    np.testing.assert_array_equal(arrays[1], i)
+    assert arrays[0].dtype == np.float32 and arrays[1].dtype == np.int64
+
+
+def test_codec_zero_arrays_and_empty_array():
+    header, arrays = decode_message(encode_message({"op": "health"}))
+    assert header["op"] == "health" and arrays == []
+    header, arrays = decode_message(
+        encode_message({"op": "x"}, np.empty((0, 4), np.float32)))
+    assert arrays[0].shape == (0, 4)
+
+
+def test_error_table_reconstructs_typed_classes():
+    """Closed error-kind table: the proxy resurrects the SAME typed
+    class the remote engine raised, so the router's retryability table
+    cannot tell a remote replica from a local one."""
+    cases = [
+        (serving.DeadlineExceeded("late"), serving.DeadlineExceeded),
+        (serving.QueueFull("full"), serving.QueueFull),
+        (serving.Overloaded("shed"), serving.Overloaded),
+        (serving.CircuitOpen("open"), serving.CircuitOpen),
+        (serving.EngineStopped("gone"), serving.EngineStopped),
+        (serving.BatchFailed("bad"), serving.BatchFailed),
+    ]
+    for exc, cls in cases:
+        out = decode_error(encode_error(exc))
+        assert type(out) is cls, (exc, out)
+    # unknown kinds degrade TYPED and retryable, never silently
+    out = decode_error({"error_kind": "???", "error_type": "Weird",
+                        "message": "m"})
+    assert isinstance(out, serving.BatchFailed)
+    assert serving.is_retryable(out)
+
+
+def test_classify_transport_closed_vocabulary():
+    """classify_transport works by isinstance over the __cause__ chain
+    (poisoned-stream wrappers carry the original error there), and
+    every verdict is in the closed kind vocabulary."""
+    import errno
+
+    refused = ConnectionRefusedError(111, "refused")
+    poisoned = ConnectionError("send stream poisoned")
+    poisoned.__cause__ = refused
+    unreach = OSError(errno.EHOSTUNREACH, "unreachable")
+    cases = [
+        (PeerDrained("bye"), "drained"),
+        (refused, "refused"),
+        (poisoned, "refused"),        # the chain, not the wrapper
+        (unreach, "refused"),         # partitioned: EHOSTUNREACH
+        (TimeoutError("no reply"), "reply_timeout"),
+        (ConnectionResetError("rst"), "eof"),
+        (OSError("generic"), "eof"),
+        (RuntimeError("?"), "other"),
+    ]
+    for exc, kind in cases:
+        assert classify_transport(exc) == kind, (exc, kind)
+        assert kind in remote.TRANSPORT_FAILURE_KINDS
+    # a cycle in the chain must not hang the walker
+    a, b = ConnectionError("a"), ConnectionError("b")
+    a.__cause__, b.__cause__ = b, a
+    assert classify_transport(a) == "eof"
+
+
+def test_map_transport_error_typed_and_chained():
+    """Transport failures map into the fleet's retryability table and
+    always chain the original error on __cause__."""
+    refused = ConnectionRefusedError(111, "refused")
+    out = map_transport_error(refused, "r1")
+    assert isinstance(out, serving.ReplicaStarting)
+    assert serving.is_retryable(out) and out.__cause__ is refused
+    drained = PeerDrained("bye")
+    out = map_transport_error(drained, "r1")
+    assert isinstance(out, serving.EngineStopped)
+    assert out.__cause__ is drained
+    eof = ConnectionResetError("rst")
+    out = map_transport_error(eof, "r1")
+    assert isinstance(out, serving.BatchFailed)
+    assert out.__cause__ is eof and serving.is_retryable(out)
+
+
+# ------------------------------------------------------ loopback RPC path
+@pytest.fixture()
+def loopback():
+    """One real engine behind a _ReplicaServer on rank 1, a RemoteReplica
+    proxy on rank 0 — the whole wire path in-process."""
+    p0, p1 = _ports(2)
+    peers = [("127.0.0.1", p0), ("127.0.0.1", p1)]
+    eng = Engine(build_searcher(_spec()),
+                 EngineConfig(max_batch=4, max_wait_us=1000)).start()
+    ep1 = HostP2P(rank=1, size=2, peers=peers, timeout=30,
+                  peer_grace=0.5)
+    server = _ReplicaServer(eng, ep1, frontend=0)
+    st = threading.Thread(target=server.run, daemon=True)
+    st.start()
+    ep0 = HostP2P(rank=0, size=2, peers=peers, timeout=30,
+                  peer_grace=0.5)
+    proxy = RemoteReplica(ep0, peer=1, dim=DIM, name="r1",
+                          rpc_timeout_s=10.0, rpc_slack_s=1.0).start()
+    yield eng, server, proxy, ep0, ep1
+    proxy.stop(drain=False)
+    server._stop.set()
+    eng.stop(drain=False)
+    ep0.close()
+    ep1.close()
+
+
+def test_loopback_search_bit_identical(loopback):
+    """A remote search returns EXACTLY what the engine behind it would
+    return locally — the proxy adds transport, not approximation."""
+    eng, server, proxy, *_ = loopback
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        q = rng.standard_normal(DIM).astype(np.float32)
+        d, i = proxy.submit(q, K, deadline_ms=5000).result(timeout=20)
+        d2, i2 = eng.submit(q, K).result(timeout=20)
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(d2))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(i2))
+
+
+def test_loopback_deadline_rides_the_wire(loopback):
+    """A microscopic deadline_ms must shed typed DeadlineExceeded — the
+    REMOTE engine enforces the remaining budget, exactly like a local
+    replica's shed path."""
+    _, _, proxy, *_ = loopback
+    q = np.zeros(DIM, np.float32)
+    with pytest.raises(serving.DeadlineExceeded):
+        proxy.submit(q, K, deadline_ms=0.01).result(timeout=20)
+
+
+def test_loopback_health_piggyback_and_scrape(loopback):
+    """Every reply piggybacks engine health (the proxy's cache is as
+    fresh as the last reply), and the scrape op returns the replica's
+    own Prometheus families — the one-target aggregation input."""
+    _, _, proxy, *_ = loopback
+    q = np.zeros(DIM, np.float32)
+    proxy.submit(q, K, deadline_ms=5000).result(timeout=20)
+    h = proxy.health()
+    assert h["link"] == "up" and h["replica"] == "r1"
+    assert h["status"] in ("ok", "degraded")
+    assert proxy.stats.queue_wait_p99_s() >= 0.0
+    text = proxy.scrape(timeout=10)
+    assert "raft_tpu_serving" in text
+
+
+def test_loopback_reset_samples_windows_remote_pressure(loopback):
+    """The reset_samples op re-baselines the REMOTE latency window over
+    the wire: afterwards the piggybacked windowed p99 (the autoscale
+    pressure numerator) reads 0.0 until new batches complete, while the
+    cumulative p99 keeps its history — the signal the load driver
+    needs so pressure can fall when offered load falls."""
+    _, _, proxy, *_ = loopback
+    rng = np.random.default_rng(3)
+    for _ in range(8):
+        proxy.submit(rng.standard_normal(DIM).astype(np.float32),
+                     K, deadline_ms=5000).result(timeout=20)
+    assert proxy.stats.queue_wait_p99_s() > 0.0
+    assert proxy.stats.queue_wait_p99_window_s() > 0.0
+    assert proxy.reset_samples(timeout=10) is True
+    # any reply refreshes the piggyback; scrape is a synchronous RPC
+    proxy.scrape(timeout=10)
+    assert proxy.stats.queue_wait_p99_window_s() == 0.0
+    assert proxy.stats.queue_wait_p99_s() > 0.0
+    # the view's delegate reaches the same wire path
+    proxy.stats.reset_samples()
+
+
+def test_loopback_graceful_stop_maps_to_engine_stopped(loopback):
+    """After a stop RPC the replica announces a drain frame; the
+    proxy's in-flight and later requests fail typed EngineStopped (the
+    drained mapping), never untyped."""
+    eng, server, proxy, *_ = loopback
+    q = np.zeros(DIM, np.float32)
+    proxy.submit(q, K, deadline_ms=5000).result(timeout=20)
+    proxy.stop(drain=True)
+    with pytest.raises(serving.EngineStopped):
+        proxy.submit(q, K)
+
+
+def test_fleet_scrape_appends_p2p_families():
+    """Satellite: the 8 per-peer host_p2p counters live on the global
+    registry; a fleet scraping a PRIVATE registry still surfaces them
+    on its one /metrics target (extra_text append)."""
+    sink = ListSink()
+    reg = obs_metrics.Registry()
+    cfg = serving.FleetConfig(quorum=1, span_sink=sink, registry=reg)
+    fleet = serving.Fleet.from_searchers(
+        [build_searcher(_spec())],
+        engine_config=serving.EngineConfig(max_batch=4, max_wait_us=1000),
+        config=cfg)
+    with fleet:
+        fleet.submit(np.zeros(DIM, np.float32), K).result(timeout=20)
+        ms = fleet.serve_metrics(port=0)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{ms.port}/metrics", timeout=10
+        ).read().decode()
+    assert "raft_tpu_fleet_requests_total" in body   # private registry
+    assert "raft_tpu_p2p_messages_sent_total" in body  # global, appended
+    # only the p2p families are appended — not the global serving ones
+    # (those would double-count against the private registry's copies)
+    assert body.count("# TYPE raft_tpu_fleet_requests_total") == 1
+
+
+def test_fleet_metrics_routes_remote_replica_scrape(loopback):
+    """Satellite: the remote replica's OWN Prometheus families (they
+    live in the replica process's registry) are reachable through the
+    fleet's single server at /metrics/replica/<name> — a scrape-op
+    passthrough, not an inline merge (merging would duplicate family
+    declarations). Unknown names and local replicas 404."""
+    eng_r, server, proxy, *_ = loopback
+    eng_l = Engine(build_searcher(_spec()),
+                   EngineConfig(max_batch=4, max_wait_us=1000))
+    fleet = serving.Fleet(
+        [eng_l, proxy], names=["local0", "r1"],
+        config=serving.FleetConfig(quorum=1,
+                                   registry=obs_metrics.Registry()))
+    try:
+        fleet.start()
+        fleet.submit(np.zeros(DIM, np.float32), K).result(timeout=20)
+        ms = fleet.serve_metrics(port=0)
+        url = f"http://127.0.0.1:{ms.port}"
+        body = urllib.request.urlopen(
+            f"{url}/metrics/replica/r1", timeout=10).read().decode()
+        assert "raft_tpu_serving_requests_total" in body
+        for bad in ("/metrics/replica/ghost", "/metrics/replica/local0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{url}{bad}", timeout=10)
+            assert ei.value.code == 404
+    finally:
+        fleet.stop(drain=False)
+
+
+# ------------------------------------------- partition / split-brain chaos
+def test_partition_split_brain_and_heal_readmission():
+    """The tentpole chaos invariant: partition the remote replica —
+    every request resolves ok via the sibling (zero untyped), the
+    PROXY's link verdict (not the replica's healthy self-report) takes
+    it out of quorum, and the heal re-admits it through the router's
+    breaker-probe path."""
+    p0, p1 = _ports(2)
+    peers = [("127.0.0.1", p0), ("127.0.0.1", p1)]
+    eng_r = Engine(build_searcher(_spec()),
+                   EngineConfig(max_batch=4, max_wait_us=1000)).start()
+    ep1 = HostP2P(rank=1, size=2, peers=peers, timeout=30,
+                  peer_grace=0.5)
+    server = _ReplicaServer(eng_r, ep1, frontend=0)
+    threading.Thread(target=server.run, daemon=True).start()
+    ep0 = HostP2P(rank=0, size=2, peers=peers, timeout=30,
+                  peer_grace=0.5)
+    proxy = RemoteReplica(ep0, peer=1, dim=DIM, name="remote1",
+                          rpc_timeout_s=3.0, rpc_slack_s=0.5)
+    eng_l = Engine(build_searcher(_spec()),
+                   EngineConfig(max_batch=4, max_wait_us=1000))
+    sink = ListSink()
+    fleet = serving.Fleet(
+        [eng_l, proxy], names=["local0", "remote1"],
+        config=serving.FleetConfig(quorum=1, span_sink=sink,
+                                   probe_interval_s=0.2))
+    rng = np.random.default_rng(0)
+    qs = [rng.standard_normal(DIM).astype(np.float32)
+          for _ in range(20)]
+    try:
+        fleet.start()
+        for q in qs[:5]:
+            fleet.submit(q, K).result(timeout=20)
+        # ---- sever: one-sided cut, the replica itself stays healthy —
+        # the split-brain shape (its self-report says ok; the router
+        # must believe the proxy's link verdict instead)
+        heal = faults.partition_hosts(ep0, 1)
+        futs = [fleet.submit(q, K) for q in qs]
+        for f in futs:
+            assert f.exception(timeout=20) is None, f.exception()
+        # the proxy notices on the first failed RPC; drive until it has
+        deadline = time.monotonic() + 10
+        while (proxy.health()["link"] == "up"
+               and time.monotonic() < deadline):
+            fleet.submit(qs[0], K).result(timeout=20)
+            time.sleep(0.05)
+        h = proxy.health()
+        assert h["status"] == "unhealthy" and h["breaker"] == "open"
+        assert h["link"] == "down" and h["running"]
+        # split-brain authority: the severed-but-alive replica is OUT
+        # of quorum even though its own engine reports healthy
+        assert eng_r.health()["status"] == "ok"
+        assert fleet.healthy_count() == 1
+        _reconcile(fleet)
+        # ---- heal: the probe path re-admits over the healed link
+        heal()
+        deadline = time.monotonic() + 20
+        readmitted = False
+        while time.monotonic() < deadline:
+            for q in qs[:4]:
+                fleet.submit(q, K).result(timeout=20)
+            if proxy.health()["link"] == "up":
+                readmitted = True
+                break
+            time.sleep(0.1)
+        assert readmitted, "healed link never re-admitted"
+        assert fleet.healthy_count() == 2
+        oc = _reconcile(fleet)
+        assert oc["submitted"] == len(sink.by_kind("fleet"))
+    finally:
+        fleet.stop(drain=False)
+        server._stop.set()
+        eng_r.stop(drain=False)
+        ep0.close()
+        ep1.close()
+
+
+# --------------------------------------------------- autoscaler control law
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+class _StubStats:
+    def __init__(self):
+        self.p99 = 0.0
+
+    def queue_wait_p99_s(self):
+        return self.p99
+
+
+class _StubEngine:
+    """Engine-shaped stub with a settable queue-wait p99 — drives the
+    pressure signal without real load."""
+
+    def __init__(self, dim=DIM):
+        import types
+        self.searcher = types.SimpleNamespace(dim=dim, coverage=1.0)
+        self.batcher = []
+        self.stats = _StubStats()
+        self.autoscale_budget_ms = 50.0
+        self._started = True
+
+    def start(self):
+        self._started = True
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        self._started = False
+
+    def drain(self, timeout=None):
+        return True
+
+    def health(self):
+        return {"status": "ok" if self._started else "unhealthy",
+                "running": self._started, "breaker": "closed",
+                "shedding": False, "queue_depth": 0, "coverage": 1.0,
+                "n_batch_errors": 0, "n_hangs": 0}
+
+
+def _autoscaled_fleet(clk, sink, max_replicas=3):
+    fleet = serving.Fleet([_StubEngine()], names=["seed0"],
+                          config=serving.FleetConfig(quorum=1),
+                          clock=clk)
+    fleet._started = True  # membership surface only; no batcher threads
+    asc = Autoscaler(
+        fleet, spawn=_StubEngine,
+        config=AutoscalerConfig(min_replicas=1, max_replicas=max_replicas,
+                                high_watermark=0.8, low_watermark=0.2,
+                                up_window_s=5.0, down_window_s=30.0,
+                                span_sink=sink),
+        clock=clk)
+    return fleet, asc
+
+
+def _pressure(fleet, p99):
+    for r in fleet.replicas:
+        r.engine.stats.p99 = p99
+
+
+def test_autoscaler_hysteresis_law():
+    """The full law, single-stepped on a fake clock: scale-up only
+    after the sustained window; one decision per window (re-arm);
+    blocked at max with a typed reason; scale-down only after the FULL
+    cooldown; counters reconcile 1:1 with spans."""
+    clk, sink = _FakeClock(), ListSink()
+    fleet, asc = _autoscaled_fleet(clk, sink)
+    _pressure(fleet, 0.060)  # 60ms p99 / 50ms budget = 1.2
+    asc.tick()
+    clk.advance(2.0)
+    asc.tick()
+    assert len(fleet.replicas) == 1  # 2s sustained < 5s window
+    clk.advance(3.5)
+    asc.tick()
+    assert len(fleet.replicas) == 2  # 5.5s sustained: spawn
+    assert sink.by_kind("autoscale")[-1]["reason"] == "scale_up_pressure"
+    # the window re-armed: one decision per window, never per tick
+    _pressure(fleet, 0.060)
+    asc.tick()
+    clk.advance(5.5)
+    asc.tick()
+    assert len(fleet.replicas) == 3
+    # at max: the decision is emitted, typed, not silently skipped
+    _pressure(fleet, 0.060)
+    asc.tick()
+    clk.advance(6.0)
+    asc.tick()
+    assert len(fleet.replicas) == 3
+    assert (sink.by_kind("autoscale")[-1]["reason"]
+            == "blocked_max_replicas")
+    # idle: 10s below the low watermark is NOT enough (30s cooldown)
+    _pressure(fleet, 0.001)
+    asc.tick()
+    clk.advance(10.0)
+    asc.tick()
+    assert len(fleet.replicas) == 3, "retired before cooldown"
+    clk.advance(25.0)
+    asc.tick()
+    assert len(fleet.replicas) == 2  # 35s below: retire ONE (LIFO)
+    last = sink.by_kind("autoscale")[-1]
+    assert last["reason"] == "scale_down_idle"
+    assert last["replica"].startswith("scale")
+    # lifecycle counters and spans reconcile 1:1
+    lc = {ev: fleet.stats._lifecycle[ev].value
+          for ev in ("added", "removed", "spawned", "retired",
+                     "spawn_failed")}
+    spans = sink.by_kind("autoscale")
+    spawned = sum(1 for s in spans
+                  if s["reason"].startswith("scale_up") and "replica" in s)
+    retired = sum(1 for s in spans if s["reason"] == "scale_down_idle")
+    assert lc["spawned"] == spawned and lc["retired"] == retired
+    assert lc["added"] == lc["spawned"] and lc["removed"] == lc["retired"]
+    assert lc["spawn_failed"] == 0
+
+
+def test_autoscaler_fast_burn_scales_immediately():
+    """An SLO fast-burn excursion skips the sustained window (burn is
+    already a windowed signal) and stamps the slo/burn on the span."""
+    clk, sink = _FakeClock(), ListSink()
+    fleet, asc = _autoscaled_fleet(clk, sink)
+    _pressure(fleet, 0.060)
+    asc.on_fast_burn("availability", 20.0)
+    asc.tick()  # no window wait
+    assert len(fleet.replicas) == 2
+    span = sink.by_kind("autoscale")[-1]
+    assert span["reason"] == "scale_up_fast_burn"
+    assert span["slo"] == "availability" and span["burn"] == 20.0
+
+
+def test_autoscaler_spawn_failure_is_typed_decision():
+    """A raising spawn() is a spawn_failed decision + lifecycle count,
+    never an escaped exception out of the control loop."""
+    clk, sink = _FakeClock(), ListSink()
+    fleet, asc = _autoscaled_fleet(clk, sink)
+
+    def bad_spawn():
+        raise RuntimeError("container pull failed")
+
+    asc.spawn = bad_spawn
+    _pressure(fleet, 0.060)
+    asc.tick()
+    clk.advance(5.5)
+    asc.tick()  # must not raise
+    assert len(fleet.replicas) == 1
+    span = sink.by_kind("autoscale")[-1]
+    assert span["reason"] == "spawn_failed"
+    assert "container pull failed" in span["error"]
+    assert fleet.stats._lifecycle["spawn_failed"].value == 1
+
+
+def test_autoscaler_scale_down_blocked_by_quorum():
+    """remove_replica's quorum refusal surfaces as a typed
+    blocked_quorum decision — the autoscaler never forces a fleet
+    below quorum."""
+    clk, sink = _FakeClock(), ListSink()
+    fleet = serving.Fleet([_StubEngine(), _StubEngine()],
+                          names=["seed0", "scale1"],
+                          config=serving.FleetConfig(quorum=2),
+                          clock=clk)
+    fleet._started = True
+    asc = Autoscaler(
+        fleet, spawn=_StubEngine,
+        config=AutoscalerConfig(min_replicas=1, max_replicas=3,
+                                down_window_s=30.0, span_sink=sink),
+        clock=clk)
+    _pressure(fleet, 0.001)
+    asc.tick()
+    clk.advance(31.0)
+    asc.tick()
+    assert len(fleet.replicas) == 2  # refused, membership intact
+    assert sink.by_kind("autoscale")[-1]["reason"] == "blocked_quorum"
+
+
+def test_fleet_add_remove_replica_lifecycle_counters():
+    """The membership surface itself: add starts + registers, remove
+    drains through the quorum check, and the lifecycle counter family
+    records each transition."""
+    fleet = serving.Fleet([_StubEngine()], names=["seed0"],
+                          config=serving.FleetConfig(quorum=1))
+    fleet._started = True
+    rep = fleet.add_replica(_StubEngine(), name="scale1")
+    assert rep.name == "scale1" and len(fleet.replicas) == 2
+    with pytest.raises(ValueError):
+        fleet.add_replica(_StubEngine(), name="scale1")  # dup name
+    eng = fleet.remove_replica("scale1", drain=True)
+    assert len(fleet.replicas) == 1 and not eng._started
+    with pytest.raises(serving.FleetBelowQuorum):
+        fleet.remove_replica("seed0")
+    with pytest.raises(KeyError):
+        fleet.remove_replica("ghost")
+    lc = fleet.stats._lifecycle
+    assert lc["added"].value == 1 and lc["removed"].value == 1
+
+
+# ------------------------------------------------ two-process kill -9 smoke
+def test_two_process_kill9_exact_typed_accounting(tmp_path):
+    """The CI faults-job smoke: spawn one real replica_main child,
+    SIGKILL it mid-load, and demand EXACT typed accounting — every
+    future resolves, submitted == sum(outcomes), one fleet span per
+    request. Gated fast (<60s): brute-force searcher, 256 rows."""
+    import random
+
+    base = random.randint(42000, 55000)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "raft_tpu.serving.replica_main",
+         "--rank", "1", "--size", "2", "--base-port", str(base),
+         "--family", "brute_force", "--dim", str(DIM), "--rows", "256",
+         "--seed", "1", "--max-batch", "4", "--max-wait-us", "1000",
+         "--peer-grace", "0.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    fleet = ep0 = None
+    try:
+        ready = False
+        for line in child.stdout:
+            if line.startswith("REPLICA_READY"):
+                ready = True
+                break
+        assert ready, "child never printed REPLICA_READY"
+        ep0 = HostP2P(rank=0, size=2, base_port=base, timeout=30,
+                      peer_grace=0.5)
+        proxy = RemoteReplica(ep0, peer=1, dim=DIM, name="remote1",
+                              rpc_timeout_s=5.0, rpc_slack_s=0.5)
+        local = Engine(build_searcher(_spec()),
+                       EngineConfig(max_batch=4, max_wait_us=1000))
+        sink = ListSink()
+        fleet = serving.Fleet(
+            [local, proxy], names=["local0", "remote1"],
+            config=serving.FleetConfig(quorum=1, span_sink=sink,
+                                       probe_interval_s=0.5))
+        fleet.start()
+        rng = np.random.default_rng(0)
+        qs = [rng.standard_normal(DIM).astype(np.float32)
+              for _ in range(40)]
+        for q in qs[:5]:
+            fleet.submit(q, K).result(timeout=30)  # real cross-process
+        futs = []
+        for n, q in enumerate(qs):
+            futs.append(fleet.submit(q, K))
+            if n == 10:
+                os.kill(child.pid, signal.SIGKILL)
+        for f in futs:
+            exc = f.exception(timeout=30)  # resolves — ok or TYPED
+            if exc is not None:
+                assert isinstance(
+                    exc, (serving.BatchFailed, serving.Overloaded,
+                          serving.EngineStopped,
+                          serving.DeadlineExceeded)), exc
+        oc = _reconcile(fleet)
+        assert oc["submitted"] == 45
+        assert len(sink.by_kind("fleet")) == oc["submitted"]
+    finally:
+        if fleet is not None:
+            fleet.stop(drain=False)
+        if ep0 is not None:
+            ep0.close()
+        try:
+            child.kill()
+        except OSError:
+            pass
+        child.wait(timeout=10)
+
+
+# ------------------------------------- amplified interleavings (slow tier)
+class _StubIndex:
+    pass
+
+
+def _stub_searcher(dim=DIM):
+    def search(queries, k):
+        q = np.asarray(queries, np.float32)
+        base = q.sum(axis=1, keepdims=True)
+        d = base + np.arange(k, dtype=np.float32)[None, :]
+        i = (np.abs(q).sum(axis=1, keepdims=True).astype(np.int64)
+             + np.arange(k, dtype=np.int64)[None, :])
+        return d.astype(np.float32), i
+
+    return serving.Searcher(family="stub", dim=dim, index=_StubIndex(),
+                            search=search)
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_partition_chaos_amplified():
+    """Partition/heal racing live traffic across >= 100 amplified
+    interleave seeds (stub searchers: a seed costs milliseconds of
+    device time, the TCP round-trips dominate). At every seed: every
+    future resolves typed, the accounting reconciles exactly, and the
+    severed replica is out of the healthy count while cut."""
+    from raft_tpu.testing.interleave import InterleaveAmplifier, seeds
+
+    for seed in seeds(100):
+        p0, p1 = _ports(2)
+        peers = [("127.0.0.1", p0), ("127.0.0.1", p1)]
+        ecfg = EngineConfig(max_batch=4, max_wait_us=200,
+                            hang_timeout_s=None, persistent_cache=False,
+                            flight_recorder=False)
+        eng_r = Engine(_stub_searcher(), ecfg).start()
+        ep1 = HostP2P(rank=1, size=2, peers=peers, timeout=10,
+                      peer_grace=0.3)
+        server = _ReplicaServer(eng_r, ep1, frontend=0)
+        threading.Thread(target=server.run, daemon=True).start()
+        ep0 = HostP2P(rank=0, size=2, peers=peers, timeout=10,
+                      peer_grace=0.3)
+        proxy = RemoteReplica(ep0, peer=1, dim=DIM, name="remote1",
+                              rpc_timeout_s=2.0, rpc_slack_s=0.3)
+        eng_l = Engine(_stub_searcher(), ecfg)
+        fleet = serving.Fleet(
+            [eng_l, proxy], names=["local0", "remote1"],
+            config=serving.FleetConfig(quorum=1, seed=seed,
+                                       retry_limit=4,
+                                       backoff_base_ms=0.2,
+                                       backoff_cap_ms=2.0,
+                                       probe_interval_s=0.01))
+        futs = []
+        lock = threading.Lock()
+
+        def submitter(ti, fleet=fleet, futs=futs, lock=lock):
+            trng = np.random.default_rng(1000 + ti)
+            for _ in range(10):
+                q = trng.standard_normal(DIM).astype(np.float32)
+                try:
+                    f = fleet.submit(q, K)
+                except serving.EngineStopped:
+                    return
+                with lock:
+                    futs.append(f)
+
+        def chaos(ep0=ep0):
+            heal = faults.partition_hosts(ep0, 1)
+            time.sleep(0.02)
+            heal()
+
+        with InterleaveAmplifier(seed=seed, yield_probability=0.05,
+                                 path_filters=("raft_tpu/serving",)):
+            fleet.start()
+            threads = [threading.Thread(target=submitter, args=(ti,))
+                       for ti in range(2)]
+            threads.append(threading.Thread(target=chaos))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for f in futs:
+                exc = f.exception(timeout=30)
+                if exc is not None:
+                    assert isinstance(
+                        exc, (serving.Overloaded, serving.BatchFailed,
+                              serving.EngineStopped,
+                              serving.DeadlineExceeded)), (seed, exc)
+            fleet.stop(drain=False)
+        oc = fleet.stats.outcome_counts()
+        resolved = sum(v for k, v in oc.items() if k != "submitted")
+        assert oc["submitted"] == resolved, (seed, oc)
+        assert oc["submitted"] == len(futs), (seed, oc)
+        server._stop.set()
+        eng_r.stop(drain=False)
+        ep0.close()
+        ep1.close()

@@ -471,6 +471,232 @@ def bench_fleet(searcher, cfg_kwargs, queries, k, capacity_qps,
     return row, fleet_completed
 
 
+def bench_remote_fleet(dim, k, base_port=None, chaos_n=40, kill_at=10,
+                       up_window_s=0.6, down_window_s=2.5):
+    """Remote-fleet arm (docs/serving.md "Remote fleet"): one local
+    replica plus one real ``replica_main`` child process over loopback
+    ``host_p2p``, with the :class:`~raft_tpu.serving.autoscaler.
+    Autoscaler` as a live actuator. Three contracts, each the remote
+    stack's reason to exist:
+
+    - **stepped load curve** — a sustained overload step (slowed local
+      searcher + bursts) must grow the fleet within ~one ``up_window_s``
+      of hysteresis, attributed by a ``kind="autoscale"`` span with
+      reason ``scale_up_pressure``; going quiet must shrink it again
+      ONLY after the full ``down_window_s`` cooldown
+      (``scale_down_idle``), and the ``spawned``/``retired`` lifecycle
+      counters must reconcile 1:1 with those spans. The windows are
+      scoped by ``reset_samples()`` on every replica — the remote one
+      re-baselines over the wire (the ``reset_samples`` op), which is
+      what lets pressure FALL when offered load falls;
+    - **kill -9 chaos** — SIGKILL of the child mid-load yields ZERO
+      untyped failures: every future resolves served or to a typed
+      failure from the closed transport table, and
+      ``submitted == sum(outcomes)`` exactly;
+    - **span accounting** — one ``kind="fleet"`` span per request under
+      a unique trace id, ok spans == ok counter, across ALL phases
+      including the partition.
+
+    Self-contained: builds its own deterministic index (the same
+    ``replica_main.build_searcher`` spec on both sides, so siblings are
+    bit-identical) and reconciles against its own span sink.
+    """
+    import random as _random
+    import signal
+    import subprocess
+    import sys
+
+    from raft_tpu import serving
+    from raft_tpu.obs import spans as obs_spans
+    from raft_tpu.parallel.host_p2p import HostP2P
+    from raft_tpu.serving.replica_main import build_searcher
+    from raft_tpu.testing import faults
+
+    spec = {"family": "brute_force", "dim": dim, "rows": 1024, "seed": 0}
+    engine_cfg = serving.EngineConfig(
+        max_batch=16, max_wait_us=500, deadline_budget_ms=20.0,
+        warm_ks=(k,))
+    base_port = base_port or _random.randint(42000, 55000)
+    sink = obs_spans.ListSink()
+
+    child = subprocess.Popen(
+        [sys.executable, "-m", "raft_tpu.serving.replica_main",
+         "--rank", "1", "--size", "2", "--base-port", str(base_port),
+         "--family", spec["family"], "--dim", str(dim),
+         "--rows", str(spec["rows"]), "--seed", str(spec["seed"]),
+         "--max-batch", "16", "--max-wait-us", "2000",
+         "--peer-grace", "1.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    t0 = time.perf_counter()
+    ready = False
+    for line in child.stdout:
+        if "REPLICA_READY" in line:
+            ready = True
+            break
+        if time.perf_counter() - t0 > 90:
+            break
+    if not ready:
+        child.kill()
+        raise AssertionError("replica child never became ready")
+
+    ep0 = HostP2P(rank=0, size=2, base_port=base_port, peer_grace=1.0)
+    proxy = serving.RemoteReplica(ep0, peer=1, dim=dim, name="remote1",
+                                  rpc_timeout_s=10.0, rpc_slack_s=1.0)
+    local = serving.Engine(build_searcher(spec), engine_cfg)
+    fleet = serving.Fleet(
+        [local, proxy], names=["local0", "remote1"],
+        config=serving.FleetConfig(quorum=1, probe_interval_s=0.25,
+                                   span_sink=sink))
+    futs = []
+    row = {}
+    try:
+        fleet.start()
+
+        # ---- warm: cross-process traffic + sibling bit-identity
+        rng = np.random.default_rng(7)
+        warm_q = rng.standard_normal(dim).astype(np.float32)
+        d0, i0 = proxy.submit(warm_q, k, deadline_ms=10_000).result(60)
+        d1, i1 = local.submit(warm_q, k, deadline_ms=10_000).result(60)
+        assert np.array_equal(np.asarray(i0), np.asarray(i1)) and \
+            np.allclose(np.asarray(d0), np.asarray(d1)), (
+                "remote and local siblings disagree on the same query — "
+                "the shared build spec did not produce identical indexes")
+        for _ in range(10):
+            futs.append(fleet.submit(
+                rng.standard_normal(dim).astype(np.float32), k,
+                deadline_ms=10_000))
+
+        # ---- stepped load curve under a live autoscaler
+        asc = serving.Autoscaler(
+            fleet,
+            spawn=lambda: serving.Engine(build_searcher(spec),
+                                         engine_cfg),
+            config=serving.AutoscalerConfig(
+                min_replicas=2, max_replicas=3, high_watermark=0.8,
+                low_watermark=0.2, up_window_s=up_window_s,
+                down_window_s=down_window_s, tick_s=0.05,
+                span_sink=sink))
+        for r in fleet.replicas:
+            r.engine.stats.reset_samples()
+        asc.start()
+        t_high = time.perf_counter()
+        with faults.slow_searcher(local.searcher, 0.012):
+            while len(fleet.replicas) < 3:
+                for _ in range(24):
+                    futs.append(fleet.submit(
+                        rng.standard_normal(dim).astype(np.float32), k))
+                time.sleep(0.02)
+                assert time.perf_counter() - t_high < 30, (
+                    "sustained overload never triggered a scale-up")
+        rise_s = time.perf_counter() - t_high
+        assert rise_s <= up_window_s + 15.0, (
+            f"scale-up took {rise_s:.2f}s — not within one hysteresis "
+            f"window of the load step (window {up_window_s}s)")
+        typed = (serving.Overloaded, serving.QueueFull,
+                 serving.BatchFailed, serving.EngineStopped,
+                 serving.DeadlineExceeded, serving.IntegrityError)
+        for f in futs:  # drain the high step; typed sheds recount below
+            try:
+                f.result(timeout=120)
+            except typed:
+                pass
+        # quiesce, then re-baseline EVERY window — remote over the wire
+        for r in fleet.replicas:
+            r.engine.stats.reset_samples()
+        proxy.scrape(timeout=10)  # fresh piggyback carries window=0
+        t_low = time.perf_counter()
+        while len(fleet.replicas) > 2:  # silence: pressure reads 0.0
+            time.sleep(0.05)
+            assert time.perf_counter() - t_low < down_window_s + 20, (
+                "idle fleet never scaled back down")
+        fall_s = time.perf_counter() - t_low
+        asc.stop()
+        assert fall_s >= down_window_s, (
+            f"scale-down after {fall_s:.2f}s — inside the "
+            f"{down_window_s}s cooldown, hysteresis violated")
+        ascs = sink.by_kind("autoscale")
+        reasons = {}
+        for rec in ascs:
+            reasons[rec["reason"]] = reasons.get(rec["reason"], 0) + 1
+        assert reasons.get("scale_up_pressure", 0) == 1, reasons
+        assert reasons.get("scale_down_idle", 0) == 1, reasons
+        assert reasons.get("spawn_failed", 0) == 0, reasons
+        lc = {ev: fleet.stats._lifecycle[ev].value
+              for ev in ("spawned", "retired", "spawn_failed")}
+        assert lc["spawned"] == reasons["scale_up_pressure"], (lc, reasons)
+        assert lc["retired"] == reasons["scale_down_idle"], (lc, reasons)
+        assert lc["spawn_failed"] == 0, lc
+
+        # ---- kill -9 the child mid-load: typed or served, nothing else
+        n_before_chaos = len(futs)
+        served = untyped = 0
+        shed = {}
+        for i in range(chaos_n):
+            if i == kill_at:
+                os.kill(child.pid, signal.SIGKILL)
+            futs.append(fleet.submit(
+                rng.standard_normal(dim).astype(np.float32), k,
+                deadline_ms=2000))
+            time.sleep(0.01)
+        for f in futs:
+            try:
+                f.result(timeout=120)
+                served += 1
+            except typed as e:
+                kind = serving.failure_kind(e)
+                shed[kind] = shed.get(kind, 0) + 1
+            except BaseException:
+                untyped += 1
+        assert untyped == 0, (
+            f"{untyped} requests resolved UNTYPED after kill -9 — the "
+            "closed transport table leaked")
+        n_total = len(futs)
+        assert served + sum(shed.values()) == n_total
+
+        # ---- exact counter + span reconciliation across all phases
+        counts = fleet.stats.outcome_counts()
+        resolved = sum(v for ev, v in counts.items() if ev != "submitted")
+        assert counts["submitted"] == n_total == resolved, (
+            f"counters do not reconcile: {counts} vs {n_total} futures")
+        assert counts["ok"] == served, (counts, served)
+        fspans = sink.by_kind("fleet")
+        traces = {rec["trace_id"] for rec in fspans}
+        ok_spans = sum(1 for rec in fspans if rec["outcome"] == "ok")
+        assert len(fspans) == n_total == len(traces), (
+            f"fleet spans do not reconcile 1:1: {len(fspans)} spans / "
+            f"{len(traces)} trace ids for {n_total} requests")
+        assert ok_spans == served, (ok_spans, served)
+
+        row = {
+            "n": n_total,
+            "served": served,
+            "shed": shed,
+            "untyped": untyped,
+            "chaos": {"kill": "SIGKILL", "at": n_before_chaos + kill_at,
+                      "arrivals_after": chaos_n},
+            "autoscale": {
+                "rise_s": round(rise_s, 3),
+                "up_window_s": up_window_s,
+                "fall_s": round(fall_s, 3),
+                "down_window_s": down_window_s,
+                "reasons": reasons,
+                "lifecycle": lc,
+            },
+            "outcomes": counts,
+            "spans": {"records": len(fspans), "trace_ids": len(traces),
+                      "ok": ok_spans},
+        }
+    finally:
+        try:
+            fleet.stop(drain=False)
+        finally:
+            ep0.close()
+            child.kill()
+            child.wait(timeout=30)
+    return row
+
+
 def make_planner(family, k, db, queries, artifact_path, recall_floor,
                  res):
     """AdaptivePlanner for the adaptive-overload arm: the committed
@@ -699,6 +925,14 @@ def main():
                     help="fleet arm arrivals per phase (warm-up, after "
                          "each kill, tail); the swap phase is paced by "
                          "the swap itself")
+    ap.add_argument("--no-remote-fleet", action="store_true",
+                    help="skip the two-process remote-fleet arm "
+                         "(replica_main child over loopback host_p2p: "
+                         "autoscaler stepped-curve tracking + kill -9 "
+                         "typed accounting)")
+    ap.add_argument("--remote-fleet-port", type=int, default=0,
+                    help="base port for the remote-fleet arm's host_p2p "
+                         "pair (0 picks a random high port)")
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the per-request bit-identity sweep")
     ap.add_argument("--spans", default=None,
@@ -974,6 +1208,21 @@ def main():
                       f"{len(traces)} trace ids, {ok_spans} ok — "
                       f"reconciled", flush=True)
             row["fleet"] = fl
+
+        if fi == 0 and not args.no_remote_fleet:
+            rf = bench_remote_fleet(
+                args.dim, args.k,
+                base_port=args.remote_fleet_port or None)
+            a = rf["autoscale"]
+            print(f"  remote fleet: n={rf['n']}, served={rf['served']}, "
+                  f"shed={rf['shed']}, untyped={rf['untyped']}; "
+                  f"autoscale rise {a['rise_s']}s (window "
+                  f"{a['up_window_s']}s), fall {a['fall_s']}s (cooldown "
+                  f"{a['down_window_s']}s), reasons={a['reasons']}; "
+                  f"spans {rf['spans']['records']} records / "
+                  f"{rf['spans']['trace_ids']} trace ids — reconciled",
+                  flush=True)
+            row["remote_fleet"] = rf
 
         if spans_sink is not None:
             # consume the span file back: the ok spans must reconcile
