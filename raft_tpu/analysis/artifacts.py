@@ -69,7 +69,8 @@ def artifact_kind(name: str) -> str:
     for prefix, kind in (("PALLAS_PROBE_", "pallas_probe"),
                          ("SELECT_K_TABLE_", "select_k_table"),
                          ("TOPK_PAD_", "topk_pad"),
-                         ("PARETO_", "pareto")):
+                         ("PARETO_", "pareto"),
+                         ("TIERED_MANIFEST_", "tiered_manifest")):
         if name.startswith(prefix):
             return kind
     return "json"
@@ -102,6 +103,15 @@ def _check_pareto(art: dict, path: str) -> None:
     load_frontier(path)
 
 
+def _check_tiered_manifest(art: dict, path: str) -> None:
+    # the exact front half of tiered.load_tiered: schema + geometry +
+    # per-file crc32/header agreement, so a committed manifest that
+    # load_tiered would refuse (or silently mis-read) fails CI here
+    from raft_tpu.neighbors.tiered import validate_manifest
+    validate_manifest(art, base_dir=os.path.dirname(os.path.abspath(path)),
+                      check_files=True)
+
+
 def _check_baseline(art: dict, path: str) -> None:
     from raft_tpu.analysis.findings import load_baseline
     entries = load_baseline(path)
@@ -116,6 +126,7 @@ _CHECKERS: Dict[str, Callable[[dict, str], None]] = {
     "topk_pad": _check_topk_pad,
     "pareto": _check_pareto,
     "baseline": _check_baseline,
+    "tiered_manifest": _check_tiered_manifest,
 }
 
 

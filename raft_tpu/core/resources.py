@@ -292,6 +292,58 @@ def solve_merge_bytes(size: int, nq: int, kk: int, k_out: int,
     return out
 
 
+def solve_host_tier(n_lists: int, list_pad: int, rot_dim: int,
+                    n_code_bytes: int, workspace_limit_bytes: int,
+                    n_probes: int = 20, max_batch: int = 64,
+                    cache_itemsize: int = 2, arena_fraction: float = 0.5,
+                    host_bw_bytes_per_s: float = 8e9) -> dict:
+    """Byte/bandwidth model for the HBM-as-cache tier
+    (neighbors/tiered.py): size the device slab arena from the
+    workspace budget and predict the host-tier footprint and per-slab
+    fetch cost. The C001 calibration audit (obs/costs.py) and the
+    tiered smoke test pin these predictions against measured bytes.
+
+    Per-slot device cost (one decoded list slab):
+
+        slab_bytes = list_pad · (rot_dim·cache_itemsize + 4 + 4) + 4
+
+    (decoded residuals + f32 norms + i32 ids, plus the i32 size) — the
+    exact ``nbytes`` sum of the arena's four arrays. ``arena_fraction``
+    of the workspace budget goes to slots, floored at ``n_probes`` (one
+    query's probes must be co-resident) and capped at ``n_lists``
+    (beyond that the tier degenerates to the resident cache engine).
+
+    Host-side truth: packed codes + ids + norms per list, plus the
+    sizes vector. The fetch model is per-slab payload over an assumed
+    pinned-host→HBM bandwidth (DMA-dominated; the measured stall
+    histogram ``raft_tpu_tier_fetch_stall_seconds`` is its check).
+
+    ``worst_batch_distinct`` is the sizing constraint a caller must
+    respect: one batch can probe up to ``max_batch · n_probes``
+    distinct lists, and the arena must hold them simultaneously or the
+    resolve raises ``TieredArenaError``.
+    """
+    n_lists = max(int(n_lists), 1)
+    list_pad = max(int(list_pad), 1)
+    slab_bytes = list_pad * (rot_dim * cache_itemsize + 4 + 4) + 4
+    arena_budget = int(max(workspace_limit_bytes, 0) * arena_fraction)
+    floor_slots = min(n_lists, max(int(n_probes), 1))
+    arena_slots = int(np.clip(arena_budget // max(slab_bytes, 1),
+                              floor_slots, n_lists))
+    host_bytes_per_list = list_pad * (n_code_bytes + 4 + 4)
+    fetch_bytes = list_pad * (n_code_bytes + 4 + 4) + 4
+    worst = min(n_lists, int(max_batch) * max(int(n_probes), 1))
+    return {
+        "arena_slots": arena_slots,
+        "slab_bytes": slab_bytes,
+        "arena_bytes": arena_slots * slab_bytes,
+        "host_bytes": n_lists * host_bytes_per_list + 4 * n_lists,
+        "fetch_bytes_per_slab": fetch_bytes,
+        "predicted_fetch_s": fetch_bytes / max(host_bw_bytes_per_s, 1.0),
+        "worst_batch_distinct": worst,
+    }
+
+
 _default_resources: Optional[Resources] = None
 _default_lock = threading.Lock()
 

@@ -14,8 +14,9 @@ from raft_tpu.neighbors import (
     quantize,
     rbc,
     refine,
+    tiered,
 )
 
 __all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
            "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "quantize",
-           "rbc", "refine"]
+           "rbc", "refine", "tiered"]

@@ -280,6 +280,65 @@ def make_sharded_merge_core(mode: str, nq: int = 1024, kk: int = 100,
     return core, args, meta
 
 
+def make_tiered_scan_core(budget_bytes: int):
+    """``(core, args, meta)`` factory for the tiered arena scan
+    (neighbors/tiered.py ``tiered_scan_core``) at the sift-1M crash
+    shape with the arena sized by ``core.resources.solve_host_tier`` —
+    wiring the host-tier byte model into the C001 calibration audit.
+    The scan's workspace model is the cache engine's (the gathered
+    ``[q_tile, P, pad, rot]`` live set is identical; only the gather
+    source shrinks from ``n_lists`` to ``arena_slots``), so the same
+    ``cache_bytes_per_query`` prediction must hold — drift outside the
+    gate means the tiered mirror diverged from the resident core."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.analysis.jaxpr_audit import Sift1MCrashShape
+    from raft_tpu.core.resources import solve_host_tier
+    from raft_tpu.neighbors import ivf_pq, tiered
+    from raft_tpu.ops.distance import DistanceType
+
+    s = Sift1MCrashShape()
+    q_tile = ivf_pq.plan_cache_tiles(s.n_probes, s.list_pad, s.rot_dim,
+                                     budget_bytes)
+    plan = solve_host_tier(s.n_lists, s.list_pad, s.rot_dim,
+                           s.pq_dim * s.pq_bits // 8, budget_bytes,
+                           n_probes=s.n_probes)
+    slots = plan["arena_slots"]
+    meta = {"family": "tiered_ivf_pq", "planner": "solve_host_tier",
+            "predicted_bytes": q_tile * ivf_pq.cache_bytes_per_query(
+                s.n_probes, s.list_pad, s.rot_dim),
+            "tiles": {"q_tile": q_tile, "arena_slots": slots,
+                      "slab_bytes": plan["slab_bytes"],
+                      "arena_bytes": plan["arena_bytes"]}}
+
+    def core(queries, centers, rotation, arena_dec, arena_norms,
+             arena_ids, arena_sizes, cluster_probes, slot_probes):
+        return tiered.tiered_scan_core(
+            queries, centers, rotation, arena_dec, arena_norms,
+            arena_ids, arena_sizes, cluster_probes, slot_probes,
+            metric=DistanceType.L2Expanded, k=s.k, n_probes=s.n_probes,
+            q_tile=q_tile, overflow_decoded=jnp.zeros((0, s.rot_dim),
+                                                      jnp.float32),
+            overflow_norms=jnp.zeros((0,), jnp.float32),
+            overflow_indices=jnp.zeros((0,), jnp.int32),
+            has_overflow=False)
+
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((s.nq, s.dim), np.float32),
+        sds((s.n_lists, s.dim), np.float32),
+        sds((s.rot_dim, s.dim), np.float32),
+        sds((slots, s.list_pad, s.rot_dim), jax.numpy.bfloat16),
+        sds((slots, s.list_pad), np.float32),
+        sds((slots, s.list_pad), np.int32),
+        sds((slots,), np.int32),
+        sds((s.nq, s.n_probes), np.int32),
+        sds((s.nq, s.n_probes), np.int32))
+    return core, args, meta
+
+
 def sharded_merge_entries(nq: int = 1024, kk: int = 100, k: int = 100
                           ) -> list:
     """``(name, make_core)`` pairs for the three merge engines at sift-1M
@@ -326,6 +385,7 @@ def default_cost_entries(budget_bytes: Optional[int] = None) -> list:
     out = [
         *ja.canonical_cores(b),
         ("cagra.search@1m", lambda: ja.make_cagra_core(b)),
+        ("tiered_ivf_pq.scan@1m", lambda: make_tiered_scan_core(b)),
     ]
     nd = jax.device_count()
     if nd >= 2 and (nd & (nd - 1)) == 0:

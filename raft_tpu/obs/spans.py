@@ -31,7 +31,9 @@ from typing import Iterator, List, Optional
 from raft_tpu.obs import metrics as _metrics
 
 __all__ = [
+    "current_trace",
     "new_trace_id",
+    "trace_scope",
     "JsonlSink",
     "ListSink",
     "NullSink",
@@ -50,6 +52,30 @@ def new_trace_id() -> str:
     """64-bit random hex id (Dapper-style width; 16 chars). os.urandom is
     one syscall — microseconds, fine at serving request rates."""
     return os.urandom(8).hex()
+
+
+_CURRENT = threading.local()
+
+
+def current_trace() -> Optional[str]:
+    """The trace id of the work this thread is currently executing, or
+    None. Set by the serving engine around the device call so deep
+    emitters (the tiered arena's ``tier_fetch`` spans) can tag their
+    records with the requesting trace without plumbing an argument
+    through every search signature."""
+    return getattr(_CURRENT, "trace", None)
+
+
+@contextlib.contextmanager
+def trace_scope(trace_id: Optional[str]) -> Iterator[None]:
+    """Bind :func:`current_trace` for the dynamic extent of a block
+    (re-entrant: restores the previous binding on exit)."""
+    prev = getattr(_CURRENT, "trace", None)
+    _CURRENT.trace = trace_id
+    try:
+        yield
+    finally:
+        _CURRENT.trace = prev
 
 
 class NullSink:

@@ -36,7 +36,8 @@ from raft_tpu.planner import adaptive
 __all__ = ["FAMILIES", "default_grid", "exact_oracle", "sweep_family",
            "build_artifact"]
 
-FAMILIES = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+FAMILIES = ("brute_force", "ivf_flat", "ivf_pq", "cagra",
+            "tiered_ivf_pq")
 
 
 def default_grid(family: str, mini: bool = False) -> List[Dict[str, object]]:
@@ -49,7 +50,11 @@ def default_grid(family: str, mini: bool = False) -> List[Dict[str, object]]:
         if not mini:
             grid.append({"select_recall": 0.9})
         return grid
-    if family in ("ivf_flat", "ivf_pq"):
+    if family in ("ivf_flat", "ivf_pq", "tiered_ivf_pq"):
+        # tiered shares ivf_pq's knob: n_probes trades recall for scan
+        # work AND arena churn (more probes -> more distinct lists per
+        # batch -> lower hit rate at fixed slots), so the measured
+        # frontier already prices the tier's fetch stalls
         probes = (4, 32) if mini else (4, 8, 16, 32, 64)
         return [{"n_probes": int(p)} for p in probes]
     if family == "cagra":
@@ -115,6 +120,21 @@ def _build_searcher(family: str, db: np.ndarray, res,
             graph_degree=32, intermediate_graph_degree=64), res=res)
         searcher = serving.cagra_searcher(index, res=res)
         shape = {"graph_degree": 32}
+    elif family == "tiered_ivf_pq":
+        # same index as ivf_pq, lists demoted to host RAM. The arena
+        # holds every list (a smaller one could refuse a single batch
+        # probing more distinct lists than it has slots): the sweep
+        # prices the steady-state HIT path — the slot-indirected scan
+        # the planner's operating point actually serves — while arena
+        # churn under pressure is serving_bench's tiered arm.
+        from raft_tpu.neighbors import tiered
+        index = ivf_pq.build(
+            db, ivf_pq.IndexParams(n_lists=n_lists, pq_dim=32), res=res)
+        t = tiered.TieredIvfPq.from_index(
+            index, res=res, arena_slots=n_lists, namespace="sweep")
+        searcher = serving.tiered_ivf_pq_searcher(t, res=res)
+        shape = {"n_lists": n_lists, "pq_dim": 32,
+                 "arena_slots": t.arena.slots}
     else:
         raise ValueError(f"unknown family {family!r}")
     shape.update({"rows": int(db.shape[0]), "dim": int(db.shape[1])})
@@ -154,7 +174,9 @@ def _roofline_min_ms(family: str, params: Dict[str, object], shape: dict,
             int(shape.get("n_lists", 1)), 1)
         scanned_rows, row_bytes = min(frac, 1.0) * rows, dim * 4
         flops = 2.0 * bucket * scanned_rows * dim
-    elif family == "ivf_pq":
+    elif family in ("ivf_pq", "tiered_ivf_pq"):
+        # the tiered hit path scans decoded slabs through the same
+        # cache-core math, so the ivf_pq roofline is its floor too
         frac = int(params.get("n_probes", 20)) / max(
             int(shape.get("n_lists", 1)), 1)
         scanned_rows = min(frac, 1.0) * rows

@@ -84,7 +84,8 @@ from raft_tpu.serving.router import (FleetBelowQuorum, NoReplicaAvailable,
 from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
                                         cagra_searcher, elastic_searcher,
                                         ivf_flat_searcher,
-                                        ivf_pq_searcher, make_searcher)
+                                        ivf_pq_searcher, make_searcher,
+                                        tiered_ivf_pq_searcher)
 from raft_tpu.serving.stats import ServingStats, percentiles
 
 __all__ = [
@@ -127,5 +128,6 @@ __all__ = [
     "make_searcher",
     "percentiles",
     "solo_reference",
+    "tiered_ivf_pq_searcher",
     "verify_bit_identity",
 ]

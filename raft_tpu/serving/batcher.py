@@ -209,6 +209,28 @@ class Batcher:
             return ready
         return None
 
+    def peek(self) -> Optional[List[Request]]:
+        """Non-consuming view of the batch the flush policy is forming:
+        the head-k group :meth:`select` would launch, *including* before
+        the flush condition fires (the whole point — a prefetcher wants
+        the batch while it is still coalescing, so host→device staging
+        overlaps the previous batch's device time).
+
+        Strictly read-only: expired requests are filtered from the view
+        but stay queued — pruning into ``_expired`` remains
+        :meth:`select`'s job on the consuming path, so deadline
+        accounting is identical whether or not anyone peeks. The view
+        is advisory (a race with ``take`` may launch a different
+        batch); callers must treat it as a hint, never as ownership.
+        """
+        with self._lock:
+            now = self.clock()
+            live = [r for r in self._queue if not r.expired(now)]
+            if not live:
+                return None
+            head = live[0]
+            return [r for r in live if r.k == head.k][:self.max_batch]
+
     def locked(self):
         """Context manager over the internal lock (test hook)."""
         return self._lock

@@ -1104,7 +1104,10 @@ class Engine:
                 # every family search record their decisions into the
                 # open capture; briefs ride batch meta into every
                 # rider's span record
-                with obs_explain.capture() as cap:
+                # the batch's lead trace id is visible to deep emitters
+                # (tiered arena fetch spans) for the device call's extent
+                with obs_spans.trace_scope(live[0].trace_id), \
+                        obs_explain.capture() as cap:
                     choice = self._choose_operating_point(
                         searcher, live, t_launch)
                     if choice is not None:

@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = ["Searcher", "make_searcher", "brute_force_searcher",
            "ivf_flat_searcher", "ivf_pq_searcher", "cagra_searcher",
-           "elastic_searcher"]
+           "elastic_searcher", "tiered_ivf_pq_searcher"]
 
 
 @dataclasses.dataclass
@@ -173,12 +173,40 @@ def elastic_searcher(index, params=None, res=None) -> Searcher:
     return Searcher(family, dim, index, search)
 
 
+def tiered_ivf_pq_searcher(index, params=None, res=None) -> Searcher:
+    """Serving handle over a ``TieredIvfPq`` (neighbors/tiered.py).
+
+    The index object's host-tier arrays live inside non-array
+    attributes (``tier``, ``arena``), so :meth:`Searcher.place`'s
+    device upload sweep copies only the coarse structures — demoting
+    the lists to host RAM survives engine placement by construction.
+    """
+    from raft_tpu.neighbors import ivf_pq, tiered
+
+    if not isinstance(index, tiered.TieredIvfPq):
+        raise TypeError(f"tiered_ivf_pq_searcher wants TieredIvfPq, got "
+                        f"{type(index).__name__}")
+    params = params or ivf_pq.SearchParams()
+
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        p = dataclasses.replace(params, **overrides) if overrides \
+            else params
+        return index.search(queries, k, p, res=res)
+
+    def search(queries: np.ndarray, k: int):
+        return index.search(queries, k, params, res=res)
+
+    return Searcher("tiered_ivf_pq", int(index.dim), index, search,
+                    search_with=search_with)
+
+
 _FACTORIES = {
     "brute_force": brute_force_searcher,
     "ivf_flat": ivf_flat_searcher,
     "ivf_pq": ivf_pq_searcher,
     "cagra": cagra_searcher,
     "elastic": elastic_searcher,
+    "tiered_ivf_pq": tiered_ivf_pq_searcher,
 }
 
 

@@ -57,7 +57,8 @@ DEFAULT_TOLERANCE = 0.05
 
 #: metric-name suffix/token → direction. Longest match wins; tokens are
 #: matched against '.'-and-'_'-split pieces of the metric name.
-_HIGHER = ("qps", "recall", "rows_per_s", "throughput", "hypervolume")
+_HIGHER = ("qps", "recall", "rows_per_s", "throughput", "hypervolume",
+           "hit_rate")
 _LOWER = ("latency_ms", "latency_ms_b1", "latency_ms_b10", "mean_ms",
           "p50_ms", "p99_ms", "build_s", "build_warm_s", "warm_s",
           "wall_s", "fit_s", "chained_ms")

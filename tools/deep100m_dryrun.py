@@ -125,23 +125,67 @@ def run_stage(args, art: dict) -> None:
         synth_fbin(data, rows, args.dim)
     t["synth_s"] = round(time.time() - t0, 1)
 
-    comms = init_comms(jax.devices(), axis="data")
     params = ivf_pq.IndexParams(n_lists=n_lists, pq_dim=args.pq_dim,
                                 kmeans_n_iters=10)
-    t0 = time.time()
-    index = sharded.build_ivf_pq_from_file_pod(
-        comms, data, params, max_train_rows=max_train, scan_mode="lut",
-        batch_rows=args.batch_rows)
-    t["build_s"] = round(time.time() - t0, 1)
-    print(f"pod build: {t['build_s']}s bounds={list(index.bounds)}",
-          flush=True)
+    tier_row = None
+    if args.tier == "host":
+        # single-host streamed build, lists demoted to host RAM; the
+        # search runs through the slab arena in query chunks sized so a
+        # chunk's distinct probed lists always fit the arena
+        from raft_tpu.neighbors import ooc, tiered
+        from raft_tpu.utils.shape import query_bucket
 
-    queries = synth_queries(data, args.nq)
-    t0 = time.time()
-    v, i = sharded.search_ivf_pq(
-        index, queries, args.k, ivf_pq.SearchParams(n_probes=args.nprobe))
-    i = np.asarray(i)
-    t["search_s"] = round(time.time() - t0, 1)
+        t0 = time.time()
+        base = ooc.build_ivf_pq_from_file(
+            data, params=params, batch_rows=args.batch_rows,
+            max_train_rows=max_train)
+        chunk = 64
+        worst = min(n_lists, query_bucket(chunk) * args.nprobe)
+        ti = tiered.TieredIvfPq.from_index(base, arena_slots=worst,
+                                           namespace="dryrun")
+        t["build_s"] = round(time.time() - t0, 1)
+        print(f"host-tier build: {t['build_s']}s "
+              f"host={ti.tier.nbytes / (1 << 30):.2f}GB "
+              f"arena={ti.arena.nbytes / (1 << 30):.3f}GB "
+              f"({ti.arena.slots}/{n_lists} slots)", flush=True)
+
+        queries = synth_queries(data, args.nq)
+        sp = ivf_pq.SearchParams(n_probes=args.nprobe)
+        t0 = time.time()
+        parts = [ti.search(queries[s:s + chunk], args.k, sp)
+                 for s in range(0, len(queries), chunk)]
+        i = np.concatenate([np.asarray(p[1]) for p in parts])
+        t["search_s"] = round(time.time() - t0, 1)
+        counts = ti.arena.snapshot_counts()
+        demand = counts["hits"] + counts["misses"]
+        tier_row = {
+            "arena_slots": ti.arena.slots,
+            "arena_bytes": ti.arena.nbytes,
+            "host_bytes": ti.tier.nbytes,
+            "counts": counts,
+            "hit_rate": (round(counts["hits"] / demand, 4)
+                         if demand else None),
+        }
+        print(f"tier counters: {counts}", flush=True)
+        n_devices = 1
+    else:
+        comms = init_comms(jax.devices(), axis="data")
+        t0 = time.time()
+        index = sharded.build_ivf_pq_from_file_pod(
+            comms, data, params, max_train_rows=max_train,
+            scan_mode="lut", batch_rows=args.batch_rows)
+        t["build_s"] = round(time.time() - t0, 1)
+        print(f"pod build: {t['build_s']}s bounds={list(index.bounds)}",
+              flush=True)
+
+        queries = synth_queries(data, args.nq)
+        t0 = time.time()
+        v, i = sharded.search_ivf_pq(
+            index, queries, args.k,
+            ivf_pq.SearchParams(n_probes=args.nprobe))
+        i = np.asarray(i)
+        t["search_s"] = round(time.time() - t0, 1)
+        n_devices = comms.size
 
     t0 = time.time()
     _, gt = chunked_ground_truth(data, queries, args.k,
@@ -155,8 +199,10 @@ def run_stage(args, art: dict) -> None:
         "n_lists": n_lists, "pq_dim": args.pq_dim, "nq": args.nq,
         "k": args.k, "n_probes": args.nprobe, "recall": round(recall, 4),
         "timings_s": t, "peak_rss_gb": round(rss_gb, 2),
-        "n_devices": comms.size, "data": data,
+        "n_devices": n_devices, "tier": args.tier, "data": data,
     }
+    if tier_row is not None:
+        art["stage"]["host_tier"] = tier_row
     print(f"stage={args.stage} recall@{args.k}={recall:.4f} "
           f"peak_rss={rss_gb:.2f}GB timings={t}", flush=True)
 
@@ -204,6 +250,12 @@ def main():
     ap.add_argument("--nprobe", type=int, default=100)
     ap.add_argument("--batch-rows", type=int, default=1 << 18)
     ap.add_argument("--gt-batch-rows", type=int, default=1 << 16)
+    ap.add_argument("--tier", choices=("hbm", "host"), default="hbm",
+                    help="staged-run storage tier: 'hbm' is the pod "
+                         "build (all lists device-resident); 'host' "
+                         "demotes the lists to host RAM and serves "
+                         "through TieredIvfPq's slab arena, recording "
+                         "hit/miss/eviction counters in the artifact")
     args = ap.parse_args()
 
     os.environ.setdefault("XLA_FLAGS",

@@ -885,6 +885,129 @@ def bench_shadow_recall(searcher, cfg_kwargs, queries, k, submitters,
     }
 
 
+def bench_tiered(db, queries, k, res, rng, pressures=(2.0, 8.0),
+                 n_requests=200, n_lists=256, n_probes=4, max_batch=8):
+    """HBM-as-cache arm: the same index served through ``TieredIvfPq``
+    at 2x and 8x arena pressure (``n_lists / arena_slots``), a full
+    Engine with the batcher-driven :class:`~raft_tpu.neighbors.tiered.
+    TierPrefetcher` attached, and the deadline/shed policy engaged.
+
+    What the row gates:
+
+    - **exact typed accounting** — every arrival is served or a typed
+      shed (``bench_overload``'s assertion), no untyped failures;
+    - **tier_hit_rate** (higher-better bench_gate token) — demand hits
+      over demand resolutions, straight off the arena counters, which
+      must themselves reconcile exactly (hits + misses + prefetch_hits
+      + prefetch_fetches == resolved);
+    - **fetch_stall_p50_ms / _p99_ms** (lower-better ``_ms`` tokens) —
+      host→device copy stalls measured from the arena's own
+      ``tier_fetch`` spans, demand path only (prefetch stalls overlap
+      device time by design and are reported separately).
+
+    The per-batch distinct-list bound ``query_bucket(max_batch) *
+    n_probes`` sizes the deepest arena so the arm can never trip
+    ``TieredArenaError`` — that ceiling is printed, not silent.
+    """
+    from raft_tpu import serving
+    from raft_tpu.neighbors import ivf_pq, tiered
+    from raft_tpu.obs import spans as obs_spans
+    from raft_tpu.serving.stats import percentiles
+    from raft_tpu.utils.shape import query_bucket
+
+    t0 = time.perf_counter()
+    index = ivf_pq.build(db, ivf_pq.IndexParams(n_lists=n_lists, pq_dim=32),
+                         res=res)
+    build_s = round(time.perf_counter() - t0, 2)
+    params = ivf_pq.SearchParams(n_probes=n_probes)
+    # a bucketed batch resolves at most this many distinct lists; every
+    # arena below must hold one full batch or the demand path raises
+    distinct_bound = min(n_lists, query_bucket(max_batch) * n_probes)
+    out = {"build_s": build_s, "n_lists": n_lists, "n_probes": n_probes,
+           "max_batch": max_batch, "distinct_bound": distinct_bound,
+           "runs": []}
+    extra = {}
+    for pressure in pressures:
+        slots = max(int(round(n_lists / pressure)), distinct_bound)
+        if slots * pressure != n_lists:
+            print(f"  tiered: pressure {pressure}x floored to "
+                  f"{n_lists / slots:.1f}x by the per-batch distinct "
+                  f"bound ({distinct_bound} lists)", flush=True)
+        sink = obs_spans.ListSink()
+        arena = tiered.SlabArena(
+            slots, int(index.list_codes.shape[1]), index.rot_dim,
+            label=f"bench{pressure:g}x", span_sink=sink)
+        t = tiered.TieredIvfPq.from_index(index, res=res, arena=arena,
+                                          namespace=f"bench{pressure:g}x")
+        searcher = serving.tiered_ivf_pq_searcher(t, params, res=res)
+        engine = serving.Engine(searcher, serving.EngineConfig(
+            max_batch=max_batch, max_wait_us=2000, max_inflight=2,
+            warm_ks=(k,), queue_limit=max(4 * max_batch, 64),
+            queue_high_watermark=max_batch))
+        engine.start()
+        pf = tiered.attach_prefetcher(engine, t, params=params)
+        try:
+            base = arena.snapshot_counts()
+            closed, _, _, _ = bench_closed_loop(engine, queries, k, 4)
+            cap_qps = closed["qps"]
+            over = bench_overload(engine, queries, k, 2.0 * cap_qps,
+                                  n_requests, rng, deadline_ms=2000.0)
+        finally:
+            pf.close()
+            engine.stop()
+        counts = arena.snapshot_counts()
+        phase = {key: counts[key] - base.get(key, 0)
+                 for key in counts if key != "occupancy"}
+        # the reconciliation the interleave suite pins, re-checked live:
+        # a bench row with unaccounted resolutions is a finding, not data
+        assert (phase["hits"] + phase["misses"] + phase["prefetch_hits"]
+                + phase["prefetch_fetches"] == phase["resolved"]), phase
+        demand = phase["hits"] + phase["misses"]
+        hit_rate = phase["hits"] / demand if demand else None
+        stalls_ms = {
+            path: sorted(float(s["stall_s"]) * 1e3 for s in sink.records
+                         if s.get("kind") == "tier_fetch"
+                         and s.get("path") == path)
+            for path in ("demand", "prefetch")}
+        demand_pcts = percentiles(stalls_ms["demand"]) \
+            if stalls_ms["demand"] else {}
+        row = {
+            "pressure": round(n_lists / slots, 2),
+            "arena_slots": slots,
+            "arena_bytes": arena.nbytes,
+            "closed_loop_qps": cap_qps,
+            "overload": over,
+            "counts": phase,
+            "occupancy": counts["occupancy"],
+            "tier_hit_rate": round(hit_rate, 4) if hit_rate is not None
+            else None,
+            "demand_fetches": len(stalls_ms["demand"]),
+            "prefetch_fetches_spanned": len(stalls_ms["prefetch"]),
+            "prefetcher": {"passes": pf.n_passes, "capped": pf.n_capped,
+                           "errors": pf.n_errors},
+        }
+        if demand_pcts:
+            row["fetch_stall_p50_ms"] = round(demand_pcts["p50"], 3)
+            row["fetch_stall_p99_ms"] = round(demand_pcts["p99"], 3)
+        if pf.n_capped:
+            print(f"  tiered: prefetch depth cap engaged {pf.n_capped} "
+                  f"times — staged coverage was partial", flush=True)
+        out["runs"].append(row)
+        fam = f"tiered_{pressure:g}x"
+        extra[fam] = {"goodput_qps": over["goodput_qps"]}
+        if hit_rate is not None:
+            extra[fam]["tier_hit_rate"] = round(hit_rate, 4)
+        for key in ("fetch_stall_p50_ms", "fetch_stall_p99_ms"):
+            if key in row:
+                extra[fam][key] = row[key]
+        print(f"  tiered @{row['pressure']}x pressure: "
+              f"hit_rate={row['tier_hit_rate']}, "
+              f"stall p99={row.get('fetch_stall_p99_ms')} ms, "
+              f"shed_rate={over['shed_rate']}, "
+              f"prefetch useful={phase['useful_prefetch']}", flush=True)
+    return out, extra
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None,
@@ -966,6 +1089,13 @@ def main():
     ap.add_argument("--recall-floor", type=float, default=0.9,
                     help="adaptive arm: degradation never picks a point "
                          "below this recall")
+    ap.add_argument("--tiered-pressures", type=float, nargs="*",
+                    default=[2.0, 8.0],
+                    help="HBM-as-cache arm arena pressures (n_lists / "
+                         "arena_slots); empty disables the arm")
+    ap.add_argument("--tiered-queries", type=int, default=200,
+                    help="tiered arm overload-phase arrivals per "
+                         "pressure level")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -1282,6 +1412,17 @@ def main():
                 f"QPS (rerun with --overhead-reps higher on a noisy "
                 f"machine, or --no-overhead-check to skip the gate)")
         art["families"][family] = row
+
+    if args.tiered_pressures:
+        print("=== tiered (HBM-as-cache)", flush=True)
+        tiered_row, tiered_extra = bench_tiered(
+            db, queries, args.k, res, rng,
+            pressures=tuple(args.tiered_pressures),
+            n_requests=args.tiered_queries)
+        art["tiered"] = tiered_row
+        # bench_gate.flatten_metrics reads ``extra`` as {family: fields},
+        # so the hit-rate / stall tokens gate direction-aware
+        art["extra"] = tiered_extra
 
     if spans_sink is not None:
         spans_sink.close()
