@@ -100,7 +100,8 @@ __all__ = [
 #: request-path packages scanned by Tier F (joined under the scan root).
 FLOW_SCAN_DIRS = ("raft_tpu/serving", "raft_tpu/obs")
 #: single request-path modules outside those packages.
-FLOW_SCAN_FILES = ("raft_tpu/parallel/host_p2p.py",)
+FLOW_SCAN_FILES = ("raft_tpu/parallel/host_p2p.py",
+                   "raft_tpu/neighbors/mutable.py")
 
 #: F001 whitelist: programmer errors on argument validation only.
 PROGRAMMER_ERRORS = frozenset({"TypeError", "ValueError", "AssertionError"})

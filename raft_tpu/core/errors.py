@@ -21,8 +21,13 @@ class IntegrityError(RaftError):
 
     ``path`` names the file, ``record`` the 0-based framed record inside it
     (None when the fault is file-level), and ``reason`` is one of
-    ``"missing"``, ``"truncated"``, ``"corrupt"`` so callers (degraded-mode
-    restore, pre-flight verification) can branch without parsing messages.
+    ``"missing"``, ``"truncated"``, ``"corrupt"``, ``"torn_tail"`` so
+    callers (degraded-mode restore, pre-flight verification, WAL recovery)
+    can branch without parsing messages. ``"torn_tail"`` is specific to
+    append-only logs (neighbors/mutable.py): the LAST frame is damaged and
+    nothing follows it — a crash mid-append, recoverable by truncation
+    with only never-acknowledged bytes lost — where the same damage
+    mid-file would be ``"corrupt"``.
     """
 
     def __init__(self, message: str, *, path=None, record=None, reason=None):

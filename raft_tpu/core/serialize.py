@@ -55,6 +55,10 @@ _SERIALIZATION_VERSION = 2
 _FOOTER_MAGIC = b"RTFT"
 _FRAME_LEN = struct.Struct("<Q")
 _FRAME_CRC = struct.Struct("<I")
+#: public aliases for append-only consumers (the mutable-index WAL)
+#: that parse frames themselves to classify damage by file position.
+FRAME_LEN = _FRAME_LEN
+FRAME_CRC = _FRAME_CRC
 
 ArrayLike = Union[np.ndarray, "jax.Array"]
 
@@ -162,6 +166,24 @@ def reader_for(file_or_stream):
             stream.close()
 
 
+def frame(payload: bytes) -> bytes:
+    """One v2 record frame (``[u64 len][payload][u32 crc32]``) as raw
+    bytes — for append-only files (the mutable-index WAL) that write
+    frames past a :func:`header_bytes` header with no footer."""
+    return _FRAME_LEN.pack(len(payload)) + payload \
+        + _FRAME_CRC.pack(zlib.crc32(payload))
+
+
+def header_bytes(kind: str, version: int) -> bytes:
+    """The v2 container header (magic + format version + kind +
+    version) as raw bytes. Files headed this way are recognized by
+    :func:`record_spans` and the byte-level fault injectors even when
+    they frame their own records (the mutable-index WAL)."""
+    buf = io.BytesIO()
+    IndexWriter(buf, kind, version)
+    return buf.getvalue()
+
+
 def _stream_name(stream, name: Optional[str]) -> str:
     if name is not None:
         return name
@@ -209,6 +231,13 @@ class IndexWriter:
         buf = io.BytesIO()
         serialize_array(buf, a)
         self._record(buf.getvalue())
+        return self
+
+    def blob(self, b: bytes) -> "IndexWriter":
+        """One opaque byte record — e.g. a whole nested index file
+        (the mutable-index checkpoint embeds its base's serialization
+        as a single crc-framed record)."""
+        self._record(bytes(b))
         return self
 
     def finish(self) -> "IndexWriter":
@@ -315,6 +344,15 @@ class IndexReader:
                 f"failed to parse despite matching crc: {e}",
                 path=self.name, record=self._n_records - 1,
                 reason="corrupt") from e
+
+    def blob(self) -> bytes:
+        """One opaque byte record (see :meth:`IndexWriter.blob`). v2
+        only — v1 files carry no self-describing record boundaries."""
+        if self.fmt_version < 2:
+            raise ValueError(
+                f"{self.name}: blob records need v2 framing; this file "
+                f"is format v{self.fmt_version}")
+        return self._next_record()
 
     def finish(self) -> None:
         """Verify the footer (v2 files): record count and payload bytes must

@@ -1,5 +1,6 @@
 """Neighbors layer — the ANN index suite (SURVEY.md §2.7): brute_force,
-ivf_flat, ivf_pq, cagra, nn_descent, refine, filtering."""
+ivf_flat, ivf_pq, cagra, nn_descent, refine, filtering, plus the
+crash-consistent mutable write path (mutable)."""
 
 from raft_tpu.neighbors import (
     ball_cover,
@@ -9,6 +10,7 @@ from raft_tpu.neighbors import (
     hnsw,
     ivf_flat,
     ivf_pq,
+    mutable,
     nn_descent,
     ooc,
     quantize,
@@ -18,5 +20,5 @@ from raft_tpu.neighbors import (
 )
 
 __all__ = ["ball_cover", "brute_force", "cagra", "epsilon_neighborhood",
-           "hnsw", "ivf_flat", "ivf_pq", "nn_descent", "ooc", "quantize",
-           "rbc", "refine", "tiered"]
+           "hnsw", "ivf_flat", "ivf_pq", "mutable", "nn_descent", "ooc",
+           "quantize", "rbc", "refine", "tiered"]

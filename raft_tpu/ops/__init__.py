@@ -9,7 +9,8 @@ from raft_tpu.ops.distance import (
     is_min_close,
     row_norms_sq,
 )
-from raft_tpu.ops.select_k import SelectAlgo, select_k, merge_topk_dedup
+from raft_tpu.ops.select_k import (SelectAlgo, select_k, select_k_filtered,
+                                   merge_topk_dedup)
 from raft_tpu.ops.fused_l2_nn import fused_l2_nn_argmin, masked_l2_nn_argmin
 from raft_tpu.ops import kernels, linalg, matrix, rng
 
@@ -21,6 +22,7 @@ __all__ = [
     "row_norms_sq",
     "SelectAlgo",
     "select_k",
+    "select_k_filtered",
     "merge_topk_dedup",
     "fused_l2_nn_argmin",
     "masked_l2_nn_argmin",

@@ -27,6 +27,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.core.bitset import filter_mask
 from raft_tpu.obs import explain as obs_explain
 from raft_tpu.utils.shape import cdiv
 
@@ -443,6 +444,49 @@ def select_k(
                                         jnp.maximum(out_i, 0), axis=1)
         out_i = jnp.where(out_i < 0, -1, relabeled)
     return out_v, out_i
+
+
+def select_k_filtered(
+    values,
+    k: int,
+    ids,
+    filter_words,
+    select_min: bool = True,
+    algo: SelectAlgo = SelectAlgo.AUTO,
+    recall_target: float = 0.95,
+    pad_rules: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """``select_k`` with a standing bitset filter folded into selection.
+
+    ``values`` [batch, len] are candidate distances labeled by ``ids``
+    [batch, len] (or [len], broadcast across the batch; -1 marks padding
+    per the null convention). ``filter_words`` is a ``core.bitset`` word
+    array where a SET bit means the id is eligible — candidates whose bit
+    is clear are pushed to the sentinel before the top-k, so a filtered
+    id can never surface (ROADMAP item 4's sample-filter semantics,
+    sample_filter_types.hpp:27-82, applied post-scan).
+
+    Returns ``(selected_values, selected_ids, n_filtered)`` where
+    ``n_filtered`` is a scalar i32: the count of otherwise-live
+    candidates (valid id, finite distance) removed specifically by the
+    bitset — the observable behind the ``filtered_rows`` metric.
+    """
+    values = jnp.asarray(values)
+    ids = jnp.asarray(ids)
+    if ids.ndim == values.ndim - 1:
+        ids = jnp.broadcast_to(ids[None, :], values.shape)
+    valid = ids >= 0
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        valid = valid & jnp.isfinite(values)
+    allowed = filter_mask(ids, jnp.asarray(filter_words))
+    n_filtered = jnp.sum(valid & ~allowed, dtype=jnp.int32)
+    keep = valid & allowed
+    sentinel = jnp.inf if select_min else -jnp.inf
+    masked_v = jnp.where(keep, values, jnp.asarray(sentinel, values.dtype))
+    masked_i = jnp.where(keep, ids, -1)
+    v, i = select_k(masked_v, k, select_min, indices=masked_i, algo=algo,
+                    recall_target=recall_target, pad_rules=pad_rules)
+    return v, i, n_filtered
 
 
 def select_k_plan(n: int, k: int, floating: bool = True,

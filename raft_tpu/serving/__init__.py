@@ -54,6 +54,15 @@ exception             base                 retryable  raised when
                                                       / index bytes —
                                                       retrying re-serves
                                                       the corruption
+``WriteStalled``      ``RaftError``        no         a write's ack-
+                                                      durability wait
+                                                      outlived its
+                                                      budget (mutable
+                                                      writer)
+``CompactorCrashed``  ``RaftError``        no         injected compactor
+                                                      crash between
+                                                      checkpoint and
+                                                      publish (faults)
 ====================  ===================  =========  ====================
 
 Overload & failure semantics (docs/serving.md): per-request
@@ -67,6 +76,7 @@ injectors in ``raft_tpu.testing.faults``.
 """
 
 from raft_tpu.core.errors import IntegrityError
+from raft_tpu.neighbors.mutable import CompactorCrashed, WriteStalled
 from raft_tpu.serving.autoscaler import (AUTOSCALE_REASONS, Autoscaler,
                                          AutoscalerConfig)
 from raft_tpu.serving.batcher import (Batch, Batcher, DeadlineExceeded,
@@ -85,6 +95,7 @@ from raft_tpu.serving.searchers import (Searcher, brute_force_searcher,
                                         cagra_searcher, elastic_searcher,
                                         ivf_flat_searcher,
                                         ivf_pq_searcher, make_searcher,
+                                        mutable_ivf_searcher,
                                         tiered_ivf_pq_searcher)
 from raft_tpu.serving.stats import ServingStats, percentiles
 
@@ -97,6 +108,7 @@ __all__ = [
     "Batcher",
     "CircuitBreaker",
     "CircuitOpen",
+    "CompactorCrashed",
     "DeadlineExceeded",
     "Engine",
     "EngineConfig",
@@ -117,6 +129,7 @@ __all__ = [
     "Router",
     "Searcher",
     "ServingStats",
+    "WriteStalled",
     "brute_force_searcher",
     "cagra_searcher",
     "compile_count",
@@ -126,6 +139,7 @@ __all__ = [
     "ivf_flat_searcher",
     "ivf_pq_searcher",
     "make_searcher",
+    "mutable_ivf_searcher",
     "percentiles",
     "solo_reference",
     "tiered_ivf_pq_searcher",

@@ -453,6 +453,24 @@ class Engine:
         :meth:`swap_index`)."""
         return self._searcher
 
+    def writer(self):
+        """The mutable write surface behind the current searcher: the
+        index object itself when it takes writes (``add``/``upsert``/
+        ``delete`` — a ``MutableIvf`` handle), else a typed error. The
+        engine batches READS; writes go straight to the writer, whose
+        own WAL + group commit is the durability boundary, and the
+        searcher generation breadcrumb in swap spans ties each published
+        compaction back to the writer state it captured."""
+        index = self._searcher.index
+        for op in ("add", "upsert", "delete"):
+            if not callable(getattr(index, op, None)):
+                raise TypeError(
+                    f"searcher family {self._searcher.family!r} index "
+                    f"{type(index).__name__} has no write surface "
+                    f"(missing {op!r}); serve a MutableIvf via "
+                    f"mutable_ivf_searcher to take writes")
+        return index
+
     # ------------------------------------------------------------ lifecycle
     def _warm(self, searcher: Searcher) -> None:
         """Pre-compile every configured (bucket, k) shape on ``searcher``
@@ -762,6 +780,16 @@ class Engine:
                     "old_coverage": round(float(old.coverage), 6),
                     "new_coverage": round(float(searcher.coverage), 6)})
         return old
+
+    @property
+    def searcher_generation(self) -> int:
+        """Monotonic swap count: 0 for the boot searcher, +1 per
+        :meth:`swap_index`. Rides every ``kind="swap"`` and batch span
+        as ``searcher_gen``, and the compactor stamps it onto its
+        ``kind="compaction"`` span after publish — the breadcrumb that
+        ties a compacted artifact to the generation serving it."""
+        with self._swap_lock:
+            return self._searcher_gen
 
     # -------------------------------------------------------------- health
     def health(self) -> dict:

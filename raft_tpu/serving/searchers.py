@@ -25,7 +25,8 @@ import numpy as np
 
 __all__ = ["Searcher", "make_searcher", "brute_force_searcher",
            "ivf_flat_searcher", "ivf_pq_searcher", "cagra_searcher",
-           "elastic_searcher", "tiered_ivf_pq_searcher"]
+           "elastic_searcher", "tiered_ivf_pq_searcher",
+           "mutable_ivf_searcher"]
 
 
 @dataclasses.dataclass
@@ -200,6 +201,36 @@ def tiered_ivf_pq_searcher(index, params=None, res=None) -> Searcher:
                     search_with=search_with)
 
 
+def mutable_ivf_searcher(index, params=None, res=None) -> Searcher:
+    """Serving handle over a ``MutableIvf`` (neighbors/mutable.py).
+
+    The writer's host mirrors (WAL, delta rows, tombstones) live inside
+    non-array attributes, so :meth:`Searcher.place`'s device upload
+    sweep never pins mutable host state — only the immutable base the
+    writer wraps. Search goes through the writer's merged base+delta
+    path, so a handle published by the background compactor and a
+    handle wrapping the live writer return bit-identical results for
+    the same applied prefix.
+    """
+    from raft_tpu.neighbors import mutable
+
+    if not isinstance(index, mutable.MutableIvf):
+        raise TypeError(f"mutable_ivf_searcher wants MutableIvf, got "
+                        f"{type(index).__name__}")
+    params = params if params is not None else index.default_search_params()
+
+    def search_with(queries: np.ndarray, k: int, overrides: dict):
+        p = dataclasses.replace(params, **overrides) if overrides \
+            else params
+        return index.search(queries, k, p, res=res)
+
+    def search(queries: np.ndarray, k: int):
+        return index.search(queries, k, params, res=res)
+
+    return Searcher("mutable_ivf", int(index.dim), index, search,
+                    search_with=search_with)
+
+
 _FACTORIES = {
     "brute_force": brute_force_searcher,
     "ivf_flat": ivf_flat_searcher,
@@ -207,6 +238,7 @@ _FACTORIES = {
     "cagra": cagra_searcher,
     "elastic": elastic_searcher,
     "tiered_ivf_pq": tiered_ivf_pq_searcher,
+    "mutable_ivf": mutable_ivf_searcher,
 }
 
 

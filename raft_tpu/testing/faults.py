@@ -20,6 +20,14 @@ to survive (docs/robustness.md):
   exercising the engine's per-batch failure containment, the hang
   watchdog + circuit breaker, and deadline/overload shedding
   (tests/test_serving_chaos.py);
+- the mutable write path — :func:`tear_wal_tail` damages the LAST frame
+  of a ``MutableIvf`` write-ahead log (truncate mid-payload or flip a
+  byte), the crash-mid-append shape recovery must classify as a typed
+  ``IntegrityError(reason="torn_tail")`` and truncate away, and
+  :func:`crash_compactor` kills the background compactor between
+  artifact write and publish (``CompactorCrashed``), the window where
+  checkpoint and serving generation disagree until replay reconciles
+  them — tests/test_mutable.py;
 - fleet replicas — :func:`kill_replica` hard-stops one engine of a
   :class:`~raft_tpu.serving.fleet.Fleet` mid-traffic (queued riders
   fail typed and must be retried on a sibling), :func:`hang_replica`
@@ -160,6 +168,87 @@ def kill_host(target) -> None:
         target.kill()
         return
     target.close()
+
+
+# ------------------------------------------------- mutable-WAL injectors
+
+
+def _resolve_writer(target):
+    """Accept an Engine (its searcher must serve a mutable index), a bare
+    ``MutableIvf`` writer, or a WAL path string; return ``(writer, path)``
+    where ``writer`` is None for a bare path. Mirrors
+    :func:`_resolve_replica`'s target flexibility so chaos tests read the
+    same against either surface."""
+    if isinstance(target, (str, os.PathLike)):
+        return None, os.fspath(target)
+    if hasattr(target, "swap_index") and hasattr(target, "writer"):
+        target = target.writer()  # Engine -> the index behind the searcher
+    wal_path = getattr(target, "wal_path", None)
+    if wal_path is None:
+        raise TypeError(
+            f"tear_wal_tail wants an Engine serving a mutable index, a "
+            f"MutableIvf writer, or a WAL path; got "
+            f"{type(target).__name__}")
+    return target, wal_path
+
+
+def tear_wal_tail(target, mode: str = "truncate") -> str:
+    """Damage the LAST frame of the write-ahead log — the crash-mid-append
+    shape. ``mode="truncate"`` cuts the file mid-way through the final
+    record's payload (the length header survives, the bytes don't);
+    ``mode="flip"`` XORs one payload byte so the frame's crc32 fails.
+    Either way nothing follows the damaged frame, so recovery must
+    classify it ``torn_tail`` (typed, recoverable by truncation) — the
+    same damage mid-file would be ``corrupt``.
+
+    ``target`` resolves like the fleet injectors: an Engine serving a
+    mutable index, a bare ``MutableIvf`` writer (synced first so the
+    frame under attack is really on disk), or a WAL path. Returns the
+    damaged path. Real bytes, no monkeypatched readers."""
+    writer, path = _resolve_writer(target)
+    if writer is not None:
+        writer.sync()
+    spans = record_spans(path)
+    if not spans:
+        raise ValueError(f"{path}: no WAL records to tear")
+    if mode == "truncate":
+        truncate_record(path, -1)
+    elif mode == "flip":
+        flip_record_byte(path, -1)
+    else:
+        raise ValueError(f"unknown tear mode {mode!r}; "
+                         f"expected 'truncate' or 'flip'")
+    return path
+
+
+def _resolve_compactor(target):
+    """Engine / MutableIvf / Compactor -> the Compactor."""
+    if hasattr(target, "swap_index") and hasattr(target, "writer"):
+        target = target.writer()
+    comp = getattr(target, "compactor", target)
+    if not hasattr(comp, "_crash_after_checkpoint"):
+        raise TypeError(
+            f"crash_compactor wants an Engine serving a mutable index, a "
+            f"MutableIvf with an attached Compactor, or a Compactor; got "
+            f"{type(target).__name__}")
+    return comp
+
+
+@contextlib.contextmanager
+def crash_compactor(target) -> Iterator:
+    """Context manager: while active, any compaction run on ``target``'s
+    compactor dies between artifact write (checkpoint durable) and
+    publish (hot swap) — the widest crash window, where the on-disk
+    state is ahead of the serving generation. The run records a typed
+    ``CompactorCrashed`` (outcome ``"failed"``, counted + spanned like
+    any other run, never an untyped escape), and a recovery/replay must
+    reconcile to the exact acknowledged prefix. Yields the compactor."""
+    comp = _resolve_compactor(target)
+    comp._crash_after_checkpoint = True
+    try:
+        yield comp
+    finally:
+        comp._crash_after_checkpoint = False
 
 
 # ----------------------------------------------------- serving injectors

@@ -1,0 +1,91 @@
+"""Kill -9 victim process for tests/test_mutable.py (not collected by
+pytest — module name starts with an underscore).
+
+Opens a ``MutableIvf`` on the directory in ``sys.argv[1]``, applies the
+deterministic op stream :func:`make_ops` derives from the seed in
+``sys.argv[2]``, and prints ``ACK <lsn>`` (flushed) after each write
+returns — i.e. after its WAL frame is fsync-durable. The parent test
+reads those lines, SIGKILLs this process at an arbitrary point, and then
+proves recovery covers every acknowledged lsn by replaying
+``make_ops(seed)[:applied_lsn]`` into a fresh never-crashed writer and
+comparing state bit-for-bit.
+
+``sys.argv[3]`` (mode): ``plain`` just writes; ``compact`` also runs an
+aggressive background :class:`Compactor` (tiny thresholds, fast poll) so
+the kill lands mid-compaction — mid-build, mid-checkpoint, or
+mid-publish-window — with realistic probability.
+
+After the stream is exhausted the process parks forever (the parent
+always kills it; exiting cleanly would make the test vacuous).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+DIM = 8
+
+
+def make_ops(seed: int, n: int = 64):
+    """Deterministic (kind, ids, vectors) stream: adds with explicit
+    increasing ids, upserts and deletes of currently-live ids only.
+    Op ``i`` commits as lsn ``i + 1``, so a recovered ``applied_lsn``
+    of R means exactly ``ops[:R]`` were applied."""
+    rng = np.random.RandomState(seed)
+    ops = []
+    live: list = []
+    next_id = 0
+    for _ in range(n):
+        roll = rng.rand()
+        if roll < 0.6 or len(live) < 4:
+            count = int(rng.randint(1, 4))
+            ids = list(range(next_id, next_id + count))
+            next_id += count
+            live.extend(ids)
+            ops.append(("add", ids, rng.randn(count, DIM)
+                        .astype(np.float32)))
+        elif roll < 0.85:
+            id_ = live[int(rng.randint(len(live)))]
+            ops.append(("upsert", [id_], rng.randn(1, DIM)
+                        .astype(np.float32)))
+        else:
+            id_ = live.pop(int(rng.randint(len(live))))
+            ops.append(("delete", [id_], None))
+    return ops
+
+
+def apply_op(writer, op):
+    kind, ids, vectors = op
+    if kind == "add":
+        return writer.add(vectors, ids=ids)
+    if kind == "upsert":
+        return writer.upsert(vectors, ids)
+    return writer.delete(ids)
+
+
+def main():
+    from raft_tpu.neighbors import mutable
+
+    directory, seed, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    writer = mutable.MutableIvf(directory, dim=DIM, group_window_s=0.0)
+    comp = None
+    if mode == "compact":
+        comp = mutable.Compactor(writer, delta_threshold=8,
+                                 tombstone_ratio=0.05, poll_s=0.005,
+                                 min_rows=1)
+        comp.start()
+    for op in make_ops(seed):
+        apply_op(writer, op)
+        print(f"ACK {writer.applied_lsn}", flush=True)
+    print("DONE", flush=True)
+    while True:  # park until the parent kills us
+        time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,788 @@
+"""Crash-consistent mutable indexes (raft_tpu/neighbors/mutable.py).
+
+Covers the write path's durability contract end to end: WAL framing +
+torn-tail/corrupt classification, select_k_filtered standing filter,
+add/upsert/delete semantics, bit-stable merged search, checkpoint +
+replay recovery, kill -9 at every injected point (mid-append, torn
+tail, mid-compaction, mid-publish), compaction spans/counters 1:1
+reconciliation, Engine/Fleet hot-swap publication, and the amplified
+interleave suite (concurrent writers + searchers + compactor with exact
+counter reconciliation per seed).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.core.errors import IntegrityError, RaftError
+from raft_tpu.neighbors import ivf_flat, mutable
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import spans as obs_spans
+from raft_tpu.ops.select_k import select_k, select_k_filtered
+from raft_tpu.testing import faults
+from raft_tpu.testing.interleave import InterleaveAmplifier, seeds
+
+from _mutable_kill_child import DIM as CHILD_DIM
+from _mutable_kill_child import apply_op, make_ops
+
+DIM = 8
+
+
+def _writer(tmp_path, **kw):
+    kw.setdefault("dim", DIM)
+    kw.setdefault("registry", obs_metrics.Registry())
+    kw.setdefault("span_sink", obs_spans.ListSink())
+    kw.setdefault("group_window_s", 0.0)
+    return mutable.MutableIvf(str(tmp_path / "idx"), **kw)
+
+
+def _metric(writer, name, *labels):
+    fam = writer.registry.get(name)
+    assert fam is not None, name
+    return dict(fam.collect()).get(labels, type("z", (), {"value": 0})).value
+
+
+def _live_state(writer):
+    """(ids, vectors) of every live row sorted by id — the bit-identity
+    comparison surface (vectors round-trip the WAL as raw float32)."""
+    snap = writer._compaction_snapshot()
+    order = np.argsort(snap.ids, kind="stable")
+    return snap.ids[order], snap.vectors[order]
+
+
+# ------------------------------------------------------------------- WAL
+
+
+def test_wal_roundtrip_and_record_spans(tmp_path):
+    path = str(tmp_path / "wal.log")
+    wal = mutable.WriteAheadLog(path, group_window_s=0.0)
+    rng = np.random.default_rng(0)
+    for op, ids in ((mutable.OP_ADD, [0, 1]), (mutable.OP_UPSERT, [1]),
+                    (mutable.OP_DELETE, [0])):
+        n = len(ids)
+        vecs = rng.standard_normal((n, 4)).astype(np.float32) \
+            if op != mutable.OP_DELETE else np.zeros((0, 4), np.float32)
+        wal.commit(op, np.asarray(ids, np.int32), vecs)
+    wal.close()
+
+    scan = mutable.read_wal(path)
+    assert scan.status == "ok" and scan.error is None
+    assert [r.lsn for r in scan.records] == [1, 2, 3]
+    assert [r.op for r in scan.records] == [
+        mutable.OP_ADD, mutable.OP_UPSERT, mutable.OP_DELETE]
+    assert list(scan.records[0].ids) == [0, 1]
+    # footer-less WAL frames are visible to the PR-3 byte injectors
+    from raft_tpu.core.serialize import record_spans
+    assert len(record_spans(path)) == 3
+
+
+@pytest.mark.parametrize("mode", ["truncate", "flip"])
+def test_wal_torn_tail_is_typed_and_positional(tmp_path, mode):
+    path = str(tmp_path / "wal.log")
+    wal = mutable.WriteAheadLog(path, group_window_s=0.0)
+    for i in range(3):
+        wal.commit(mutable.OP_ADD, np.asarray([i], np.int32),
+                   np.full((1, 4), float(i), np.float32))
+    wal.close()
+    faults.tear_wal_tail(path, mode=mode)
+
+    scan = mutable.read_wal(path)
+    assert scan.status == "torn_tail"
+    assert isinstance(scan.error, IntegrityError)
+    assert scan.error.reason == "torn_tail"
+    # the durable prefix survives intact
+    assert [r.lsn for r in scan.records] == [1, 2]
+
+
+def test_wal_damage_mid_file_is_corrupt_not_torn(tmp_path):
+    path = str(tmp_path / "wal.log")
+    wal = mutable.WriteAheadLog(path, group_window_s=0.0)
+    for i in range(3):
+        wal.commit(mutable.OP_ADD, np.asarray([i], np.int32),
+                   np.full((1, 4), float(i), np.float32))
+    wal.close()
+    faults.flip_record_byte(path, 1)  # bytes FOLLOW the damaged frame
+
+    scan = mutable.read_wal(path)
+    assert scan.status == "corrupt"
+    assert isinstance(scan.error, IntegrityError)
+    assert scan.error.reason == "corrupt"
+
+
+def test_wal_bad_header_is_corrupt(tmp_path):
+    path = str(tmp_path / "wal.log")
+    with open(path, "wb") as f:
+        f.write(b"not a wal at all")
+    scan = mutable.read_wal(path)
+    assert scan.status == "corrupt"
+    assert scan.error.reason == "corrupt"
+
+
+def test_wal_group_commit_batches_appends(tmp_path):
+    """Concurrent writers share fsyncs: every committed lsn is durable,
+    and the writer-facing invariant ack => durable holds throughout."""
+    path = str(tmp_path / "wal.log")
+    wal = mutable.WriteAheadLog(path, group_window_s=0.002)
+    errors = []
+
+    def hammer(tid):
+        try:
+            for i in range(10):
+                lsn = wal.commit(mutable.OP_ADD,
+                                 np.asarray([tid * 100 + i], np.int32),
+                                 np.zeros((1, 4), np.float32))
+                assert wal.durable_lsn >= lsn
+        except (RaftError, ValueError) as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wal.close()
+    assert not errors
+    scan = mutable.read_wal(path)
+    assert scan.status == "ok"
+    assert sorted(r.lsn for r in scan.records) == list(range(1, 41))
+
+
+# ------------------------------------------------------- select_k_filtered
+
+
+def test_select_k_filtered_removes_and_counts():
+    values = np.asarray([[1.0, 2.0, 3.0, 4.0, 5.0]], np.float32)
+    ids = np.asarray([[10, 11, 12, 13, -1]], np.int32)
+    words = np.zeros(1, np.uint32)
+    for allowed in (10, 12, 13):
+        words[allowed // 32] |= np.uint32(1) << np.uint32(allowed % 32)
+    v, i, n_filt = select_k_filtered(values, 3, ids, words,
+                                     pad_rules=False)
+    assert list(np.asarray(i)[0]) == [10, 12, 13]
+    assert list(np.asarray(v)[0]) == [1.0, 3.0, 4.0]
+    # 11 was a live candidate removed by the bitset; -1 padding is NOT
+    # counted as filtered
+    assert int(n_filt) == 1
+
+
+def test_select_k_filtered_matches_select_k_on_allowed_subset():
+    rng = np.random.default_rng(7)
+    values = rng.standard_normal((4, 64)).astype(np.float32)
+    ids = np.tile(np.arange(64, dtype=np.int32), (4, 1))
+    words = np.zeros(2, np.uint32)
+    allowed = rng.choice(64, size=40, replace=False)
+    for a in allowed:
+        words[a // 32] |= np.uint32(1) << np.uint32(a % 32)
+    v, i, n_filt = select_k_filtered(values, 8, ids, words,
+                                     select_min=True, pad_rules=False)
+    mask = np.zeros(64, bool)
+    mask[allowed] = True
+    ref_v, ref_i = select_k(
+        np.where(mask[None, :], values, np.inf), 8, True,
+        indices=np.where(mask[None, :], ids, -1), pad_rules=False)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(ref_v))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ref_i))
+    assert int(n_filt) == 4 * (64 - 40)
+
+
+# ------------------------------------------------------- writer semantics
+
+
+def test_add_upsert_delete_search_semantics(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(1)
+    vecs = rng.standard_normal((10, DIM)).astype(np.float32)
+    ids = w.add(vecs)
+    assert list(ids) == list(range(10))
+
+    # exact self-query: nearest neighbor of row 3 is id 3
+    _, i = w.search(vecs[3], 1)
+    assert int(np.asarray(i).ravel()[0]) == 3
+
+    # upsert moves id 3 far away; a fresh query there finds it
+    far = np.full((1, DIM), 50.0, np.float32)
+    w.upsert(far, [3])
+    d, i = w.search(far, 1)
+    assert int(np.asarray(i).ravel()[0]) == 3
+    assert float(np.asarray(d).ravel()[0]) < 1e-3
+
+    # delete: the id never surfaces again, even at k = everything
+    w.delete([3])
+    _, i = w.search(far, 10)
+    assert 3 not in set(np.asarray(i).ravel().tolist())
+    assert w.size == 9
+
+    # explicit-id collision with a live row is a typed validation error
+    with pytest.raises(ValueError, match="upsert"):
+        w.add(vecs[:1], ids=[4])
+    w.close()
+
+
+def test_search_is_bit_stable_across_calls_and_snapshots(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(2)
+    w.add(rng.standard_normal((64, DIM)).astype(np.float32))
+    q = rng.standard_normal((4, DIM)).astype(np.float32)
+    d1, i1 = w.search(q, 5)
+    w.delete([0])  # invalidate the device snapshot
+    w.upsert(rng.standard_normal((1, DIM)).astype(np.float32), [0])
+    d2, i2 = w.search(q, 5)
+    d3, i3 = w.search(q, 5)
+    np.testing.assert_array_equal(np.asarray(d2), np.asarray(d3))
+    np.testing.assert_array_equal(np.asarray(i2), np.asarray(i3))
+    w.close()
+
+
+def test_deleted_base_ids_filtered_after_compaction(tmp_path):
+    """Tombstones fold into select as a standing filter over BASE rows
+    (post-compaction residents), with the filtered_rows counter live."""
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(3)
+    vecs = rng.standard_normal((40, DIM)).astype(np.float32)
+    w.add(vecs)
+    comp = mutable.Compactor(w)
+    assert comp.run_once("manual") == "ok"
+    assert w.stats()["base_rows"] == 40 and w.stats()["delta_rows"] == 0
+
+    victim = 7
+    w.delete([victim])
+    assert w.stats()["tombstone_live_ratio"] > 0
+    _, i = w.search(vecs[victim], 40)
+    got = set(np.asarray(i).ravel().tolist())
+    assert victim not in got
+    assert _metric(w, "raft_tpu_mutable_filtered_rows_total", w.name) > 0
+
+    # upsert of a base-resident id: the stale base copy is masked too
+    w.upsert(np.full((1, DIM), 30.0, np.float32), [11])
+    _, i = w.search(vecs[11], 40)
+    ids = np.asarray(i).ravel().tolist()
+    assert ids.count(11) <= 1  # never both copies
+    w.close()
+
+
+# ------------------------------------------------------ recovery + replay
+
+
+def test_recovery_replays_wal_bit_identical(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(4)
+    w.add(rng.standard_normal((20, DIM)).astype(np.float32))
+    w.delete([2, 4])
+    w.upsert(rng.standard_normal((2, DIM)).astype(np.float32), [0, 1])
+    q = rng.standard_normal((3, DIM)).astype(np.float32)
+    d1, i1 = w.search(q, 6)
+    w.close()
+
+    w2 = _writer(tmp_path)
+    assert w2.recovery["status"] == "ok"
+    assert w2.recovery["replayed"] == 3
+    d2, i2 = w2.search(q, 6)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    assert _metric(w2, "raft_tpu_mutable_replays_total", w2.name, "ok") == 1
+    # replay is surfaced as a span too
+    kinds = [s["kind"] for s in w2.span_sink.records]
+    assert "wal_replay" in kinds
+    w2.close()
+
+
+def test_torn_tail_recovery_is_typed_never_a_crash(tmp_path):
+    w = _writer(tmp_path)
+    w.add(np.ones((4, DIM), np.float32))
+    w.add(2.0 * np.ones((4, DIM), np.float32))
+    faults.tear_wal_tail(w, mode="flip")
+    w.close()
+
+    w2 = _writer(tmp_path)
+    rec = w2.recovery
+    assert rec["status"] == "torn_tail"
+    assert isinstance(rec["error"], IntegrityError)
+    assert rec["error"].reason == "torn_tail"
+    assert rec["applied_lsn"] == 1  # the torn frame's writes are gone
+    assert _metric(w2, "raft_tpu_mutable_replays_total",
+                   w2.name, "torn_tail") == 1
+    # the log was truncated: reopening again is clean
+    w2.close()
+    w3 = _writer(tmp_path)
+    assert w3.recovery["status"] == "ok"
+    w3.close()
+
+
+def test_corrupt_wal_raises_typed(tmp_path):
+    w = _writer(tmp_path)
+    for i in range(3):
+        w.add(np.full((2, DIM), float(i), np.float32))
+    w.close()
+    faults.flip_record_byte(str(tmp_path / "idx" / "wal.log"), 1)
+    with pytest.raises(IntegrityError) as ei:
+        _writer(tmp_path)
+    assert ei.value.reason == "corrupt"
+
+
+def test_checkpoint_trims_wal_and_restores(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(5)
+    w.add(rng.standard_normal((12, DIM)).astype(np.float32))
+    w.checkpoint()
+    w.delete([0])  # post-checkpoint: must survive via the WAL tail
+    q = rng.standard_normal((2, DIM)).astype(np.float32)
+    d1, i1 = w.search(q, 4)
+    w.close()
+
+    assert mutable.read_wal(str(tmp_path / "idx" / "wal.log")).records, \
+        "post-checkpoint write should be in the trimmed WAL"
+    w2 = _writer(tmp_path)
+    assert w2.recovery["replayed"] == 1
+    d2, i2 = w2.search(q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    w2.close()
+
+
+# ------------------------------------------------------------- compaction
+
+
+def test_compaction_reason_vocabulary_is_closed(tmp_path):
+    w = _writer(tmp_path)
+    comp = mutable.Compactor(w)
+    with pytest.raises(ValueError, match="unknown compaction reason"):
+        comp.request("because")
+    with pytest.raises(ValueError, match="unknown compaction reason"):
+        comp.run_once("vibes")
+    w.close()
+
+
+def test_compaction_counters_reconcile_1_to_1_with_spans(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(6)
+    w.add(rng.standard_normal((30, DIM)).astype(np.float32))
+    comp = mutable.Compactor(w)
+    assert comp.run_once("manual") == "ok"
+    w.delete(list(range(5)))
+    assert comp.run_once("tombstone_ratio") == "ok"
+    with faults.crash_compactor(w):
+        assert comp.run_once("delta_threshold") == "failed"
+
+    spans = [s for s in w.span_sink.records if s["kind"] == "compaction"]
+    by_key: dict = {}
+    for s in spans:
+        by_key[(s["reason"], s["outcome"])] = \
+            by_key.get((s["reason"], s["outcome"]), 0) + 1
+    fam = w.registry.get("raft_tpu_mutable_compactions_total")
+    counted = {(labels[1], labels[2]): child.value
+               for labels, child in fam.collect()}
+    assert counted == by_key  # exactly 1:1, per (reason, outcome)
+    assert counted[("manual", "ok")] == 1
+    assert counted[("delta_threshold", "failed")] == 1
+    w.close()
+
+
+def test_compaction_auto_triggers(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(7)
+    comp = mutable.Compactor(w, delta_threshold=16, tombstone_ratio=0.2)
+    w.add(rng.standard_normal((20, DIM)).astype(np.float32))
+    assert comp._auto_reason() == "delta_threshold"
+    assert comp.run_once(comp._auto_reason()) == "ok"
+    assert comp._auto_reason() is None
+    w.delete(list(range(6)))
+    assert comp._auto_reason() == "tombstone_ratio"
+    w.close()
+
+
+def test_compaction_stall_trips_flight_recorder(tmp_path):
+    w = _writer(tmp_path)
+    w.add(np.random.default_rng(8).standard_normal((8, DIM))
+          .astype(np.float32))
+    dumps = []
+
+    class Target:
+        def swap_index(self, searcher):
+            return searcher
+
+        def dump_diagnostics(self, reason="manual"):
+            dumps.append(reason)
+            return "bundle"
+
+        @property
+        def searcher_generation(self):
+            return 1
+
+    class SlowCompactor(mutable.Compactor):
+        def _build(self, snap):
+            time.sleep(0.2)
+            return super()._build(snap)
+
+    comp = SlowCompactor(w, publish=Target(), stall_timeout_s=0.02)
+    assert comp.run_once("manual") == "ok"  # a stall detects, not aborts
+    assert dumps == ["compaction_stall"]
+    assert _metric(w, "raft_tpu_mutable_compaction_stalls_total",
+                   w.name) == 1
+    stall_spans = [s for s in w.span_sink.records
+                   if s["kind"] == "compaction_stall"]
+    assert len(stall_spans) == 1 and stall_spans[0]["reason"] == "manual"
+    w.close()
+
+
+def test_background_compactor_thread_runs_and_stops(tmp_path):
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(9)
+    comp = mutable.Compactor(w, delta_threshold=8, poll_s=0.005,
+                             min_rows=1)
+    comp.start()
+    try:
+        w.add(rng.standard_normal((32, DIM)).astype(np.float32))
+        deadline = time.monotonic() + 10.0
+        while comp.runs == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert comp.runs > 0, "auto compaction never fired"
+    finally:
+        comp.stop()
+    assert w.stats()["base_rows"] > 0
+    w.close()
+
+
+# ----------------------------------------------------- serving integration
+
+
+def _mutable_engine(w, **kw):
+    from raft_tpu import serving
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_us", 2000)
+    kw.setdefault("warm_ks", (3,))
+    kw.setdefault("warm_buckets", (1, 4))
+    searcher = serving.mutable_ivf_searcher(w)
+    return serving.Engine(searcher, serving.EngineConfig(**kw))
+
+
+def test_engine_writer_surface_and_hot_swap_publish(tmp_path):
+    from raft_tpu import serving
+
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(10)
+    vecs = rng.standard_normal((24, DIM)).astype(np.float32)
+    with _mutable_engine(w) as eng:
+        # the writer surface is the mutable index behind the searcher
+        eng.writer().add(vecs)
+        d, i = eng.submit(vecs[5], 3).result(timeout=60)
+        assert int(np.asarray(i).ravel()[0]) == 5
+
+        comp = mutable.Compactor(w, publish=eng)
+        assert comp.run_once("manual") == "ok"
+        assert eng.searcher_generation == 1  # published via hot swap
+        span = [s for s in w.span_sink.records
+                if s["kind"] == "compaction"][-1]
+        assert span["searcher_gen"] == 1  # the generation breadcrumb
+
+        # zero dropped requests across the swap; deletes keep working
+        eng.writer().delete([5])
+        d, i = eng.submit(vecs[5], 3).result(timeout=60)
+        assert 5 not in set(np.asarray(i).ravel().tolist())
+    w.close()
+
+
+def test_engine_writer_surface_is_typed_for_immutable_indexes():
+    from raft_tpu import serving
+
+    rng = np.random.default_rng(11)
+    db = rng.standard_normal((64, DIM)).astype(np.float32)
+    idx = ivf_flat.build(db, ivf_flat.IndexParams(n_lists=4))
+    searcher = serving.ivf_flat_searcher(idx)
+    eng = serving.Engine(searcher, serving.EngineConfig(max_batch=2))
+    with pytest.raises(TypeError, match="write surface"):
+        eng.writer()
+
+
+def test_fleet_rolling_swap_publish(tmp_path):
+    from raft_tpu import serving
+
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(12)
+    w.add(rng.standard_normal((24, DIM)).astype(np.float32))
+    searchers = [serving.mutable_ivf_searcher(w) for _ in range(2)]
+    cfg = serving.EngineConfig(max_batch=4, max_wait_us=2000,
+                               warm_ks=(3,), warm_buckets=(1, 4))
+    with serving.Fleet.from_searchers(
+            searchers, engine_config=cfg,
+            config=serving.FleetConfig(quorum=1)) as fleet:
+        comp = mutable.Compactor(w, publish=fleet)
+        assert comp.run_once("manual") == "ok"
+        span = [s for s in w.span_sink.records
+                if s["kind"] == "compaction"][-1]
+        assert span["searcher_gen"] == [1, 1]  # every replica swapped
+        d, i = fleet.search(rng.standard_normal(DIM).astype(np.float32), 3)
+        assert np.asarray(i).shape == (3,)
+    w.close()
+
+
+# ------------------------------------------------------------ kill -9 suite
+
+
+def _run_victim(directory, seed, mode, kill_after_acks):
+    """Spawn the victim, SIGKILL it after ``kill_after_acks`` acked
+    writes, and return the highest acked lsn."""
+    child = os.path.join(os.path.dirname(__file__),
+                         "_mutable_kill_child.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, child, directory, str(seed), mode],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    acked = 0
+    try:
+        for line in proc.stdout:
+            if line.startswith("ACK"):
+                acked = int(line.split()[1])
+                if acked >= kill_after_acks:
+                    break
+            elif line.startswith("DONE"):
+                break
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    assert acked > 0, "victim never acknowledged a write"
+    return acked
+
+
+def _assert_recovered_matches_oracle(directory, seed, acked, tmp_path):
+    """The recovered writer's applied prefix covers every ack and is
+    bit-identical to a never-crashed writer fed the same prefix."""
+    w = mutable.MutableIvf(directory, dim=CHILD_DIM,
+                           registry=obs_metrics.Registry(),
+                           group_window_s=0.0)
+    rec = w.recovery
+    assert rec["status"] in ("ok", "torn_tail")  # typed, never untyped
+    if rec["status"] == "torn_tail":
+        assert isinstance(rec["error"], IntegrityError)
+        assert rec["error"].reason == "torn_tail"
+    applied = w.applied_lsn
+    assert applied >= acked, (
+        f"lost acknowledged writes: acked lsn {acked}, recovered "
+        f"applied_lsn {applied}")
+
+    oracle = mutable.MutableIvf(str(tmp_path / "oracle"), dim=CHILD_DIM,
+                                registry=obs_metrics.Registry(),
+                                group_window_s=0.0)
+    for op in make_ops(seed)[:applied]:
+        apply_op(oracle, op)
+    got_ids, got_vecs = _live_state(w)
+    want_ids, want_vecs = _live_state(oracle)
+    np.testing.assert_array_equal(got_ids, want_ids)
+    np.testing.assert_array_equal(got_vecs, want_vecs)  # bit-identical
+    w.close()
+    oracle.close()
+    return applied
+
+
+def test_kill9_mid_append_recovers_every_acked_write(tmp_path):
+    directory = str(tmp_path / "victim")
+    acked = _run_victim(directory, seed=101, mode="plain",
+                        kill_after_acks=20)
+    _assert_recovered_matches_oracle(directory, 101, acked, tmp_path)
+
+
+def test_kill9_mid_compaction_state_bit_identical(tmp_path):
+    """Kill -9 lands while an aggressive compactor races the write
+    stream (mid-build / mid-checkpoint / mid-trim windows). Recovery
+    must land on exactly the applied prefix — checkpoint + WAL tail —
+    bit-identical to a never-crashed all-delta writer."""
+    directory = str(tmp_path / "victim")
+    acked = _run_victim(directory, seed=202, mode="compact",
+                        kill_after_acks=30)
+    _assert_recovered_matches_oracle(directory, 202, acked, tmp_path)
+
+
+def test_crash_mid_publish_recovers_and_republises(tmp_path):
+    """The widest window: checkpoint durable, publish never happened
+    (crash_compactor). The run fails typed; a recovery sees the
+    checkpointed state; the next compaction publishes cleanly."""
+    from raft_tpu import serving
+
+    w = _writer(tmp_path)
+    rng = np.random.default_rng(13)
+    vecs = rng.standard_normal((24, DIM)).astype(np.float32)
+    with _mutable_engine(w) as eng:
+        eng.writer().add(vecs)
+        comp = mutable.Compactor(w, publish=eng)
+        with faults.crash_compactor(eng):
+            assert comp.run_once("manual") == "failed"
+        assert isinstance(comp.last_error, mutable.CompactorCrashed)
+        assert eng.searcher_generation == 0  # publish never happened
+        pre = _live_state(w)
+    w.close()
+
+    # simulated restart: the checkpoint the crashed run wrote restores
+    w2 = mutable.MutableIvf(str(tmp_path / "idx"),
+                            registry=obs_metrics.Registry(),
+                            span_sink=obs_spans.ListSink(),
+                            group_window_s=0.0)
+    assert w2.recovery["status"] == "ok"
+    got = _live_state(w2)
+    np.testing.assert_array_equal(pre[0], got[0])
+    np.testing.assert_array_equal(pre[1], got[1])
+    with _mutable_engine(w2) as eng2:
+        comp2 = mutable.Compactor(w2, publish=eng2)
+        assert comp2.run_once("manual") in ("ok", "skipped")
+        d, i = eng2.submit(vecs[3], 3).result(timeout=60)
+        assert int(np.asarray(i).ravel()[0]) == 3
+    w2.close()
+
+
+# --------------------------------------------------------- verification
+
+
+def test_verify_dir_classification(tmp_path):
+    w = _writer(tmp_path)
+    w.add(np.ones((4, DIM), np.float32))
+    w.sync()
+    directory = str(tmp_path / "idx")
+    assert mutable.verify_dir(directory)["status"] == "ok"
+    faults.tear_wal_tail(w, mode="truncate")
+    w.close()
+    report = mutable.verify_dir(directory)
+    assert report["status"] == "torn_tail"
+    assert report["wal"]["status"] == "torn_tail"
+
+    # recovery repairs; a checkpoint makes the replay range empty
+    w2 = _writer(tmp_path)
+    w2.add(np.ones((2, DIM), np.float32))
+    w2.close()
+    report = mutable.verify_dir(directory)
+    assert report["status"] == "ok"
+    assert report["replay"]["records"] == report["wal"]["records"]
+
+    faults.flip_record_byte(os.path.join(directory, "wal.log"), 0)
+    # damage followed by live bytes classifies corrupt when more records
+    # follow; with a single record it is a torn tail — either way typed
+    report = mutable.verify_dir(directory)
+    assert report["status"] in ("torn_tail", "corrupt")
+
+
+def test_verify_checkpoint_tool_exit_codes(tmp_path):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "verify_checkpoint.py")
+    directory = str(tmp_path / "idx")
+    w = _writer(tmp_path)
+    w.add(np.ones((6, DIM), np.float32))
+    w.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    r = subprocess.run([sys.executable, tool, directory],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "replay: lsn 1...1" in r.stdout
+
+    faults.tear_wal_tail(os.path.join(directory, "wal.log"),
+                         mode="truncate")
+    r = subprocess.run([sys.executable, tool, directory],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "DEGRADED" in r.stdout
+
+    with open(os.path.join(directory, "wal.log"), "wb") as f:
+        f.write(b"garbage")
+    r = subprocess.run([sys.executable, tool, directory],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+# ------------------------------------------------- amplified interleaving
+
+
+def _interleave_round(tmp_path, seed, n_ops=6):
+    """One amplified seed: 2 writer threads on disjoint id ranges + a
+    searcher + an aggressive compactor, then exact reconciliation of
+    final state AND counters against the deterministic per-thread
+    streams."""
+    reg = obs_metrics.Registry()
+    sink = obs_spans.ListSink()
+    w = mutable.MutableIvf(str(tmp_path / f"s{seed}"), dim=4,
+                           registry=reg, span_sink=sink,
+                           group_window_s=0.0, name=f"s{seed}")
+    comp = mutable.Compactor(w, delta_threshold=4, poll_s=0.002,
+                             min_rows=1)
+    expect: dict = {}
+    errors: list = []
+
+    def writer_thread(tid):
+        rng = np.random.RandomState(seed * 31 + tid)
+        base_id = tid * 1000
+        try:
+            for i in range(n_ops):
+                id_ = base_id + i
+                vec = rng.randn(1, 4).astype(np.float32)
+                w.upsert(vec, [id_])
+                expect[id_] = vec[0]
+            w.delete([base_id])  # each thread deletes its first id
+            del expect[base_id]
+        except (RaftError, ValueError) as e:  # pragma: no cover
+            errors.append(e)
+
+    def searcher_thread():
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(3):
+                q = rng.randn(1, 4).astype(np.float32)
+                d, i = w.search(q, 3)
+                ids = np.asarray(i).ravel()
+                assert len(set(ids[ids >= 0].tolist())) == \
+                    len(ids[ids >= 0]), "duplicate ids in one result row"
+        except (RaftError, ValueError) as e:  # pragma: no cover
+            errors.append(e)
+
+    with InterleaveAmplifier(
+            seed=seed, path_filters=("neighbors/mutable.py",)):
+        comp.start()
+        threads = [threading.Thread(target=writer_thread, args=(t,))
+                   for t in range(2)]
+        threads.append(threading.Thread(target=searcher_thread))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        comp.stop()
+    assert not errors, errors
+
+    # exact final state: every thread's last write per id, minus deletes
+    ids, vecs = _live_state(w)
+    assert list(ids) == sorted(expect)
+    for id_, vec in zip(ids, vecs):
+        np.testing.assert_array_equal(vec, expect[int(id_)])
+
+    # exact counter reconciliation for this seed's registry
+    n_writes = 2 * (n_ops + 1)  # n_ops upserts + 1 delete per thread
+    writes = sum(child.value for _, child in reg.get(
+        "raft_tpu_mutable_writes_total").collect())
+    acks = dict(reg.get("raft_tpu_mutable_acks_total").collect())[
+        (w.name,)].value
+    assert writes == n_writes
+    assert acks == n_writes  # every write acked — none stalled
+    comp_spans = [s for s in sink.records if s["kind"] == "compaction"]
+    fam = reg.get("raft_tpu_mutable_compactions_total")
+    counted = sum(child.value for _, child in fam.collect())
+    assert counted == len(comp_spans)  # spans 1:1 with counters
+    assert w.applied_lsn == n_writes
+    w.close()
+
+
+def test_mutable_interleave_fast_twin(tmp_path):
+    """Tier-1 shape check of the amplified suite (3 seeds)."""
+    for seed in seeds(3):
+        _interleave_round(tmp_path, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.interleave
+def test_mutable_interleave_100_seeds(tmp_path):
+    """The full 100-seed amplified sweep: concurrent writers +
+    searchers + compactor with exact state and counter reconciliation
+    on every seed (replay a failure via RAFT_TPU_INTERLEAVE_SEED)."""
+    for seed in seeds(100):
+        _interleave_round(tmp_path, seed)

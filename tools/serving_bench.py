@@ -41,6 +41,14 @@ Measures, per index family (brute_force / ivf_flat / ivf_pq / cagra):
   attributed in ``raft_tpu_adaptive_choice_total`` (``--no-adaptive``
   skips the arm).
 
+- ``mutable_soak``: writer threads upsert/delete a
+  :class:`~raft_tpu.neighbors.mutable.MutableIvf` while submitters
+  search it through a full Engine and a background Compactor publishes
+  re-clustered bases via hot swap — zero untyped failures, zero dropped
+  requests, and post-soak recall within ``--soak-tolerance`` of a
+  freshly rebuilt brute-force oracle over the surviving rows
+  (``--soak-writes 0`` disables the arm).
+
 Telemetry (docs/observability.md): every engine in the bench runs with a
 span sink writing ``<out>.spans.jsonl`` (one record per request with its
 trace id, phase decomposition, and typed outcome; ``--spans ''``
@@ -1008,6 +1016,175 @@ def bench_tiered(db, queries, k, res, rng, pressures=(2.0, 8.0),
     return out, extra
 
 
+def bench_mutable_soak(db, queries, k, res, rng, *, writers=2,
+                       writes_per_writer=150, submitters=4,
+                       max_batch=8, tolerance=0.02, sink=None):
+    """Mixed read/write soak: writer threads upsert/delete through a
+    :class:`~raft_tpu.neighbors.mutable.MutableIvf` while submitter
+    threads search it through a full Engine and a background Compactor
+    re-clusters and publishes via hot swap — the docs/robustness.md
+    "Write path & recovery" story under live traffic.
+
+    What the row gates:
+
+    - **zero untyped failures** — every search resolves with a result
+      or a typed :class:`~raft_tpu.core.errors.RaftError`; every write
+      acks or raises typed; any other exception fails the arm;
+    - **zero dropped requests** — submits in equals results out,
+      across however many hot swaps the compactor publishes mid-soak;
+    - **shadow recall vs a fresh oracle** — after the soak quiesces,
+      the engine's served answers over the FINAL state are graded
+      against a freshly rebuilt brute-force oracle on the surviving
+      rows; recall must sit within ``tolerance`` of exact. The search
+      params probe every list, so this measures the merged
+      base+delta+tombstone read path, not clustering luck;
+    - **counter/span reconciliation** — ``compactions_total`` equals
+      the ``kind="compaction"`` span count, and acks equal writes.
+    """
+    import tempfile
+
+    from raft_tpu import serving
+    from raft_tpu.core.errors import RaftError
+    from raft_tpu.neighbors import ivf_flat, mutable
+    from raft_tpu.obs import metrics as obs_metrics
+    from raft_tpu.obs import spans as obs_spans
+
+    dim = db.shape[1]
+    n_lists = 16
+    reg = obs_metrics.Registry()
+    span_sink = obs_spans.ListSink()
+    td = tempfile.TemporaryDirectory()
+    w = mutable.MutableIvf(
+        os.path.join(td.name, "soak"), dim=dim, registry=reg,
+        span_sink=span_sink, name="soak",
+        index_params=ivf_flat.IndexParams(n_lists=n_lists),
+        search_params=ivf_flat.SearchParams(n_probes=n_lists))
+    seed_rows = len(db) // 2
+    w.add(np.asarray(db[:seed_rows], np.float32))
+    oracle_lock = threading.Lock()
+    oracle_state = {i: np.asarray(db[i], np.float32)
+                    for i in range(seed_rows)}
+
+    searcher = serving.mutable_ivf_searcher(w, res=res)
+    eng = serving.Engine(searcher, serving.EngineConfig(
+        max_batch=max_batch, max_wait_us=2000, warm_ks=(k,),
+        span_sink=sink))
+    untyped, typed = [], []
+    served = [0]
+    stop = threading.Event()
+
+    def writer_thread(tid):
+        trng = np.random.default_rng(1000 + tid)
+        pool = list(range(seed_rows + tid, len(db), writers))
+        try:
+            for i in range(writes_per_writer):
+                if trng.random() < 0.25 and i > 4:
+                    victim = int(pool[int(trng.integers(len(pool)))])
+                    with oracle_lock:
+                        if victim not in oracle_state:
+                            continue
+                        del oracle_state[victim]
+                    w.delete([victim])
+                else:
+                    id_ = int(pool[int(trng.integers(len(pool)))])
+                    vec = np.asarray(db[id_], np.float32) \
+                        + trng.standard_normal(dim).astype(np.float32) * 0.01
+                    with oracle_lock:
+                        oracle_state[id_] = vec
+                    w.upsert(vec[None, :], [id_])
+        except RaftError as e:
+            typed.append(e)
+        except Exception as e:  # noqa: BLE001 — the zero-untyped gate
+            untyped.append(e)
+
+    def submit_thread(tid):
+        trng = np.random.default_rng(2000 + tid)
+        try:
+            while not stop.is_set():
+                q = queries[int(trng.integers(len(queries)))]
+                eng.submit(np.asarray(q, np.float32), k).result(timeout=60)
+                served[0] += 1
+        except RaftError as e:
+            typed.append(e)
+        except Exception as e:  # noqa: BLE001
+            untyped.append(e)
+
+    comp = mutable.Compactor(w, publish=eng, delta_threshold=64,
+                             tombstone_ratio=0.1, poll_s=0.01, min_rows=8)
+    t0 = time.perf_counter()
+    with eng:
+        comp.start()
+        try:
+            wthreads = [threading.Thread(target=writer_thread, args=(t,))
+                        for t in range(writers)]
+            sthreads = [threading.Thread(target=submit_thread, args=(t,))
+                        for t in range(submitters)]
+            for t in wthreads + sthreads:
+                t.start()
+            for t in wthreads:
+                t.join()
+            stop.set()
+            for t in sthreads:
+                t.join()
+        finally:
+            comp.stop()
+        soak_s = time.perf_counter() - t0
+        assert not untyped, f"untyped failures in soak: {untyped!r}"
+
+        # quiesced read pass over the FINAL state, graded against a
+        # freshly rebuilt exact oracle on the rows that survived
+        with oracle_lock:
+            final = sorted(oracle_state.items())
+        live_ids = np.asarray([i for i, _ in final], np.int64)
+        live_rows = np.stack([v for _, v in final])
+        oracle = make_exact_oracle(live_rows)
+        grade_q = queries[: min(len(queries), 128)]
+        _, oracle_pos = oracle(np.asarray(grade_q, np.float32), k)
+        want = live_ids[oracle_pos]
+        futs = [eng.submit(np.asarray(q, np.float32), k) for q in grade_q]
+        got = np.stack([np.asarray(f.result(timeout=60)[1]).ravel()
+                        for f in futs])
+        hits = sum(len(set(g.tolist()) & set(ww.tolist()))
+                   for g, ww in zip(got, want))
+        recall = hits / float(want.size)
+        generations = eng.searcher_generation
+
+    n_writes = int(sum(c.value for _, c in reg.get(
+        "raft_tpu_mutable_writes_total").collect()))
+    n_acks = int(sum(c.value for _, c in reg.get(
+        "raft_tpu_mutable_acks_total").collect()))
+    comp_spans = [s for s in span_sink.records if s["kind"] == "compaction"]
+    n_comp = int(sum(c.value for _, c in reg.get(
+        "raft_tpu_mutable_compactions_total").collect()))
+    assert n_acks == n_writes, (
+        f"{n_writes} writes but {n_acks} acks — a write neither acked "
+        f"nor raised typed")
+    assert n_comp == len(comp_spans), (
+        f"compaction counters ({n_comp}) and spans ({len(comp_spans)}) "
+        f"do not reconcile 1:1")
+    assert recall >= 1.0 - tolerance, (
+        f"soak recall {recall:.4f} fell more than {tolerance} below the "
+        f"fresh oracle — the merged base+delta+tombstone read path is "
+        f"losing rows")
+    w.close()
+    td.cleanup()
+    return {
+        "soak_s": round(soak_s, 2),
+        "writers": writers,
+        "writes": n_writes,
+        "acks": n_acks,
+        "searches": served[0],
+        "typed_failures": len(typed),
+        "untyped_failures": len(untyped),
+        "live_rows": len(live_ids),
+        "compactions": n_comp,
+        "compaction_spans": len(comp_spans),
+        "swaps": generations if isinstance(generations, int) else None,
+        "recall_vs_fresh_oracle": round(recall, 4),
+        "tolerance": tolerance,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None,
@@ -1096,6 +1273,14 @@ def main():
     ap.add_argument("--tiered-queries", type=int, default=200,
                     help="tiered arm overload-phase arrivals per "
                          "pressure level")
+    ap.add_argument("--soak-writes", type=int, default=150,
+                    help="mutable soak arm: writes per writer thread "
+                         "(0 disables the arm)")
+    ap.add_argument("--soak-writers", type=int, default=2,
+                    help="mutable soak arm: concurrent writer threads")
+    ap.add_argument("--soak-tolerance", type=float, default=0.02,
+                    help="mutable soak arm: max recall gap vs the "
+                         "freshly rebuilt exact oracle")
     args = ap.parse_args()
 
     if os.environ.get("RAFT_TPU_BENCH_PLATFORM", "default") != "default":
@@ -1423,6 +1608,22 @@ def main():
         # bench_gate.flatten_metrics reads ``extra`` as {family: fields},
         # so the hit-rate / stall tokens gate direction-aware
         art["extra"] = tiered_extra
+
+    if args.soak_writes > 0:
+        print("=== mutable soak (mixed read/write)", flush=True)
+        soak = bench_mutable_soak(
+            db, queries, args.k, res, rng, writers=args.soak_writers,
+            writes_per_writer=args.soak_writes,
+            submitters=args.submitters, max_batch=args.max_batch,
+            tolerance=args.soak_tolerance, sink=spans_sink)
+        art["mutable_soak"] = soak
+        print(f"  soak {soak['soak_s']}s: {soak['writes']} writes "
+              f"({soak['acks']} acked), {soak['searches']} searches, "
+              f"{soak['compactions']} compactions / "
+              f"{soak['swaps']} swaps, recall vs fresh oracle "
+              f"{soak['recall_vs_fresh_oracle']} "
+              f"(tolerance {soak['tolerance']}), untyped failures "
+              f"{soak['untyped_failures']}", flush=True)
 
     if spans_sink is not None:
         spans_sink.close()

@@ -1,16 +1,28 @@
 #!/usr/bin/env python
-"""Pre-flight sharded-checkpoint verification (TPU runbook gate).
+"""Pre-flight checkpoint verification (TPU runbook gate).
 
-Classifies every rank file of a checkpoint prefix against its manifest —
-ok / missing / truncated / corrupt — WITHOUT deserializing payloads or
-touching any accelerator, so it is safe (and fast) to run before burning
-a TPU window on `flagship_1m.py --from-ckpt`.
+Two target shapes, one exit-code contract:
+
+**Sharded checkpoint prefix** — classifies every rank file against its
+manifest — ok / missing / truncated / corrupt — WITHOUT deserializing
+payloads or touching any accelerator, so it is safe (and fast) to run
+before burning a TPU window on `flagship_1m.py --from-ckpt`.
 
     python tools/verify_checkpoint.py /tmp/flagship_10m.fbin.ckpt
 
-Exit codes: 0 = every shard rank restorable from a healthy file;
-1 = degraded (some ranks lost — an `allow_partial=True` elastic restore
-still works, coverage printed); 2 = no manifest / not a checkpoint.
+**Mutable-index directory** (a ``MutableIvf`` home: ``checkpoint.idx``
++ ``wal.log``) — classifies the WAL alongside the checkpoint
+(ok / torn_tail / corrupt) and names the lsn replay range a recovery
+would apply onto the checkpoint, so an operator knows BEFORE restarting
+a writer exactly which acknowledged writes the replay covers.
+
+    python tools/verify_checkpoint.py /data/indexes/products
+
+Exit codes: 0 = fully healthy; 1 = degraded but restorable (lost ranks
+with `allow_partial=True` coverage printed, or a torn WAL tail that
+recovery truncates — typed, only never-acknowledged bytes lost);
+2 = unrecoverable (no manifest / not a checkpoint / corrupt WAL or
+checkpoint bytes).
 """
 
 import argparse
@@ -23,18 +35,53 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from raft_tpu.neighbors import mutable  # noqa: E402
 from raft_tpu.parallel import sharded  # noqa: E402
+
+
+def _verify_mutable_dir(directory: str, as_json: bool) -> int:
+    report = mutable.verify_dir(directory)
+    if as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        ckpt, wal = report["checkpoint"], report["wal"]
+        print(f"{directory}: mutable index")
+        print(f"  {ckpt['status']:>9}  {ckpt['path']} "
+              f"(applied_lsn={ckpt['applied_lsn']})")
+        print(f"  {wal['status']:>9}  {wal['path']} "
+              f"({wal['records']} records)")
+        replay = report["replay"]
+        if replay:
+            print(f"  replay: lsn {replay['first_lsn']}..."
+                  f"{replay['last_lsn']} ({replay['records']} records) "
+                  f"onto the checkpoint")
+        else:
+            print("  replay: none (checkpoint covers the WAL)")
+        if report["status"] == "torn_tail":
+            print("DEGRADED: torn WAL tail — recovery truncates the "
+                  "damaged final frame; every acknowledged write is in "
+                  "the surviving prefix")
+        elif report["status"] != "ok":
+            print(f"UNRECOVERABLE: {report['status']}")
+        else:
+            print("OK")
+    return {"ok": 0, "torn_tail": 1}.get(report["status"], 2)
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(
-        description="Verify a sharded checkpoint's manifest + file crcs")
-    ap.add_argument("prefix", help="checkpoint prefix (the path passed to "
-                                   "sharded.serialize_*; files are "
-                                   "<prefix>.rank<i> + <prefix>.manifest)")
+        description="Verify a sharded checkpoint prefix or a mutable "
+                    "index directory (checkpoint + WAL)")
+    ap.add_argument("prefix",
+                    help="sharded checkpoint prefix (files <prefix>.rank<i>"
+                         " + <prefix>.manifest) or a MutableIvf directory "
+                         "(checkpoint.idx + wal.log)")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON on stdout")
     args = ap.parse_args()
+
+    if os.path.isdir(args.prefix):
+        return _verify_mutable_dir(args.prefix, args.json)
 
     try:
         report = sharded.verify_checkpoint(args.prefix)
