@@ -89,7 +89,7 @@ __all__ = [
 WAL_KIND = "mutable_wal"
 WAL_VERSION = 1
 CKPT_KIND = "mutable_ivf"
-CKPT_VERSION = 1
+CKPT_VERSION = 2
 #: on-disk file names inside a MutableIvf directory.
 WAL_FILE = "wal.log"
 CKPT_FILE = "checkpoint.idx"
@@ -268,8 +268,9 @@ class WriteAheadLog:
     critical section without ever nesting two locks. Durability waits
     ride a condition on the same lock: a writer blocks (budgeted) until
     the flusher's fsync covers its lsn. The flusher batches: it sleeps
-    ``group_window_s`` after the first pending append — with no lock
-    held — so concurrent writers share one fsync.
+    ``group_window_s`` after the first pending append, and both the
+    sleep AND the fsync itself run with no lock held, so concurrent
+    writers share one fsync and never stall behind it.
     """
 
     def __init__(self, path, *, lock: Optional[threading.Lock] = None,
@@ -290,6 +291,8 @@ class WriteAheadLog:
         self._durable_lsn = 0  # guarded_by: _lock
         self._appended_bytes = 0  # guarded_by: _lock
         self._closed = False  # guarded_by: _lock
+        #: last benign fsync race (handle rotated/closed mid-sync)
+        self.last_sync_error: Optional[BaseException] = None  # guarded_by: atomic
         self._flusher = threading.Thread(  # guarded_by: atomic
             target=self._flush_loop, name=f"wal-flush:{self.path}",
             daemon=True)
@@ -366,10 +369,25 @@ class WriteAheadLog:
             self._sync()
 
     def _sync(self) -> None:
+        # Snapshot the frontier and flush under the lock, fsync OUTSIDE
+        # it (appends are strictly ordered, so the fsync still covers
+        # every lsn <= target), then re-acquire to advance the durable
+        # frontier — a group-commit fsync never blocks writers,
+        # snapshot builds, or stats reads sharing this lock.
         with self._lock:
             target = self._appended_lsn
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            if target <= self._durable_lsn:
+                return
+            f = self._file
+            f.flush()
+        try:
+            os.fsync(f.fileno())
+        except (OSError, ValueError) as e:
+            # the handle was rotated (trim_locked) or closed under us;
+            # both paths fsync everything appended before swapping the
+            # file, so every lsn <= target is already durable
+            self.last_sync_error = e
+        with self._lock:
             self._durable_lsn = max(self._durable_lsn, target)
             self._cond.notify_all()
 
@@ -564,8 +582,13 @@ class _CompactionSnapshot(NamedTuple):
     are EVERY live row (base rows are recoverable from flat storage) —
     the build is a full re-cluster that also sheds tombstoned rows.
     For ivf_pq the base stores codes, not rows, so ``vectors`` carry
-    only the delta segment and the build path re-encodes it into the
-    existing base via ``extend`` (tombstones persist as filter bits)."""
+    only the delta rows NOT already resident in the base and the build
+    path re-encodes them into the existing base via ``extend``
+    (tombstones persist as filter bits). Delta ids that superseded a
+    base row (``keep_delta_ids``) are never extended — ``extend`` does
+    not dedupe ids and the standing filter is id-keyed, so it could not
+    mask just the stale physical copy; those rows stay in the delta
+    segment, keeping the base copy masked."""
 
     vectors: np.ndarray
     ids: np.ndarray
@@ -574,6 +597,8 @@ class _CompactionSnapshot(NamedTuple):
     full_rebuild: bool
     n_base: int
     n_delta: int
+    #: delta ids excluded from the build that must survive the install
+    keep_delta_ids: frozenset
 
 
 class MutableIvf:
@@ -620,6 +645,7 @@ class MutableIvf:
 
         ckpt = os.path.join(self.directory, CKPT_FILE)
         self.recovery: Optional[dict] = None  # guarded_by: atomic (init)
+        self._ckpt_metric: Optional[str] = None  # guarded_by: atomic (init)
         if os.path.exists(ckpt):
             self.base, self._mirror = self._restore_checkpoint(ckpt)
         else:
@@ -632,9 +658,16 @@ class MutableIvf:
             self.base = base  # guarded_by: _lock (compaction install)
             self._mirror = self._fresh_mirror(int(dim), base)
         self.dim = int(self._mirror.dim)
-        self.metric = resolve_metric(
-            base.metric if base is not None else
-            getattr(index_params, "metric", DistanceType.L2Expanded))
+        # metric precedence: the live base (fresh OR restored — a reopen
+        # passes base=None, the checkpoint's base is authoritative), then
+        # the metric persisted in a base-less checkpoint, then params.
+        if self.base is not None:
+            self.metric = resolve_metric(self.base.metric)
+        elif self._ckpt_metric is not None:
+            self.metric = resolve_metric(self._ckpt_metric)
+        else:
+            self.metric = resolve_metric(
+                getattr(index_params, "metric", DistanceType.L2Expanded))
         self._cache: Optional[_Cache] = None  # guarded_by: _lock
 
         wal_path = os.path.join(self.directory, WAL_FILE)
@@ -714,6 +747,7 @@ class MutableIvf:
                                 name=str(path))
             # the directory knows best: adopt the persisted family
             self.family = r.string()
+            self._ckpt_metric = r.string()
             dim = int(r.scalar())
             applied = int(r.scalar())
             next_id = int(r.scalar())
@@ -794,18 +828,25 @@ class MutableIvf:
         m.applied_lsn = max(m.applied_lsn, lsn)
         m.version += 1
 
-    def _write(self, op: int, ids: np.ndarray, vectors: np.ndarray,
-               timeout_s: Optional[float]) -> int:
+    def _write(self, op: int, ids, vectors: np.ndarray,
+               timeout_s: Optional[float]) -> Tuple[int, np.ndarray]:
+        """Commit one write: id resolution, WAL append, and in-memory
+        apply run in ONE critical section. ``ids`` may be a callable
+        receiving the mirror (under the lock) and returning the id
+        array — how :meth:`add` assigns fresh ids and validates explicit
+        ones without a release/reacquire window in which a concurrent
+        add could observe the same ``next_id``."""
         budget = self.ack_timeout_s if timeout_s is None else timeout_s
         with self._lock:
-            lsn, nbytes = self._wal.append_locked(op, ids, vectors)
-            self._apply_locked(op, ids, vectors, lsn)
+            resolved = ids(self._mirror) if callable(ids) else ids
+            lsn, nbytes = self._wal.append_locked(op, resolved, vectors)
+            self._apply_locked(op, resolved, vectors, lsn)
         self._m_writes[_OP_NAMES[op]].inc()
         self._m_wal_bytes.inc(nbytes)
         self._set_gauges()
         self._wal.wait_durable(lsn, budget)
         self._m_acks.inc()
-        return lsn
+        return lsn, resolved
 
     def add(self, vectors, ids=None, timeout_s: Optional[float] = None
             ) -> np.ndarray:
@@ -813,21 +854,25 @@ class MutableIvf:
         ids must not collide with live rows (use :meth:`upsert` to
         replace). Returns the int32 id array once fsync-durable."""
         v = self._check_vectors(vectors)
-        with self._lock:
-            m = self._mirror
-            if ids is None:
-                out = np.arange(m.next_id, m.next_id + len(v), dtype=np.int32)
-            else:
-                out = np.asarray(ids, np.int32).reshape(-1)
-                if len(out) != len(v):
-                    raise ValueError(f"{len(out)} ids for {len(v)} vectors")
-                live = m.live_ids()
-                clash = [int(i) for i in out if int(i) in live]
-                if clash:
-                    raise ValueError(
-                        f"add() of live ids {clash[:8]} — use upsert() "
-                        f"to replace")
-        self._write(OP_ADD, out, v, timeout_s)
+        explicit = None
+        if ids is not None:
+            explicit = np.asarray(ids, np.int32).reshape(-1)
+            if len(explicit) != len(v):
+                raise ValueError(f"{len(explicit)} ids for {len(v)} vectors")
+
+        def assign(m: _Mirror) -> np.ndarray:
+            if explicit is None:
+                return np.arange(m.next_id, m.next_id + len(v),
+                                 dtype=np.int32)
+            live = m.live_ids()
+            clash = [int(i) for i in explicit if int(i) in live]
+            if clash:
+                raise ValueError(
+                    f"add() of live ids {clash[:8]} — use upsert() "
+                    f"to replace")
+            return explicit
+
+        _, out = self._write(OP_ADD, assign, v, timeout_s)
         return out
 
     def upsert(self, vectors, ids, timeout_s: Optional[float] = None) -> int:
@@ -837,14 +882,16 @@ class MutableIvf:
         out = np.asarray(ids, np.int32).reshape(-1)
         if len(out) != len(v):
             raise ValueError(f"{len(out)} ids for {len(v)} vectors")
-        return self._write(OP_UPSERT, out, v, timeout_s)
+        lsn, _ = self._write(OP_UPSERT, out, v, timeout_s)
+        return lsn
 
     def delete(self, ids, timeout_s: Optional[float] = None) -> int:
         """Tombstone rows by id (unknown ids are a durable no-op so
         replay stays idempotent). Returns the commit lsn."""
         out = np.asarray(ids, np.int32).reshape(-1)
-        return self._write(OP_DELETE, out,
-                           np.zeros((0, self.dim), np.float32), timeout_s)
+        lsn, _ = self._write(OP_DELETE, out,
+                             np.zeros((0, self.dim), np.float32), timeout_s)
+        return lsn
 
     # -------------------------------------------------------------- search
     def _snapshot(self) -> _Cache:
@@ -988,6 +1035,7 @@ class MutableIvf:
         with ser.writer_for(path) as stream:
             w = ser.IndexWriter(stream, CKPT_KIND, CKPT_VERSION)
             w.string(self.family)
+            w.string(self.metric.name)
             w.scalar(self.dim, "<i4")
             w.scalar(applied, "<i8")
             w.scalar(next_id, "<i8")
@@ -1024,11 +1072,23 @@ class MutableIvf:
             m = self._mirror
             snap_lsn = m.applied_lsn
             keep_base = m.base_ids - m.tombs - set(m.slot_of)
+            base_resident = m.base_ids  # frozenset: immutable snapshot
             valid = m.ids[: m.count] >= 0
             d_ids = m.ids[: m.count][valid].copy()
             d_rows = m.rows[: m.count][valid].copy()
             base = self.base
         full_rebuild = self.family == "ivf_flat" or base is None
+        keep_delta: frozenset = frozenset()
+        if not full_rebuild and len(d_ids):
+            # extend path: a delta id already resident in the base (an
+            # upsert of a base row) would become a second physical row
+            # for the same id — keep it in the delta instead.
+            keep_delta = frozenset(int(i) for i in d_ids
+                                   if int(i) in base_resident)
+            if keep_delta:
+                sel = np.fromiter((int(i) not in keep_delta for i in d_ids),
+                                  bool, len(d_ids))
+                d_ids, d_rows = d_ids[sel], d_rows[sel]
         base_rows = np.zeros((0, self.dim), np.float32)
         base_ids = np.zeros((0,), np.int32)
         if full_rebuild and keep_base and base is not None:
@@ -1039,20 +1099,25 @@ class MutableIvf:
         vectors = np.concatenate([base_rows, d_rows], axis=0)
         ids = np.concatenate([base_ids, d_ids], axis=0).astype(np.int32)
         return _CompactionSnapshot(vectors, ids, snap_lsn, base,
-                                   full_rebuild, len(base_ids), len(d_ids))
+                                   full_rebuild, len(base_ids), len(d_ids),
+                                   keep_delta)
 
     def _install_base(self, new_base, snap: _CompactionSnapshot) -> None:
         """Swap in the compacted base and drop the delta slots it
-        absorbed (lsn <= snapshot lsn). Post-snapshot writes — delta
-        slots, tombstones, next_id — carry over untouched; the base-ok
-        bitset is rebuilt from the new id set."""
+        absorbed (lsn <= snapshot lsn, minus ``keep_delta_ids`` — rows
+        the extend path excluded, which must stay in the delta so the
+        stale base copy they supersede stays masked). Post-snapshot
+        writes — delta slots, tombstones, next_id — carry over
+        untouched; the base-ok bitset is rebuilt from the new id set."""
         with self._lock:
             m = self._mirror
             m.base_ids = frozenset(int(i) for i in _index_ids(new_base)) \
                 if new_base is not None else frozenset()
             survivors = [(int(m.ids[s]), m.rows[s].copy(), int(m.lsns[s]))
                          for s in range(m.count)
-                         if m.ids[s] >= 0 and m.lsns[s] > snap.lsn]
+                         if m.ids[s] >= 0
+                         and (m.lsns[s] > snap.lsn
+                              or int(m.ids[s]) in snap.keep_delta_ids)]
             m.rows = np.zeros((0, self.dim), np.float32)
             m.ids = np.zeros((0,), np.int32)
             m.lsns = np.zeros((0,), np.int64)
@@ -1317,20 +1382,28 @@ class Compactor:
         prior base): re-cluster every live row into a fresh index with
         the original ids (build with add_data_on_build=False, then
         extend — the id-preserving path). ivf_pq with a base: the base
-        stores codes, not rows, so the delta is re-encoded into the
-        existing base via extend; tombstoned base rows stay physically
-        present but permanently filtered by the standing bitset."""
+        stores codes, not rows, so the base-fresh delta rows are
+        re-encoded into the existing base via extend (ids already
+        resident in the base were excluded at snapshot time and stay in
+        the delta — extend does not dedupe ids); tombstoned base rows
+        stay physically present but permanently filtered by the
+        standing bitset."""
         import dataclasses as _dc
 
         mod = _family_mod(self.writer.family)
         if not snap.full_rebuild:
+            if len(snap.ids) == 0:
+                return snap.base  # every delta row superseded a base id
             return mod.extend(snap.base, snap.vectors,
                               new_indices=snap.ids, res=self.writer.res)
         params = self.writer.index_params
         if params is None:
             params = mod.IndexParams()
         n_lists = max(1, min(int(params.n_lists), len(snap.ids)))
+        # pin the writer's metric: a reopened writer has no index_params,
+        # and a default-metric rebuild would silently change the space
         params = _dc.replace(params, n_lists=n_lists,
+                             metric=self.writer.metric,
                              add_data_on_build=False)
         base = mod.build(snap.vectors, params, res=self.writer.res)
         return mod.extend(base, snap.vectors, new_indices=snap.ids,
@@ -1406,6 +1479,7 @@ def verify_dir(directory) -> dict:
                 r = ser.IndexReader(stream, CKPT_KIND, CKPT_VERSION,
                                     name=ckpt_path)
                 r.string()  # family
+                r.string()  # metric
                 r.scalar()  # dim
                 ckpt["applied_lsn"] = int(r.scalar())
                 r.scalar()  # next_id

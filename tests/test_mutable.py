@@ -266,6 +266,74 @@ def test_deleted_base_ids_filtered_after_compaction(tmp_path):
     w.close()
 
 
+def test_concurrent_adds_never_share_auto_ids(tmp_path):
+    """Auto-id assignment commits in the same critical section as the
+    WAL append + apply: two adds racing can never both observe one
+    next_id and be acknowledged with the same id (the second would
+    silently overwrite the first — put is insert-or-replace)."""
+    w = _writer(tmp_path, group_window_s=0.002)
+    got: list = []
+    errors: list = []
+
+    def adder(tid):
+        rng = np.random.RandomState(100 + tid)
+        try:
+            for _ in range(6):
+                ids = w.add(rng.randn(2, DIM).astype(np.float32))
+                got.extend(int(i) for i in ids)
+        except (RaftError, ValueError) as e:  # pragma: no cover
+            errors.append(e)
+
+    with InterleaveAmplifier(
+            seed=5, path_filters=("neighbors/mutable.py",)):
+        threads = [threading.Thread(target=adder, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    assert len(got) == 3 * 6 * 2
+    assert len(set(got)) == len(got), \
+        "two concurrent adds were acknowledged with the same id"
+    assert w.size == len(got)
+    w.close()
+
+
+def test_ivf_pq_compaction_never_duplicates_upserted_ids(tmp_path):
+    """The ivf_pq extend path must not re-extend an id already resident
+    in the base: extend does not dedupe ids and the standing filter is
+    id-keyed, so a second physical row would resurface the stale
+    pre-upsert vector. Superseded rows stay in the delta instead."""
+    from raft_tpu.neighbors import ivf_pq
+
+    rng = np.random.default_rng(23)
+    w = _writer(tmp_path, family="ivf_pq",
+                index_params=ivf_pq.IndexParams(n_lists=2))
+    vecs = rng.standard_normal((32, DIM)).astype(np.float32)
+    w.add(vecs)
+    comp = mutable.Compactor(w, min_rows=1)
+    assert comp.run_once("manual") == "ok"
+    assert w.stats()["base_rows"] == 32
+
+    # upsert a base-resident id far away, plus one brand-new row
+    far = np.full((1, DIM), 25.0, np.float32)
+    w.upsert(far, [7])
+    w.add(rng.standard_normal((1, DIM)).astype(np.float32))  # id 32
+    assert comp.run_once("manual") == "ok"
+
+    # the fresh row was absorbed; the superseded one stays in the delta
+    # and the base holds exactly ONE physical row for its id
+    assert w.stats()["delta_rows"] == 1
+    assert list(mutable._index_ids(w.base)).count(7) == 1
+    d, i = w.search(far, 33)
+    ids = np.asarray(i).ravel().tolist()
+    assert ids.count(7) == 1, "stale base copy surfaced after compaction"
+    assert ids[0] == 7  # the upserted (exact, delta-resident) location
+    assert float(np.asarray(d).ravel()[0]) < 1e-3
+    w.close()
+
+
 # ------------------------------------------------------ recovery + replay
 
 
@@ -343,6 +411,46 @@ def test_checkpoint_trims_wal_and_restores(tmp_path):
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
     np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
     w2.close()
+
+
+def test_restored_metric_matches_persisted_state(tmp_path):
+    """Reopening a directory resolves the metric from the restored
+    state (checkpointed base, or the metric persisted alongside it),
+    never from the absent constructor args — an InnerProduct index must
+    keep max-close selection across a crash/restart cycle."""
+    from raft_tpu.ops.distance import DistanceType
+
+    rng = np.random.default_rng(20)
+    w = _writer(tmp_path, index_params=ivf_flat.IndexParams(
+        n_lists=2, metric=DistanceType.InnerProduct))
+    vecs = rng.standard_normal((16, DIM)).astype(np.float32)
+    w.add(vecs)
+    q = rng.standard_normal((2, DIM)).astype(np.float32)
+    d1, i1 = w.search(q, 4)
+    w.checkpoint()
+    w.close()
+
+    # base-less checkpoint: the metric rides the checkpoint itself
+    w2 = _writer(tmp_path)  # reopen passes no base / no index_params
+    assert w2.metric == DistanceType.InnerProduct
+    d2, i2 = w2.search(q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    # a compaction on the reopened writer rebuilds in the SAME space...
+    comp = mutable.Compactor(w2)
+    assert comp.run_once("manual") == "ok"
+    assert w2.base.metric == DistanceType.InnerProduct
+    _, i3 = w2.search(q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i3))
+    w2.close()
+
+    # ...and a restore WITH a base adopts the base's metric
+    w3 = _writer(tmp_path)
+    assert w3.metric == DistanceType.InnerProduct
+    _, i4 = w3.search(q, 4)
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i4))
+    w3.close()
 
 
 # ------------------------------------------------------------- compaction
